@@ -1,0 +1,354 @@
+"""Topology discovery, initialization, and the SPMD rank model.
+
+TPU-native re-design of Horovod's process/rank bootstrap
+(reference: horovod/common/basics.py:22-212 and the extern-C API in
+horovod/common/operations.cc:653-791).
+
+Horovod's model: every *process* is a rank; ``hvd.init()`` ctypes-calls into a
+C++ core that spawns a background thread and negotiates membership over
+MPI/Gloo.  On TPU there is no MPI: the platform gives us the topology (the
+ICI mesh), and XLA compiles collectives directly into the program.  So here:
+
+* a **rank** is a *device* (TPU chip) in the global ``jax.sharding.Mesh``;
+* the per-rank "script" is an SPMD function run under :func:`horovod_tpu.spmd`
+  (``shard_map`` over the mesh) — inside it, :func:`rank` is the traced
+  ``lax.axis_index``;
+* the host Python process is a *controller* owning ``local_size()`` ranks;
+  outside SPMD regions :func:`rank` reports the controller's process index
+  (used for rank-0 gating: checkpoints, logging — same idiom as Horovod
+  examples);
+* multi-host bootstrap uses ``jax.distributed`` (the analog of Horovod's
+  Gloo HTTP-rendezvous, reference horovod/common/gloo/gloo_context.cc:56-76),
+  driven by ``HVD_*`` env vars set by the ``tpurun`` launcher.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .utils import env as env_util
+from .utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Reduction op constants, mirroring horovod.common.basics (reference
+# horovod/common/basics.py:44-49 exposes horovod_reduce_op_average/_sum/
+# _adasum read from the C++ enum in common/message.h).
+Average = "Average"
+Sum = "Sum"
+Adasum = "Adasum"
+Min = "Min"
+Max = "Max"
+
+#: Name of the global mesh axis spanning every rank (device).
+AXIS = "hvd"
+#: Hierarchical axes: "cross" spans hosts/slices (DCN), "local" spans the
+#: devices within one host/slice (ICI) — the analog of Horovod's
+#: LOCAL/CROSS communicators (reference horovod/common/common.h:110-114).
+CROSS_AXIS = "cross"
+LOCAL_AXIS = "local"
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call hvd.init() first."
+        )
+
+
+@dataclass
+class _GlobalState:
+    """Python analog of HorovodGlobalState (reference
+    horovod/common/global_state.h:42) — minus the background thread, which
+    XLA's async dispatch makes unnecessary on the hot path."""
+
+    initialized: bool = False
+    devices: tuple = ()
+    mesh: Optional[Mesh] = None
+    hmesh: Optional[Mesh] = None
+    size: int = 0
+    local_size: int = 0
+    cross_size: int = 0
+    process_index: int = 0
+    process_count: int = 1
+    platform: Optional[str] = None
+    # Monotone id so cached jitted collectives can be invalidated on re-init.
+    epoch: int = 0
+    extra: dict = field(default_factory=dict)
+
+
+_state = _GlobalState()
+_lock = threading.Lock()
+
+
+class _SpmdContext(threading.local):
+    """Tracks whether we are tracing inside an SPMD (shard_map) region and
+    which mesh axes constitute the rank axis there."""
+
+    def __init__(self) -> None:
+        self.axes: Optional[tuple] = None  # e.g. ("hvd",) or ("cross","local")
+        self.local_axis: Optional[str] = None
+
+
+_ctx = _SpmdContext()
+
+
+def _pick_devices(platform: Optional[str]) -> list:
+    if platform is not None:
+        return list(jax.devices(platform))
+    return list(jax.devices())
+
+
+def init(
+    *,
+    platform: Optional[str] = None,
+    devices: Optional[Sequence[Any]] = None,
+    local_size: Optional[int] = None,
+    comm: Optional[Sequence[int]] = None,
+) -> None:
+    """Initialize the framework: discover topology and build the global mesh.
+
+    Mirrors ``hvd.init()`` (reference horovod/common/basics.py:33-65 →
+    operations.cc:655 ``horovod_init``): idempotent, and accepts ``comm=``
+    (a subset of ranks) the way Horovod accepts a sub-communicator.
+
+    Args:
+      platform: force a JAX platform ("tpu" / "cpu"); default = default
+        backend.  Tests use ``platform="cpu"`` with
+        ``--xla_force_host_platform_device_count=N`` — the analog of the
+        reference's ``mpirun -np 2 -H localhost:2`` localhost simulation
+        (reference docker-compose.test.yml:52).
+      devices: explicit device list (overrides ``platform``).
+      local_size: devices per "node" for the hierarchical (cross, local)
+        mesh.  Defaults to this process's local device count; on a single
+        process it can be overridden to simulate multiple nodes.
+      comm: optional subset of global device indices to form the world from
+        (reference operations.cc:655-663 ranks argument).
+    """
+    global _state
+    with _lock:
+        if _state.initialized:
+            return
+        if os.environ.get("HVD_COORDINATOR_ADDR") and jax.process_count() == 1:
+            # Multi-host bootstrap: the tpurun launcher sets these.  This is
+            # the rendezvous step — the analog of GlooContext::Initialize's
+            # HTTP KV-store handshake (reference gloo/gloo_context.cc:113-157).
+            jax.distributed.initialize(
+                coordinator_address=os.environ["HVD_COORDINATOR_ADDR"],
+                num_processes=int(os.environ.get("HVD_NUM_PROCESSES", "1")),
+                process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
+            )
+
+        devs = list(devices) if devices is not None else _pick_devices(platform)
+        # Process-major ordering so each controller's devices are contiguous
+        # — this makes the (cross, local) reshape put intra-host links on
+        # the fast axis, mirroring MPI_Comm_split_type(..., SHARED)
+        # (reference mpi/mpi_context.cc).
+        devs.sort(key=lambda d: (d.process_index, d.id))
+        if comm is not None:
+            devs = [devs[i] for i in comm]
+
+        size = len(devs)
+        if size == 0:
+            raise RuntimeError("no devices available for horovod_tpu.init()")
+
+        if local_size is None:
+            mine = [d for d in devs if d.process_index == jax.process_index()]
+            local_size = len(mine) if mine else size
+        if size % local_size != 0:
+            raise ValueError(
+                f"global size {size} not divisible by local_size {local_size}"
+            )
+        cross_size = size // local_size
+
+        mesh = Mesh(np.asarray(devs, dtype=object), (AXIS,))
+        hmesh = Mesh(
+            np.asarray(devs, dtype=object).reshape(cross_size, local_size),
+            (CROSS_AXIS, LOCAL_AXIS),
+        )
+
+        _state = _GlobalState(
+            initialized=True,
+            devices=tuple(devs),
+            mesh=mesh,
+            hmesh=hmesh,
+            size=size,
+            local_size=local_size,
+            cross_size=cross_size,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            platform=devs[0].platform,
+            epoch=_state.epoch + 1,
+        )
+        log.info(
+            "initialized: size=%d local_size=%d cross_size=%d platform=%s",
+            size, local_size, cross_size, _state.platform,
+        )
+
+
+def shutdown() -> None:
+    """Tear down state (reference horovod/common/basics.py:67-70 →
+    operations.cc ``horovod_shutdown``)."""
+    global _state
+    with _lock:
+        _state = _GlobalState(epoch=_state.epoch + 1)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def mesh() -> Mesh:
+    """The global 1-D device mesh; axis name :data:`AXIS`."""
+    return _require_init().mesh
+
+
+def hierarchical_mesh() -> Mesh:
+    """The 2-D (cross, local) mesh for hierarchical collectives."""
+    return _require_init().hmesh
+
+
+def size() -> int:
+    """Total number of ranks (devices)."""
+    return _require_init().size
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def in_spmd() -> bool:
+    """True while tracing inside an hvd SPMD region."""
+    return _ctx.axes is not None
+
+
+def _spmd_axes() -> Optional[tuple]:
+    return _ctx.axes
+
+
+def rank():
+    """This rank's index.
+
+    Inside an SPMD region: the traced per-device index along the rank axis
+    (``lax.axis_index``).  Outside: the controller process index, which is
+    what rank-0 gating in user scripts needs (reference idiom:
+    examples/tensorflow2_mnist.py ``if hvd.rank() == 0``).
+    """
+    st = _require_init()
+    if _ctx.axes is not None:
+        from jax import lax
+
+        if len(_ctx.axes) == 1:
+            return lax.axis_index(_ctx.axes[0])
+        # (cross, local) → flat rank = cross * local_size + local
+        return (
+            lax.axis_index(_ctx.axes[0]) * st.local_size
+            + lax.axis_index(_ctx.axes[1])
+        )
+    return st.process_index
+
+
+def local_rank():
+    """Rank within the node (reference basics.py:152-160)."""
+    st = _require_init()
+    if _ctx.axes is not None:
+        from jax import lax
+
+        if len(_ctx.axes) == 2:
+            return lax.axis_index(_ctx.axes[1])
+        return lax.axis_index(_ctx.axes[0]) % st.local_size
+    return 0
+
+
+def cross_rank():
+    """Node index of this rank (reference LOCAL/CROSS communicator split,
+    horovod/common/common.h:110-114)."""
+    st = _require_init()
+    if _ctx.axes is not None:
+        from jax import lax
+
+        if len(_ctx.axes) == 2:
+            return lax.axis_index(_ctx.axes[0])
+        return lax.axis_index(_ctx.axes[0]) // st.local_size
+    return st.process_index
+
+
+def process_rank() -> int:
+    return _require_init().process_index
+
+
+def process_size() -> int:
+    return _require_init().process_count
+
+
+def is_homogeneous() -> bool:
+    """All nodes have the same local_size — always true for a TPU slice
+    (reference basics.py:171-179)."""
+    _require_init()
+    return True
+
+
+# --- capability probes, mirroring horovod.common.util/basics feature checks
+# (reference horovod/common/basics.py:83-150: mpi_enabled, mpi_built,
+#  gloo_enabled, nccl_built, ddl_built, ccl_built, cuda_built, rocm_built).
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """The one true data plane here."""
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    return False

@@ -1,0 +1,162 @@
+"""Eager (host-level) collectives — the control path.
+
+In the reference, *every* collective goes through the eager path: Python
+enqueues a named tensor to the background C++ thread, ranks negotiate, and a
+callback fires on completion (reference horovod/common/operations.cc:795
+EnqueueTensorAllreduce → tensor_queue → controller.cc ComputeResponseList).
+On TPU the hot path is compiled (see spmd.py), so the eager plane only
+serves control-flow uses: parameter/optimizer-state broadcast at start-up,
+metric averaging, object broadcast, and tests.
+
+Two eager modes:
+
+* **device-plane eager**: input is a list of per-rank values (or a
+  rank-sharded global array from :func:`horovod_tpu.put_per_rank`).
+  We jit a tiny SPMD program on the fly; the jit cache plays the role of
+  the reference's response cache (response_cache.h:45-102) — first call
+  negotiates (compiles), repeats are cache hits.
+* **process-plane eager**: input is one value per *controller process*
+  (multi-host); uses ``jax.experimental.multihost_utils``.  This is the
+  analog of Horovod's cross-rank object broadcast
+  (reference horovod/torch/__init__.py:446-638 broadcast_object).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from functools import partial
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import core
+from .spmd import put_per_rank, get_per_rank, rank_context
+from .core import Average, Sum, Adasum, Min, Max
+from .ops import collectives
+from .timeline.timeline import timeline
+
+
+def _is_per_rank_list(x) -> bool:
+    return isinstance(x, (list, tuple))
+
+
+def _spmd_op(fn, *, out_sharded: bool):
+    """Build (and jit-cache) a one-collective SPMD program."""
+    mesh = core.mesh()
+    out_spec = P(core.AXIS) if out_sharded else P()
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=P(core.AXIS), out_specs=out_spec,
+            check_vma=False,
+        )
+    )
+
+
+def allreduce_(tensors, *, op: str = Average, name: Optional[str] = None):
+    """Eager allreduce.  ``tensors``: list of per-rank arrays (len == size())
+    or a rank-sharded global array.  Returns the same structure, reduced.
+
+    Analog of ``hvd.allreduce_`` / ``allreduce_async_`` + ``synchronize``
+    (reference horovod/torch/mpi_ops.py:72-129) — async dispatch is native
+    to JAX, so the returned arrays are futures already; materializing them
+    is the ``synchronize`` step.
+    """
+    name = name or "allreduce.eager"
+    with timeline.span(name, "ALLREDUCE"):
+        as_list = _is_per_rank_list(tensors)
+        x = put_per_rank(list(tensors)) if as_list else tensors
+
+        def body(v):
+            with rank_context((core.AXIS,)):
+                return collectives.allreduce(v[0], op=op)[None]
+
+        out = _spmd_op(body, out_sharded=True)(x)
+        return get_per_rank(out) if as_list else out
+
+
+def allgather_(tensors, *, name: Optional[str] = None):
+    """Eager allgather along axis 0 (equal shapes).  List-in/list-out."""
+    name = name or "allgather.eager"
+    with timeline.span(name, "ALLGATHER"):
+        as_list = _is_per_rank_list(tensors)
+        x = put_per_rank(list(tensors)) if as_list else tensors
+
+        def body(v):
+            with rank_context((core.AXIS,)):
+                return collectives.allgather(v[0])
+
+        out = _spmd_op(body, out_sharded=False)(x)
+        out = jax.device_get(out)
+        if as_list:
+            return [np.asarray(out)] * core.size()
+        return out
+
+
+def broadcast_(tensors, root_rank: int = 0, *, name: Optional[str] = None):
+    """Eager broadcast of per-rank values from ``root_rank``."""
+    name = name or "broadcast.eager"
+    with timeline.span(name, "BROADCAST"):
+        as_list = _is_per_rank_list(tensors)
+        x = put_per_rank(list(tensors)) if as_list else tensors
+
+        def body(v):
+            with rank_context((core.AXIS,)):
+                return collectives.broadcast(v[0], root_rank=root_rank)[None]
+
+        out = _spmd_op(body, out_sharded=True)(x)
+        return get_per_rank(out) if as_list else out
+
+
+# ---------------------------------------------------------------------------
+# process-plane (multi-controller) object collectives
+# ---------------------------------------------------------------------------
+def broadcast_object(obj: Any, root_rank: int = 0, *, name: Optional[str] = None):
+    """Serialize ``obj`` on the root process and broadcast it to all
+    controller processes (reference horovod/torch/__init__.py:580-638
+    ``broadcast_object``: cloudpickle → byte tensor → size bcast → payload
+    bcast).  Single-process: identity."""
+    if core.process_size() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    if core.process_rank() == root_rank:
+        payload = pickle.dumps(obj)
+    else:
+        payload = b""
+    # Two-phase: length first, then fixed-size payload — same shape as the
+    # reference's sz tensor broadcast followed by the byte tensor.
+    n = np.asarray([len(payload)], np.int64)
+    n = multihost_utils.broadcast_one_to_all(n, is_source=core.process_rank() == root_rank)
+    buf = np.zeros(int(n[0]), np.uint8)
+    if core.process_rank() == root_rank:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(
+        buf, is_source=core.process_rank() == root_rank
+    )
+    return pickle.loads(buf.tobytes())
+
+
+def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
+    """Gather a picklable object from every controller process (reference
+    upstream allgather_object pattern).  Single-process: ``[obj]``."""
+    if core.process_size() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64)
+    ).reshape(-1)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(padded)
+    return [
+        pickle.loads(gathered[i, : int(sizes[i])].tobytes())
+        for i in range(core.process_size())
+    ]

@@ -1,0 +1,75 @@
+"""Join: uneven-data participation.
+
+Reference semantics (horovod/common/operations.cc:922-946 EnqueueJoin,
+controller.cc:73-77,210-213,253-264,291-298, zero-fill in
+collective_operations.cc:217-225, torch binding horovod/torch/__init__.py:42):
+a rank that has exhausted its data calls ``hvd.join()`` and from then on
+participates in every allreduce with zero tensors until all ranks join; the
+average divides by the number of *non-joined* ranks.  Allgather/broadcast
+are unsupported under Join (controller.cc:453-456,527-531) — same here.
+
+TPU-native form: under SPMD there is no per-rank control flow divergence —
+every rank runs the same compiled step.  Joined-ness becomes a per-rank
+boolean *input* (``active``), and :func:`join_allreduce` masks contributions
+and divides by the active count.  This is the compiled-world expression of
+the same contract, and it is how uneven dataset tails are handled in the
+DataLoader shim (data/loader.py): the last partial batch runs with
+``active=False`` on ranks that ran out.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import core
+from ..core import Average, Sum
+
+
+def join_allreduce(tensor, active, *, op: str = Average):
+    """Allreduce where ranks with ``active == False`` contribute zeros and
+    Average divides by the number of active ranks (min 1).
+
+    ``active``: per-rank bool scalar (traced).
+    """
+    axes = core._spmd_axes()
+    if axes is None:
+        raise RuntimeError("join_allreduce must run inside an SPMD region")
+    axis = axes if len(axes) > 1 else axes[0]
+    act = jnp.asarray(active)
+    masked = jnp.where(act, tensor, jnp.zeros_like(tensor))
+    total = lax.psum(masked, axis)
+    if op == Sum:
+        return total
+    if op == Average:
+        count = lax.psum(act.astype(jnp.float32), axis)
+        return total / jnp.maximum(count, 1.0)
+    raise ValueError(f"join_allreduce supports Average/Sum, got {op!r}")
+
+
+def join_count(active):
+    """Number of active (non-joined) ranks this step."""
+    axes = core._spmd_axes()
+    if axes is None:
+        raise RuntimeError("join_count must run inside an SPMD region")
+    axis = axes if len(axes) > 1 else axes[0]
+    return lax.psum(jnp.asarray(active).astype(jnp.int32), axis)
+
+
+def join() -> int:
+    """Host-level join barrier for the eager/process plane.
+
+    Blocks until every controller process has called join; returns the last
+    rank to join (reference returns the last joining rank so callers can
+    detect stragglers; horovod/torch/mpi_ops.py join()).  Single-process:
+    returns this process's rank immediately.
+    """
+    core._require_init()
+    if core.process_size() == 1:
+        return core.process_rank()
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("hvd_join")
+    return core.process_size() - 1

@@ -1,0 +1,11 @@
+from .collectives import (  # noqa: F401
+    allreduce,
+    grouped_allreduce,
+    allgather,
+    allgatherv,
+    broadcast,
+    alltoall,
+    reducescatter,
+    allreduce_gradients,
+)
+from .compression import Compression  # noqa: F401

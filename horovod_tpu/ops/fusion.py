@@ -1,0 +1,135 @@
+"""Tensor fusion: bucketing many small tensors into few large collectives.
+
+TPU-native re-design of the fusion buffer (reference
+horovod/common/fusion_buffer_manager.cc/.h — persistent 64 MB buffers per
+(device, framework, stream) — plus the response-fusion pass in
+controller.cc:665 FuseResponses and the MemcpyIn/OutFusionBuffer kernels in
+ops/collective_operations.cc).
+
+On TPU there is no persistent staging buffer and no memcpy kernel: we
+flatten each gradient leaf, group leaves of the same dtype into buckets of
+at most ``HVD_FUSION_THRESHOLD`` bytes (reference default 64 MB,
+common.h:69), concatenate each bucket, run ONE ``psum`` per bucket, and
+split back.  XLA fuses the concat/split with neighbors, and its own
+all-reduce combiner provides a second level of batching — the autotuner
+(optim/autotune.py) owns both knobs, as SURVEY §7.3(2) requires.
+
+Bucketing is a *trace-time* planner (shapes are static under jit), which is
+exactly the negotiated-once-then-cached steady state of the reference's
+response cache — except the "cache" is the compiled executable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import core
+from ..core import Average, Sum
+from ..utils import env as env_util
+from .compression import Compression
+
+
+class FusionPlan:
+    """A static bucketing of a fixed list of (shape, dtype) leaves."""
+
+    def __init__(self, leaves: Sequence[Any], threshold_bytes: Optional[int] = None):
+        if threshold_bytes is None:
+            threshold_bytes = env_util.fusion_threshold_bytes()
+        self.threshold_bytes = max(int(threshold_bytes), 1)
+        # bucket := list of leaf indices, all same dtype, total bytes <= threshold
+        self.buckets: List[List[int]] = []
+        current: dict = {}  # dtype -> (bucket_idx, bytes_so_far)
+        for i, leaf in enumerate(leaves):
+            dt = jnp.result_type(leaf)
+            nbytes = leaf.size * dt.itemsize
+            slot = current.get(dt)
+            if slot is not None and slot[1] + nbytes <= self.threshold_bytes:
+                self.buckets[slot[0]].append(i)
+                current[dt] = (slot[0], slot[1] + nbytes)
+            else:
+                self.buckets.append([i])
+                current[dt] = (len(self.buckets) - 1, nbytes)
+
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def _reduce_flat(flat, *, op, axes, groups, group_size):
+    if len(axes) == 1:
+        out = lax.psum(flat, axes[0], axis_index_groups=groups)
+    else:
+        out = lax.psum(flat, axes)
+    if op == Average:
+        out = out / group_size
+    return out
+
+
+def fused_allreduce(
+    tensors: List[Any],
+    *,
+    op: str = Average,
+    compression=Compression.none,
+    process_set=None,
+    threshold_bytes: Optional[int] = None,
+):
+    """Allreduce a list of tensors with static bucketing; returns the list in
+    the original order (reference semantics: grouped allreduce results are
+    per-input, horovod/common/controller.cc FuseResponses)."""
+    axes = core._spmd_axes()
+    if axes is None:
+        raise RuntimeError("fused_allreduce must run inside an SPMD region")
+    if process_set is None:
+        groups, group_size = None, core.size()
+    else:
+        groups, group_size = process_set.groups(), process_set.size()
+
+    compressed = []
+    ctxs = []
+    for t in tensors:
+        c, ctx = compression.compress(t)
+        compressed.append(c)
+        ctxs.append(ctx)
+
+    plan = FusionPlan(compressed, threshold_bytes)
+    out: List[Any] = [None] * len(tensors)
+    for bucket in plan.buckets:
+        if len(bucket) == 1:
+            i = bucket[0]
+            red = _reduce_flat(compressed[i], op=op, axes=axes, groups=groups,
+                               group_size=group_size)
+            out[i] = compression.decompress(red, ctxs[i])
+            continue
+        flats = [compressed[i].reshape(-1) for i in bucket]
+        fused = jnp.concatenate(flats)
+        red = _reduce_flat(fused, op=op, axes=axes, groups=groups,
+                           group_size=group_size)
+        offset = 0
+        for i in bucket:
+            n = compressed[i].size
+            piece = lax.dynamic_slice_in_dim(red, offset, n).reshape(
+                compressed[i].shape
+            )
+            out[i] = compression.decompress(piece, ctxs[i])
+            offset += n
+    return out
+
+
+def allreduce_pytree(
+    tree,
+    *,
+    op: str = Average,
+    compression=Compression.none,
+    process_set=None,
+    threshold_bytes: Optional[int] = None,
+):
+    """Fused allreduce over every array leaf of a pytree (gradients)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    reduced = fused_allreduce(
+        leaves, op=op, compression=compression, process_set=process_set,
+        threshold_bytes=threshold_bytes,
+    )
+    return jax.tree_util.tree_unflatten(treedef, reduced)

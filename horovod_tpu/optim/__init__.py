@@ -1,0 +1,7 @@
+from .distributed import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedGradientTape,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    broadcast_variables,
+)

@@ -1,0 +1,1 @@
+from .timeline import Timeline, timeline  # noqa: F401

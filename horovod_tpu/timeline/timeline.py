@@ -1,0 +1,202 @@
+"""Per-rank Chrome-trace communication timeline.
+
+Re-design of the fork-modified Timeline (reference
+horovod/common/timeline.cc/.h): a dedicated writer thread drains an event
+queue (reference uses a boost SPSC lock-free queue, timeline.h:68-70; here a
+``queue.SimpleQueue``) and streams Chrome-trace JSON.  Fork behaviors kept:
+
+* **per-rank output** ``<dir>/<rank>/comm.json`` (reference
+  timeline.cc:205-228, changed from upstream's single coordinator file —
+  operations.cc:395-399);
+* **step windowing** via ``HVD_TRACE_START_STEP`` / ``HVD_TRACE_END_STEP``
+  (reference BYTEPS_TRACE_START_STEP/END_STEP, timeline.cc:30-31,101-144):
+  events are only recorded inside the window, and the file is finalized and
+  the writer stopped at the end step;
+* the event vocabulary: ``NEGOTIATE_<OP>`` spans, top-level ``ALLREDUCE`` /
+  ``ALLGATHER`` / ``BROADCAST`` spans, nested activity spans, and
+  ``CYCLE_START`` instants when ``HVD_TIMELINE_MARK_CYCLES`` is set
+  (reference common.h:31-59, timeline.cc:377-384).
+
+What changes on TPU: GPU activity timing came from CUDA events drained by
+finalizer threads (reference gpu_operations.h:103-111); here device-side
+timing comes from the XLA profiler (``jax.profiler``), which the Recorder
+layer (timeline/recorder.py) integrates; this timeline covers the host-side
+dispatch spans — which is also exactly what the reference timeline measures
+for the negotiation phase.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+from .. import core
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_SHUTDOWN = object()
+
+
+class _Writer:
+    """Background writer thread (analog of TimelineWriter::WriterLoop,
+    reference timeline.cc)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._closed = threading.Event()
+        self._thread.start()
+
+    def put(self, ev: dict) -> None:
+        if not self._closed.is_set():
+            self.q.put(ev)
+
+    def close(self) -> None:
+        if not self._closed.is_set():
+            self.q.put(_SHUTDOWN)
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(self.path, "w") as f:
+            f.write("[\n")
+            first = True
+            while True:
+                item = self.q.get()
+                if item is _SHUTDOWN:
+                    break
+                if not first:
+                    f.write(",\n")
+                json.dump(item, f)
+                first = False
+                f.flush()
+            f.write("\n]\n")
+        self._closed.set()
+
+
+class Timeline:
+    """Process-wide timeline recorder; one writer per controller process,
+    pid field = rank so merged traces line up per-rank."""
+
+    def __init__(self) -> None:
+        self._writer: Optional[_Writer] = None
+        self._lock = threading.Lock()
+        self._step = 0
+        self._start_step = env_util.get_int(env_util.HVD_TRACE_START_STEP, 0)
+        self._end_step = env_util.get_int(env_util.HVD_TRACE_END_STEP, 1 << 62)
+        self._mark_cycles = env_util.get_bool(env_util.HVD_TIMELINE_MARK_CYCLES)
+        self._origin = time.perf_counter()
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, directory: Optional[str] = None) -> None:
+        """Open ``<dir>/<rank>/comm.json`` (reference timeline.cc:205-228)."""
+        directory = directory or env_util.get_str(env_util.HVD_TIMELINE) or \
+            env_util.get_str(env_util.HVD_TRACE_DIR)
+        if not directory:
+            return
+        rank = core.process_rank() if core.is_initialized() else 0
+        path = os.path.join(directory, str(rank), "comm.json")
+        with self._lock:
+            if self._writer is None:
+                self._writer = _Writer(path)
+                log.debug("timeline → %s", path)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._writer is not None and self._in_window()
+
+    def _in_window(self) -> bool:
+        return self._start_step <= self._step <= self._end_step
+
+    # -- step windowing (fork: BYTEPS_TRACE_*_STEP) -------------------------
+    def record_step(self) -> int:
+        """Advance the step counter; auto-finalize at the end step
+        (reference timeline.cc:101-144)."""
+        self._step += 1
+        if self._step > self._end_step:
+            self.shutdown()
+        return self._step
+
+    # -- events -------------------------------------------------------------
+    def _ts_us(self) -> float:
+        return (time.perf_counter() - self._origin) * 1e6
+
+    def _emit(self, ev: dict) -> None:
+        w = self._writer
+        if w is not None:
+            w.put(ev)
+
+    @contextlib.contextmanager
+    def span(self, tensor_name: str, activity: str, rank: Optional[int] = None):
+        """A complete ('X') event named by tensor with the activity as
+        category — the nested-activity form of the reference's
+        ActivityStart/ActivityEnd."""
+        if not self.enabled:
+            yield
+            return
+        t0 = self._ts_us()
+        try:
+            yield
+        finally:
+            self._emit({
+                "name": activity,
+                "cat": tensor_name,
+                "ph": "X",
+                "ts": t0,
+                "dur": self._ts_us() - t0,
+                "pid": rank if rank is not None else (
+                    core.process_rank() if core.is_initialized() else 0),
+                "tid": tensor_name,
+            })
+
+    def negotiate_start(self, tensor_name: str, op: str) -> None:
+        """NEGOTIATE_<OP> begin (reference timeline.cc NegotiateStart)."""
+        if self.enabled:
+            self._emit({"name": f"NEGOTIATE_{op}", "cat": tensor_name,
+                        "ph": "B", "ts": self._ts_us(),
+                        "pid": core.process_rank() if core.is_initialized() else 0,
+                        "tid": tensor_name})
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
+        """Per-rank readiness X event (fork NegotiateSubEvent "Sync",
+        reference timeline.cc:250-259, used controller.cc:656-661)."""
+        if self.enabled:
+            self._emit({"name": f"{rank}", "cat": tensor_name, "ph": "X",
+                        "ts": self._ts_us(), "dur": 1,
+                        "pid": core.process_rank() if core.is_initialized() else 0,
+                        "tid": tensor_name})
+
+    def negotiate_end(self, tensor_name: str, op: str) -> None:
+        if self.enabled:
+            self._emit({"name": f"NEGOTIATE_{op}", "cat": tensor_name,
+                        "ph": "E", "ts": self._ts_us(),
+                        "pid": core.process_rank() if core.is_initialized() else 0,
+                        "tid": tensor_name})
+
+    def mark_cycle_start(self) -> None:
+        """CYCLE_START instant (reference timeline.cc:377-384, gated by
+        HOROVOD_TIMELINE_MARK_CYCLES)."""
+        if self.enabled and self._mark_cycles:
+            self._emit({"name": "CYCLE_START", "ph": "i", "s": "g",
+                        "ts": self._ts_us(),
+                        "pid": core.process_rank() if core.is_initialized() else 0,
+                        "tid": "cycle"})
+
+
+#: process-wide singleton, auto-enabled when HVD_TIMELINE is set at init
+timeline = Timeline()

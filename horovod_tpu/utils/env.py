@@ -1,0 +1,103 @@
+"""Environment-variable knob inventory and parsing.
+
+TPU-native analog of the ``HOROVOD_*`` env system (reference inventory at
+horovod/common/common.h:62-87, parsing in horovod/common/operations.cc:392-492
+and horovod/common/utils/env_parser.cc:41-106).  Same three-layer contract:
+(1) ``HVD_*`` env vars consumed by the runtime, (2) ``tpurun`` CLI flags that
+set them for workers (horovod_tpu/run/config_parser.py), (3) optional YAML
+config file overriding CLI.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# -- knob names (HOROVOD_* → HVD_*) ------------------------------------------
+HVD_FUSION_THRESHOLD = "HVD_FUSION_THRESHOLD"          # bytes; HOROVOD_FUSION_THRESHOLD
+HVD_CYCLE_TIME = "HVD_CYCLE_TIME"                      # ms; HOROVOD_CYCLE_TIME
+HVD_TIMELINE = "HVD_TIMELINE"                          # trace output dir
+HVD_TIMELINE_MARK_CYCLES = "HVD_TIMELINE_MARK_CYCLES"
+HVD_TRACE_START_STEP = "HVD_TRACE_START_STEP"          # fork: BYTEPS_TRACE_START_STEP
+HVD_TRACE_END_STEP = "HVD_TRACE_END_STEP"              # fork: BYTEPS_TRACE_END_STEP
+HVD_TRACE_ON = "HVD_TRACE_ON"                          # fork: BYTEPS_TRACE_ON
+HVD_TRACE_DIR = "HVD_TRACE_DIR"                        # fork: BYTEPS_TRACE_DIR
+HVD_STALL_CHECK_DISABLE = "HVD_STALL_CHECK_DISABLE"
+HVD_STALL_CHECK_TIME_SECONDS = "HVD_STALL_CHECK_TIME_SECONDS"
+HVD_STALL_SHUTDOWN_TIME_SECONDS = "HVD_STALL_SHUTDOWN_TIME_SECONDS"
+HVD_AUTOTUNE = "HVD_AUTOTUNE"
+HVD_AUTOTUNE_LOG = "HVD_AUTOTUNE_LOG"
+HVD_AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
+HVD_AUTOTUNE_STEPS_PER_SAMPLE = "HVD_AUTOTUNE_STEPS_PER_SAMPLE"
+HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HVD_LOG_LEVEL = "HVD_LOG_LEVEL"
+HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
+HVD_HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
+HVD_HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
+HVD_CACHE_CAPACITY = "HVD_CACHE_CAPACITY"
+HVD_BATCH_D2D_MEMCOPIES = "HVD_BATCH_D2D_MEMCOPIES"
+HVD_NUM_NCCL_STREAMS = "HVD_NUM_NCCL_STREAMS"          # parity stub
+# launcher-set topology vars (analog of HOROVOD_RANK/SIZE/LOCAL_RANK/... set
+# by gloo_run, reference run/gloo_run.py:210-216)
+HVD_RANK = "HVD_RANK"
+HVD_SIZE = "HVD_SIZE"
+HVD_LOCAL_RANK = "HVD_LOCAL_RANK"
+HVD_LOCAL_SIZE = "HVD_LOCAL_SIZE"
+HVD_CROSS_RANK = "HVD_CROSS_RANK"
+HVD_CROSS_SIZE = "HVD_CROSS_SIZE"
+HVD_COORDINATOR_ADDR = "HVD_COORDINATOR_ADDR"
+HVD_NUM_PROCESSES = "HVD_NUM_PROCESSES"
+HVD_PROCESS_ID = "HVD_PROCESS_ID"
+HVD_CONTROLLER = "HVD_CONTROLLER"
+HVD_CPU_OPERATIONS = "HVD_CPU_OPERATIONS"
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
+DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
+FUSION_BUFFER_ATOMIC_UNIT = 64                     # reference common.h:94
+DEFAULT_STALL_WARNING_SECONDS = 60.0               # reference stall_inspector.h:72
+
+
+def get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+def fusion_threshold_bytes() -> int:
+    n = get_int(HVD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES)
+    # Round to the atomic unit so fused buffers stay divisible for
+    # scatter-style ops (reference controller.cc:357-375).
+    if n % FUSION_BUFFER_ATOMIC_UNIT:
+        n = (n // FUSION_BUFFER_ATOMIC_UNIT + 1) * FUSION_BUFFER_ATOMIC_UNIT
+    return n
+
+
+def cycle_time_ms() -> float:
+    return get_float(HVD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS)

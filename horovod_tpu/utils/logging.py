@@ -1,0 +1,55 @@
+"""Leveled logging configured from HVD_LOG_LEVEL / HVD_LOG_HIDE_TIME.
+
+Analog of the LOG(level, rank) macro system (reference
+horovod/common/logging.cc:39-70: levels trace/debug/info/warning/error/fatal
+parsed by ParseLogLevelStr, time prefix suppressed by
+HOROVOD_LOG_HIDE_TIME).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG - 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+    "off": logging.CRITICAL + 10,
+}
+
+logging.addLevelName(_LEVELS["trace"], "TRACE")
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    level_str = os.environ.get("HVD_LOG_LEVEL", "warning").strip().lower()
+    level = _LEVELS.get(level_str, logging.WARNING)
+    hide_time = os.environ.get("HVD_LOG_HIDE_TIME", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+    fmt = "[%(levelname)s] hvd: %(message)s" if hide_time else (
+        "%(asctime)s [%(levelname)s] hvd: %(message)s"
+    )
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(fmt))
+    root = logging.getLogger("horovod_tpu")
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure()
+    if not name.startswith("horovod_tpu"):
+        name = "horovod_tpu." + name
+    return logging.getLogger(name)
