@@ -145,6 +145,7 @@ def grouped_allreduce(
     op: str = Average,
     compression=Compression.none,
     process_set: Optional[ProcessSet] = None,
+    threshold_bytes: Optional[int] = None,
 ):
     """Allreduce a list of tensors as one fused operation.
 
@@ -157,7 +158,8 @@ def grouped_allreduce(
     from .fusion import fused_allreduce
 
     return fused_allreduce(
-        list(tensors), op=op, compression=compression, process_set=process_set
+        list(tensors), op=op, compression=compression,
+        process_set=process_set, threshold_bytes=threshold_bytes,
     )
 
 

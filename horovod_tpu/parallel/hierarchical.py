@@ -1,0 +1,114 @@
+"""Hierarchical (two-level) collectives: local stage + cross stage.
+
+Re-design of NCCLHierarchicalAllreduce (reference
+horovod/common/ops/nccl_operations.cc:171-372: NCCL ReduceScatter inside the
+node → per-local-rank parallel cross-node MPI_Allreduce on a host buffer →
+NCCL Allgather back, remainder handled via NCCL Reduce/Bcast) and
+MPIHierarchicalAllgather (mpi_operations.cc), built on the LOCAL/CROSS
+communicator split (common.h:110-114).
+
+TPU mapping: "local" = devices connected by ICI within a slice, "cross" =
+slices connected by DCN.  The same reduce_scatter → cross-allreduce →
+all_gather decomposition applies, with ``axis_index_groups`` on the flat
+mesh (so it composes with the 1-D rank model) — each cross-stage psum moves
+1/local_size of the data, and the local stages ride ICI.
+
+Enabled per-call or via ``HVD_HIERARCHICAL_ALLREDUCE=1`` (reference knob
+HOROVOD_HIERARCHICAL_ALLREDUCE, common.h:72; autotuned by
+parameter_manager.cc — ours is a candidate knob in optim/autotune.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import core
+from ..core import Average, Sum
+from ..utils import env as env_util
+
+
+def _local_groups() -> list:
+    ls = core.local_size()
+    return [list(range(n * ls, (n + 1) * ls)) for n in range(core.cross_size())]
+
+
+def _cross_groups_for_chunk() -> list:
+    ls = core.local_size()
+    return [
+        [n * ls + r for n in range(core.cross_size())] for r in range(ls)
+    ]
+
+
+def hierarchical_allreduce(tensor, *, op: str = Average):
+    """Two-level allreduce on the flat 1-D mesh.
+
+    reduce_scatter over the local group (ICI) → psum over the cross group
+    (DCN) on the 1/local_size shard → all_gather over the local group —
+    exactly the reference's three phases (nccl_operations.cc:241-287), but
+    the "host buffer" hop disappears: the cross psum runs device-to-device.
+    """
+    axes = core._spmd_axes()
+    if axes is None or len(axes) != 1:
+        raise RuntimeError(
+            "hierarchical_allreduce runs on the flat mesh inside hvd.spmd"
+        )
+    axis = axes[0]
+    ls = core.local_size()
+    if ls == 1 or core.cross_size() == 1:
+        out = lax.psum(tensor, axis)
+        return out / core.size() if op == Average else out
+
+    orig_shape = tensor.shape
+    flat = tensor.reshape(-1)
+    # Pad to a multiple of local_size so the scatter is even — the analog of
+    # the fusion-threshold divisibility rounding (reference
+    # controller.cc:357-375).
+    n = flat.shape[0]
+    pad = (-n) % ls
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+
+    shard = lax.psum_scatter(
+        flat, axis, scatter_dimension=0, tiled=True,
+        axis_index_groups=_local_groups(),
+    )
+    shard = lax.psum(shard, axis, axis_index_groups=_cross_groups_for_chunk())
+    full = lax.all_gather(
+        shard, axis, axis=0, tiled=True, axis_index_groups=_local_groups()
+    )
+    if pad:
+        full = full[:n]
+    out = full.reshape(orig_shape)
+    if op == Average:
+        out = out / core.size()
+    return out
+
+
+def hierarchical_allgather(tensor):
+    """Two-level allgather: gather inside the local group, then exchange the
+    node blocks across (reference MPIHierarchicalAllgather,
+    mpi_operations.cc — node-leader gather through an MPI shared-memory
+    window + cross allgather; here both stages are XLA all_gathers)."""
+    axes = core._spmd_axes()
+    if axes is None or len(axes) != 1:
+        raise RuntimeError(
+            "hierarchical_allgather runs on the flat mesh inside hvd.spmd"
+        )
+    axis = axes[0]
+    local = lax.all_gather(
+        tensor, axis, axis=0, tiled=True, axis_index_groups=_local_groups()
+    )
+    # Every local rank now holds the node block; one cross-group allgather
+    # (per local rank, in parallel) assembles the global concatenation.
+    out = lax.all_gather(
+        local, axis, axis=0, tiled=True,
+        axis_index_groups=_cross_groups_for_chunk(),
+    )
+    return out
+
+
+def use_hierarchical_default() -> bool:
+    return env_util.get_bool(env_util.HVD_HIERARCHICAL_ALLREDUCE, False)

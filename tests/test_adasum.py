@@ -1,0 +1,73 @@
+"""Adasum numerics vs the NumPy reference implementation — modeled on
+reference test/test_adasum_pytorch.py / test_adasum_tensorflow.py (compare
+device results against a NumPy adaptive-sum checker)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.adasum import numpy_adasum, numpy_adasum_pair
+
+
+def test_numpy_pair_orthogonal_sums():
+    a = np.array([1.0, 0.0], np.float64)
+    b = np.array([0.0, 1.0], np.float64)
+    np.testing.assert_allclose(numpy_adasum_pair(a, b), [1.0, 1.0])
+
+
+def test_numpy_pair_parallel_averages():
+    a = np.array([2.0, 4.0])
+    np.testing.assert_allclose(numpy_adasum_pair(a, a), a)
+
+
+@pytest.mark.parametrize("dim", [1, 2])
+def test_adasum_allreduce_matches_numpy(hvd_init, rng, dim):
+    shape = (64,) if dim == 1 else (8, 8)
+    xs = [rng.normal(size=shape).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Adasum)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    expected = numpy_adasum(xs)
+    for o in out:
+        np.testing.assert_allclose(o, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_adasum_all_ranks_agree(hvd_init, rng):
+    xs = [rng.normal(size=(32,)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Adasum)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    for o in out[1:]:
+        np.testing.assert_allclose(o, out[0], rtol=1e-6)
+
+
+def test_adasum_identical_inputs_is_identity(hvd_init, rng):
+    # Adasum of n identical vectors = the vector itself (scale invariance).
+    v = rng.normal(size=(16,)).astype(np.float32)
+    xs = [v.copy() for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Adasum)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    np.testing.assert_allclose(out[0], v, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_zero_rank_contributes_as_sum(hvd_init, rng):
+    xs = [np.zeros((8,), np.float32) for _ in range(8)]
+    xs[3] = rng.normal(size=(8,)).astype(np.float32)
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Adasum)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    np.testing.assert_allclose(out[0], xs[3], rtol=1e-4, atol=1e-5)

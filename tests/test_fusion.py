@@ -1,0 +1,107 @@
+"""Tensor-fusion planner and fused allreduce — analog of the reference's
+fusion stress test (test_torch.py:237 test_horovod_allreduce_async_fused)
+plus unit tests for the bucketing math (controller.cc:665 FuseResponses)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.fusion import FusionPlan, allreduce_pytree
+
+
+class _FakeLeaf:
+    def __init__(self, size, dtype):
+        self.size = size
+        self.dtype = dtype
+
+
+def test_fusion_plan_groups_by_dtype():
+    leaves = [
+        jnp.zeros((10,), jnp.float32),
+        jnp.zeros((10,), jnp.bfloat16),
+        jnp.zeros((10,), jnp.float32),
+    ]
+    plan = FusionPlan(leaves, threshold_bytes=1 << 20)
+    assert plan.num_buckets() == 2  # f32 pair fused, bf16 alone
+
+
+def test_fusion_plan_respects_threshold():
+    leaves = [jnp.zeros((100,), jnp.float32) for _ in range(10)]  # 400 B each
+    plan = FusionPlan(leaves, threshold_bytes=1000)  # 2 leaves per bucket
+    assert plan.num_buckets() == 5
+    for b in plan.buckets:
+        assert len(b) == 2
+
+
+def test_fusion_plan_single_big_tensor_own_bucket():
+    leaves = [jnp.zeros((1000,), jnp.float32), jnp.zeros((4,), jnp.float32)]
+    plan = FusionPlan(leaves, threshold_bytes=64)
+    assert plan.num_buckets() == 2
+
+
+def test_fused_matches_unfused(hvd_init, rng):
+    shapes = [(7,), (3, 5), (2, 2, 2), (11,), (1,)]
+    xs = [[rng.normal(size=s).astype(np.float32) for s in shapes]
+          for _ in range(8)]
+    stacked = [np.stack([xs[r][i] for r in range(8)]) for i in range(len(shapes))]
+
+    def make(threshold):
+        @hvd.spmd(in_specs=(P(hvd.AXIS),) * len(shapes),
+                  out_specs=(P(hvd.AXIS),) * len(shapes))
+        def step(*args):
+            outs = hvd.grouped_allreduce(
+                [a[0] for a in args], op=hvd.Average,
+                threshold_bytes=threshold,
+            )
+            return tuple(o[None] for o in outs)
+        return step
+
+    # tiny threshold → one bucket per tensor; huge → all fused
+    out_small = make(1)(*stacked)
+    out_big = make(1 << 30)(*stacked)
+    for i in range(len(shapes)):
+        expected = np.mean(stacked[i], axis=0)
+        np.testing.assert_allclose(
+            hvd.get_per_rank(out_small[i])[0], expected, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            hvd.get_per_rank(out_big[i])[0], expected, rtol=1e-5
+        )
+
+
+def test_allreduce_pytree(hvd_init, rng):
+    tree = {
+        "w": rng.normal(size=(4, 4)).astype(np.float32),
+        "b": rng.normal(size=(4,)).astype(np.float32),
+        "nested": {"x": rng.normal(size=(2,)).astype(np.float32)},
+    }
+    # every rank gets tree scaled by (rank+1)
+    import jax
+
+    stacked = jax.tree_util.tree_map(
+        lambda leaf: np.stack([leaf * (r + 1) for r in range(8)]), tree
+    )
+
+    @hvd.spmd(in_specs=P(hvd.AXIS), out_specs=P(hvd.AXIS))
+    def step(t):
+        per_rank = jax.tree_util.tree_map(lambda a: a[0], t)
+        out = hvd.allreduce_gradients(per_rank, op=hvd.Average)
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    out = step(stacked)
+    scale = np.mean([r + 1 for r in range(8)])
+    for key in ("w", "b"):
+        got = np.asarray(jax.device_get(out[key]))[0]
+        np.testing.assert_allclose(got, tree[key] * scale, rtol=1e-5)
+
+
+def test_fusion_env_threshold(monkeypatch):
+    from horovod_tpu.utils import env as env_util
+
+    monkeypatch.setenv(env_util.HVD_FUSION_THRESHOLD, "1000")
+    # rounded up to the 64-byte atomic unit (reference common.h:94)
+    assert env_util.fusion_threshold_bytes() == 1024
+    monkeypatch.setenv(env_util.HVD_FUSION_THRESHOLD, "1024")
+    assert env_util.fusion_threshold_bytes() == 1024

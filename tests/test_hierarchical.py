@@ -1,0 +1,41 @@
+"""Hierarchical allreduce/allgather vs flat results — analog of the
+reference's hierarchical paths (NCCLHierarchicalAllreduce
+nccl_operations.cc:171-372, MPIHierarchicalAllgather)."""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel.hierarchical import (
+    hierarchical_allreduce,
+    hierarchical_allgather,
+)
+
+
+@pytest.mark.parametrize("shape", [(8,), (7,), (3, 5), (1,)])
+@pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+def test_hierarchical_allreduce_matches_flat(hvd_init, rng, shape, op):
+    xs = [rng.normal(size=shape).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hierarchical_allreduce(x[0], op=op)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    expected = np.sum(np.stack(xs), axis=0)
+    if op == hvd.Average:
+        expected = expected / 8
+    for o in out:
+        np.testing.assert_allclose(o, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_hierarchical_allgather_matches_flat(hvd_init, rng):
+    xs = [rng.normal(size=(2, 3)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd(out_specs=P())
+    def step(x):
+        return hierarchical_allgather(x[0])
+
+    out = np.asarray(step(np.stack(xs)))
+    np.testing.assert_allclose(out, np.concatenate(xs, axis=0), rtol=1e-6)

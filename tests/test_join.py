@@ -1,0 +1,64 @@
+"""Join semantics: joined ranks contribute zeros, Average divides by the
+active count (reference controller.cc:253-264 join bookkeeping,
+collective_operations.cc:217-225 zero fill, test_torch.py join tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic.join import join_allreduce, join_count
+
+
+def test_join_allreduce_average(hvd_init, rng):
+    xs = np.stack([np.full((3,), float(r + 1), np.float32) for r in range(8)])
+    # ranks 6,7 have joined (exhausted data)
+    active = np.array([1, 1, 1, 1, 1, 1, 0, 0], np.bool_)
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS), P(hvd.AXIS)), out_specs=P(hvd.AXIS))
+    def step(x, a):
+        return join_allreduce(x[0], a[0], op=hvd.Average)[None]
+
+    out = hvd.get_per_rank(step(xs, active))
+    expected = np.mean([r + 1 for r in range(6)])
+    for o in out:
+        np.testing.assert_allclose(o, np.full((3,), expected), rtol=1e-6)
+
+
+def test_join_allreduce_sum(hvd_init):
+    xs = np.stack([np.full((2,), 1.0, np.float32) for _ in range(8)])
+    active = np.array([1, 0, 1, 0, 1, 0, 1, 0], np.bool_)
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS), P(hvd.AXIS)), out_specs=P(hvd.AXIS))
+    def step(x, a):
+        return join_allreduce(x[0], a[0], op=hvd.Sum)[None]
+
+    out = hvd.get_per_rank(step(xs, active))
+    np.testing.assert_allclose(out[0], np.full((2,), 4.0))
+
+
+def test_join_count(hvd_init):
+    active = np.array([1, 1, 1, 0, 0, 0, 0, 0], np.bool_)
+
+    @hvd.spmd(in_specs=P(hvd.AXIS), out_specs=P(hvd.AXIS))
+    def step(a):
+        return join_count(a[0])[None]
+
+    out = hvd.get_per_rank(step(active))
+    assert all(int(o) == 3 for o in out)
+
+
+def test_all_joined_no_divide_by_zero(hvd_init):
+    xs = np.stack([np.full((2,), 5.0, np.float32) for _ in range(8)])
+    active = np.zeros((8,), np.bool_)
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS), P(hvd.AXIS)), out_specs=P(hvd.AXIS))
+    def step(x, a):
+        return join_allreduce(x[0], a[0], op=hvd.Average)[None]
+
+    out = hvd.get_per_rank(step(xs, active))
+    np.testing.assert_allclose(out[0], np.zeros((2,)))
+
+
+def test_host_join_single_process(hvd_init):
+    assert hvd.join() == 0

@@ -1,0 +1,74 @@
+"""Timeline content checks — analog of reference test/test_timeline.py:39-56
+(run with the timeline enabled, then grep the JSON for expected spans), plus
+the fork's per-rank layout and step windowing (timeline.cc:101-144,205-228)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.timeline.timeline import Timeline
+
+
+def _read(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_timeline_per_rank_layout_and_spans(hvd_init, tmp_path, rng):
+    tl = Timeline()
+    tl.initialize(str(tmp_path))
+    with tl.span("allreduce.grad0", "ALLREDUCE"):
+        pass
+    tl.negotiate_start("allreduce.grad0", "ALLREDUCE")
+    tl.negotiate_rank_ready("allreduce.grad0", 3)
+    tl.negotiate_end("allreduce.grad0", "ALLREDUCE")
+    tl.shutdown()
+
+    path = tmp_path / "0" / "comm.json"
+    assert path.exists(), "per-rank dir layout <dir>/<rank>/comm.json"
+    events = _read(path)
+    names = [e["name"] for e in events]
+    assert "ALLREDUCE" in names
+    assert "NEGOTIATE_ALLREDUCE" in names
+    cats = {e.get("cat") for e in events}
+    assert "allreduce.grad0" in cats
+
+
+def test_timeline_step_window(hvd_init, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TRACE_START_STEP", "2")
+    monkeypatch.setenv("HVD_TRACE_END_STEP", "3")
+    tl = Timeline()
+    tl.initialize(str(tmp_path))
+
+    for step in range(1, 6):
+        tl.record_step()
+        with tl.span(f"step{step}", "ALLREDUCE"):
+            pass
+
+    tl.shutdown()
+    events = _read(tmp_path / "0" / "comm.json")
+    cats = {e.get("cat") for e in events}
+    assert "step2" in cats and "step3" in cats
+    assert "step1" not in cats and "step4" not in cats and "step5" not in cats
+
+
+def test_timeline_disabled_without_dir(hvd_init):
+    tl = Timeline()
+    tl.initialize(None)
+    assert not tl.enabled
+    with tl.span("x", "ALLREDUCE"):
+        pass  # no-op, no crash
+
+
+def test_eager_ops_emit_timeline(hvd_init, tmp_path, rng):
+    from horovod_tpu.timeline.timeline import timeline as tl
+
+    tl.initialize(str(tmp_path))
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(8)]
+    hvd.eager_allreduce(xs, name="allreduce.loss")
+    tl.shutdown()
+    events = _read(tmp_path / "0" / "comm.json")
+    assert any(e.get("cat") == "allreduce.loss" for e in events)
