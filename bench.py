@@ -1,0 +1,41 @@
+"""Driver benchmark: ResNet-50 synthetic throughput on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published sample throughput for its benchmark
+methodology is 1656.82 images/sec on 16 Pascal GPUs (ResNet-101, batch 64,
+reference docs/benchmarks.rst:27-41) ≈ 103.55 img/sec/GPU; the in-repo
+synthetic benchmark's default model is ResNet-50 (reference
+examples/tensorflow2_synthetic_benchmark.py:32-35).  vs_baseline =
+our img/sec/chip ÷ 103.55.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:27-41
+
+
+def main() -> None:
+    from examples.synthetic_benchmark import parse_args, run
+
+    args = parse_args([
+        "--batch-size", "64",
+        "--num-warmup-batches", "3",
+        "--num-batches-per-iter", "5",
+        "--num-iters", "3",
+    ])
+    result = run(args)
+    per_chip = result["img_sec_per_chip"]
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

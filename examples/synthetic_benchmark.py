@@ -1,0 +1,141 @@
+"""Synthetic benchmark — the framework's headline benchmark harness.
+
+Feature-for-feature port of the reference harness CLI (reference
+examples/tensorflow2_synthetic_benchmark.py: --model/--batch-size/
+--fp16-allreduce/--num-warmup-batches/--num-batches-per-iter/--num-iters),
+re-done TPU-native: the model is flax ResNet, the step is a compiled SPMD
+program over the mesh, gradients ride fused psum over ICI.
+
+Run:  python examples/synthetic_benchmark.py --batch-size 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import MODELS
+from horovod_tpu.training import (
+    TrainState, init_train_state, make_train_step, shard_batch,
+)
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="horovod_tpu Synthetic Benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("--fp16-allreduce", action="store_true", default=False,
+                        help="use bf16 compression during allreduce")
+    parser.add_argument("--model", type=str, default="ResNet50",
+                        help="model to benchmark")
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="input batch size per rank")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-warmup-batches", type=int, default=10,
+                        help="number of warm-up batches not benchmarked")
+    parser.add_argument("--num-batches-per-iter", type=int, default=10,
+                        help="number of batches per benchmark iteration")
+    parser.add_argument("--num-iters", type=int, default=10,
+                        help="number of benchmark iterations")
+    parser.add_argument("--adasum", action="store_true", default=False,
+                        help="use Adasum reduction")
+    parser.add_argument("--hierarchical", action="store_true", default=False,
+                        help="use two-level (ICI/DCN-style) allreduce")
+    parser.add_argument("--platform", type=str, default=None,
+                        help="jax platform override (tpu/cpu)")
+    return parser.parse_args(argv)
+
+
+def log(s, nl=True):
+    if hvd.rank() != 0:
+        return
+    print(s, end="\n" if nl else "", flush=True)
+
+
+def run(args) -> dict:
+    hvd.init(platform=args.platform)
+
+    model = MODELS[args.model](num_classes=args.num_classes)
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    global_batch = args.batch_size * hvd.size()
+    rng = np.random.default_rng(42)
+    data = rng.uniform(
+        size=(global_batch, args.image_size, args.image_size, 3)
+    ).astype(np.float32)
+    target = rng.integers(0, args.num_classes, size=(global_batch,)).astype(
+        np.int32
+    )
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    step = make_train_step(
+        apply_fn=model.apply,
+        loss_fn=loss_fn,
+        optimizer=opt,
+        op=hvd.Adasum if args.adasum else hvd.Average,
+        compression=hvd.Compression.fp16 if args.fp16_allreduce
+        else hvd.Compression.none,
+        has_batch_stats=True,
+        hierarchical=args.hierarchical,
+    )
+
+    state = init_train_state(
+        model, opt, jnp.zeros((2, args.image_size, args.image_size, 3)),
+        has_batch_stats=True,
+    )
+    x = shard_batch(data)
+    y = shard_batch(target)
+
+    log(f"Model: {args.model}")
+    log(f"Batch size: {args.batch_size} (global {global_batch})")
+    log(f"Number of chips: {hvd.size()}")
+
+    log("Running warmup...")
+    for _ in range(max(args.num_warmup_batches, 1)):
+        state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+
+    log("Running benchmark...")
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            state, loss = step(state, x, y)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter * hvd.size() / dt
+        log(f"Iter: Img/sec total: {img_sec:.1f}")
+        img_secs.append(img_sec)
+
+    img_sec_mean = float(np.mean(img_secs))
+    img_sec_conf = float(1.96 * np.std(img_secs))
+    log(f"Img/sec per chip: {img_sec_mean / hvd.size():.1f}")
+    log(f"Total img/sec on {hvd.size()} chip(s): "
+        f"{img_sec_mean:.1f} +-{img_sec_conf:.1f}")
+    return {
+        "img_sec_total": img_sec_mean,
+        "img_sec_per_chip": img_sec_mean / hvd.size(),
+        "conf": img_sec_conf,
+        "size": hvd.size(),
+        "final_loss": float(np.asarray(jax.device_get(loss))),
+    }
+
+
+if __name__ == "__main__":
+    run(parse_args())

@@ -1,0 +1,123 @@
+"""BERT-style Transformer encoder (flax/linen), TPU-first.
+
+Present because the driver's benchmark configs include "Adasum allreduce on
+BERT-base" (BASELINE.json) and the fork's sweep scripts profile BERT
+(reference examples/test_bert.sh) — the reference itself ships no model
+code.  bf16 compute / f32 params; attention as einsums that map straight
+onto the MXU; optional sequence parallelism via
+horovod_tpu.parallel.ring_attention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    # Optional override for the core attention computation, signature
+    # (q, k, v, mask) -> out.  parallel/ring_attention.py plugs in here for
+    # sequence-parallel execution.
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d = x.shape[-1]
+        assert d % self.num_heads == 0
+        head_dim = d // self.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            (self.num_heads, head_dim), dtype=self.dtype,
+            param_dtype=self.param_dtype, name=name, axis=-1,
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, mask)
+        else:
+            scale = 1.0 / np.sqrt(head_dim)
+            logits = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+            if mask is not None:
+                logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+            probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+                self.dtype
+            )
+            out = jnp.einsum("...hqk,...khd->...qhd", probs, v)
+        return nn.DenseGeneral(
+            d, axis=(-2, -1), dtype=self.dtype, param_dtype=self.param_dtype,
+            name="out",
+        )(out)
+
+
+class EncoderLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        h = SelfAttention(
+            self.num_heads, dtype=self.dtype, param_dtype=self.param_dtype,
+            attention_fn=self.attention_fn,
+        )(h, mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     param_dtype=self.param_dtype)(h)
+        return x + h
+
+
+class BertEncoder(nn.Module):
+    """Pre-LN BERT-style encoder over token ids."""
+
+    vocab_size: int = 30522
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, ids, mask=None):
+        x = nn.Embed(self.vocab_size, self.hidden_dim,
+                     param_dtype=self.param_dtype, dtype=self.dtype)(ids)
+        pos = nn.Embed(self.max_len, self.hidden_dim,
+                       param_dtype=self.param_dtype, dtype=self.dtype)(
+            jnp.arange(ids.shape[-1])[None, :]
+        )
+        x = x + pos
+        for _ in range(self.num_layers):
+            x = EncoderLayer(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype, attention_fn=self.attention_fn,
+            )(x, mask)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def bert_base(**kw):
+    return BertEncoder(**kw)
+
+
+def bert_tiny(**kw):
+    """4-layer/128-dim variant for tests and CPU dry-runs."""
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden_dim", 128)
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 256)
+    kw.setdefault("max_len", 512)
+    return BertEncoder(**kw)

@@ -1,0 +1,47 @@
+"""Small MLP / convnet for MNIST-class examples and tests — the model behind
+the examples/mnist.py end-to-end slice (the reference's
+examples/tensorflow2_mnist.py uses an equivalent little convnet)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class ConvNet(nn.Module):
+    """The examples/tensorflow2_mnist.py-shaped convnet."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

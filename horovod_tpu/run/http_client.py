@@ -1,0 +1,54 @@
+"""Client for the rendezvous KV store (reference
+horovod/run/http/http_client.py: read_data_from_kvstore /
+put_data_into_kvstore)."""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from .http_server import SECRET_HEADER, sign
+
+
+def _request(method: str, addr: str, port: int, path: str,
+             body: bytes = b"", secret: Optional[bytes] = None,
+             timeout: float = 10.0):
+    url = f"http://{addr}:{port}{path}"
+    req = urllib.request.Request(url, data=body if method == "PUT" else None,
+                                 method=method)
+    if secret is not None:
+        req.add_header(SECRET_HEADER, sign(secret, path, body))
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def put_kv(addr: str, port: int, scope: str, key: str, value: bytes,
+           secret: Optional[bytes] = None) -> None:
+    with _request("PUT", addr, port, f"/{scope}/{key}", value, secret):
+        pass
+
+
+def get_kv(addr: str, port: int, scope: str, key: str,
+           secret: Optional[bytes] = None,
+           wait: bool = False, timeout: float = 60.0) -> Optional[bytes]:
+    """GET, optionally polling until the key appears (rendezvous wait)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            with _request("GET", addr, port, f"/{scope}/{key}",
+                          secret=secret) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404 and wait and time.monotonic() < deadline:
+                time.sleep(0.1)
+                continue
+            if e.code == 404:
+                return None
+            raise
+
+
+def delete_scope(addr: str, port: int, scope: str,
+                 secret: Optional[bytes] = None) -> None:
+    with _request("DELETE", addr, port, f"/{scope}", secret=secret):
+        pass

@@ -1,0 +1,142 @@
+"""HTTP key-value store + rendezvous server.
+
+Re-design of the launcher-side rendezvous service (reference
+horovod/run/http/http_server.py: ``KVStoreHandler`` with GET/PUT of
+scope/key → bytes at :33-102, ``RendezvousServer`` where DELETE finalizes;
+used by Gloo's HTTPStore from C++ during hvd.init, reference
+gloo/gloo_context.cc:56-76, and by func-mode result collection,
+run/run.py:813-832).
+
+Here the same server bootstraps multi-host jobs: workers publish their
+host/port and read the coordinator address before ``jax.distributed``
+takes over, and ``tpurun``'s function-mode ships pickled fns/results
+through it.  Requests carry an HMAC signature derived from the job secret
+(reference run/common/util/secret.py:26-30) — unauthenticated requests are
+rejected.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+SECRET_HEADER = "X-Hvd-Signature"
+
+
+def sign(secret: bytes, path: str, body: bytes = b"") -> str:
+    mac = hmac.new(secret, path.encode() + b"|" + body, hashlib.sha256)
+    return mac.hexdigest()
+
+
+class KVStoreHandler(BaseHTTPRequestHandler):
+    """GET /scope/key → 200 bytes | 404; PUT stores; DELETE /scope
+    finalizes the scope (rendezvous complete)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def _verify(self, body: bytes = b"") -> bool:
+        secret = self.server.secret  # type: ignore[attr-defined]
+        if secret is None:
+            return True
+        got = self.headers.get(SECRET_HEADER, "")
+        want = sign(secret, self.path, body)
+        return hmac.compare_digest(got, want)
+
+    def _reply(self, code: int, body: bytes = b"") -> None:
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802
+        if not self._verify():
+            self._reply(401)
+            return
+        store: Dict[str, bytes] = self.server.store  # type: ignore
+        with self.server.lock:  # type: ignore
+            val = store.get(self.path)
+        if val is None:
+            self._reply(404)
+        else:
+            self._reply(200, val)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._verify(body):
+            self._reply(401)
+            return
+        with self.server.lock:  # type: ignore
+            self.server.store[self.path] = body  # type: ignore
+        self._reply(200)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        if not self._verify():
+            self._reply(401)
+            return
+        prefix = self.path.rstrip("/") + "/"
+        with self.server.lock:  # type: ignore
+            store = self.server.store  # type: ignore
+            for k in [k for k in store if k.startswith(prefix) or k == self.path]:
+                del store[k]
+            self.server.finalized.add(self.path)  # type: ignore
+        self._reply(200)
+
+    def log_message(self, fmt, *args):  # silence default stderr spam
+        log.debug("kvstore: " + fmt, *args)
+
+
+class RendezvousServer:
+    """Threaded KV server owned by the launcher (reference
+    run/http/http_server.py RendezvousServer; started by gloo_run at
+    reference run/gloo_run.py:268-272)."""
+
+    def __init__(self, secret: Optional[bytes] = None, port: int = 0):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), KVStoreHandler)
+        self._httpd.store = {}  # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.secret = secret  # type: ignore[attr-defined]
+        self._httpd.finalized = set()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="hvd-rendezvous",
+        )
+        self._thread.start()
+        log.debug("rendezvous server on port %d", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # direct (in-process) access for the launcher itself
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.store.get(f"/{scope}/{key}")  # type: ignore
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store[f"/{scope}/{key}"] = value  # type: ignore
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,393 @@
+"""``tpurun`` — the launcher CLI.
+
+Re-design of ``horovodrun`` (reference horovod/run/run.py:395-615 arg
+groups, :696-740 host parsing, :839-861 _launch_job; gloo_run's per-slot
+env + ssh fan-out + output capture + failure kill at
+run/gloo_run.py:142-288) for TPU pods:
+
+* one worker **process per host** (each controller owns that host's chips —
+  the JAX multi-controller model), not one per slot;
+* rendezvous = the HTTP KV store (run/http_server.py) + ``jax.distributed``
+  (HVD_COORDINATOR_ADDR), replacing Gloo's HTTPStore/full-mesh bootstrap;
+* remote execution via ssh command lines (generated identically for
+  string-assertion tests, reference test/test_run.py:259-362 asserts the
+  mpirun command line with a mocked runner);
+* local hosts ("localhost"/"127.0.0.1") spawn subprocesses directly;
+* any worker exiting non-zero kills the whole job
+  (reference gloo_run.py:253-259); SIGINT/SIGTERM propagate.
+
+Also provides the in-process API ``horovod_tpu.run.run(fn, ...)``
+(reference run/run.py:870-956 func mode: cloudpickled fn shipped through
+the KV store, results collected back).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import secrets as _secrets
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+from . import config_parser
+from .hosts import HostInfo, SlotInfo, allocate_slots, parse_hostfile, parse_hosts
+from .http_server import RendezvousServer
+
+log = get_logger(__name__)
+
+LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        "tpurun", description="Launch a horovod_tpu training job",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("-v", "--version", action="store_true")
+    parser.add_argument("-np", "--num-proc", type=int, dest="np",
+                        help="total number of ranks (chips)")
+    parser.add_argument("-H", "--hosts", dest="hosts",
+                        help="host names and slot counts, e.g. h1:8,h2:8")
+    parser.add_argument("--hostfile", dest="hostfile",
+                        help="hostfile with lines 'host slots=N'")
+    parser.add_argument("--output-filename", dest="output_filename",
+                        help="per-rank stdout/stderr capture directory")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--config-file", dest="config_file",
+                        help="YAML config overriding CLI defaults")
+    parser.add_argument("--start-timeout", type=int, default=600)
+    parser.add_argument("--ssh-port", type=int, dest="ssh_port")
+    parser.add_argument("--disable-cache", action="store_true")
+
+    group_params = parser.add_argument_group("tuneable parameter arguments")
+    group_params.add_argument("--fusion-threshold-mb", type=float,
+                              dest="fusion_threshold_mb")
+    group_params.add_argument("--cycle-time-ms", type=float,
+                              dest="cycle_time_ms")
+    group_params.add_argument("--cache-capacity", type=int,
+                              dest="cache_capacity")
+    group_params.add_argument("--hierarchical-allreduce", action="store_true",
+                              dest="hierarchical_allreduce")
+    group_params.add_argument("--hierarchical-allgather", action="store_true",
+                              dest="hierarchical_allgather")
+
+    group_at = parser.add_argument_group("autotune arguments")
+    group_at.add_argument("--autotune", action="store_true")
+    group_at.add_argument("--autotune-log-file", dest="autotune_log_file")
+    group_at.add_argument("--autotune-warmup-samples", type=int,
+                          dest="autotune_warmup_samples")
+    group_at.add_argument("--autotune-steps-per-sample", type=int,
+                          dest="autotune_steps_per_sample")
+    group_at.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                          dest="autotune_bayes_opt_max_samples")
+    group_at.add_argument("--autotune-gaussian-process-noise", type=float,
+                          dest="autotune_gaussian_process_noise")
+
+    group_tl = parser.add_argument_group("timeline arguments")
+    group_tl.add_argument("--timeline-filename", dest="timeline_filename")
+    group_tl.add_argument("--timeline-mark-cycles", action="store_true",
+                          dest="timeline_mark_cycles")
+    group_tl.add_argument("--trace-start-step", type=int,
+                          dest="trace_start_step")
+    group_tl.add_argument("--trace-end-step", type=int, dest="trace_end_step")
+
+    group_st = parser.add_argument_group("stall check arguments")
+    group_st.add_argument("--no-stall-check", action="store_true",
+                          dest="no_stall_check")
+    group_st.add_argument("--stall-check-warning-time-seconds", type=int,
+                          dest="stall_check_warning_time_seconds")
+    group_st.add_argument("--stall-check-shutdown-time-seconds", type=int,
+                          dest="stall_check_shutdown_time_seconds")
+
+    group_log = parser.add_argument_group("logging arguments")
+    group_log.add_argument("--log-level", dest="log_level",
+                           choices=["trace", "debug", "info", "warning",
+                                    "error", "fatal"])
+    group_log.add_argument("--log-hide-timestamp", action="store_true",
+                           dest="log_hide_timestamp")
+
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training command")
+
+    args = parser.parse_args(argv)
+
+    if args.config_file:
+        import yaml
+
+        with open(args.config_file) as f:
+            cfg = yaml.safe_load(f) or {}
+        explicit = _explicit_dests(argv if argv is not None else sys.argv[1:],
+                                   parser)
+        config_parser.set_args_from_config(args, cfg, explicit)
+    return args
+
+
+def _explicit_dests(argv: List[str], parser: argparse.ArgumentParser) -> set:
+    """Which dests the user passed on the command line (so YAML doesn't
+    override them — reference run/run.py:609-613 override_args)."""
+    explicit = set()
+    opts = {}
+    for action in parser._actions:  # noqa: SLF001
+        for opt in action.option_strings:
+            opts[opt] = action.dest
+    for tok in argv:
+        key = tok.split("=")[0]
+        if key in opts:
+            explicit.add(opts[key])
+    return explicit
+
+
+def _resolve_hosts(args) -> List[HostInfo]:
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    # default: all local slots on this machine
+    np = args.np or 1
+    return [HostInfo("localhost", np)]
+
+
+def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
+                coordinator: str) -> List[Dict[str, str]]:
+    """Per-host worker env dicts (reference gloo_run.py:210-216 sets
+    HOROVOD_RANK/SIZE/LOCAL_RANK/... per slot; here per host-process, with
+    the slot table embedded for the chips it owns)."""
+    hosts: Dict[str, List[SlotInfo]] = {}
+    for s in slots:
+        hosts.setdefault(s.hostname, []).append(s)
+    envs = []
+    for pid, (hostname, host_slots) in enumerate(hosts.items()):
+        first = host_slots[0]
+        env = dict(base_env)
+        env.update({
+            env_util.HVD_RANK: str(first.rank),
+            env_util.HVD_SIZE: str(first.size),
+            env_util.HVD_LOCAL_RANK: "0",
+            env_util.HVD_LOCAL_SIZE: str(len(host_slots)),
+            env_util.HVD_CROSS_RANK: str(first.cross_rank),
+            env_util.HVD_CROSS_SIZE: str(first.cross_size),
+            env_util.HVD_NUM_PROCESSES: str(len(hosts)),
+            env_util.HVD_PROCESS_ID: str(pid),
+            env_util.HVD_CONTROLLER: "xla",
+            env_util.HVD_CPU_OPERATIONS: "xla",
+        })
+        if len(hosts) > 1:
+            env[env_util.HVD_COORDINATOR_ADDR] = coordinator
+        envs.append(env)
+    return envs
+
+
+def ssh_command(hostname: str, env: Dict[str, str], command: List[str],
+                ssh_port: Optional[int] = None, cwd: Optional[str] = None) -> str:
+    """The remote launch line (reference gloo_run.py:142-259 ssh fan-out;
+    kept as a pure string builder so tests can assert it without a
+    cluster, reference test/test_run.py:259-362)."""
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+    )
+    cd = f"cd {shlex.quote(cwd)} > /dev/null 2>&1 && " if cwd else ""
+    port = f" -p {ssh_port}" if ssh_port else ""
+    inner = f"{cd}env {exports} {' '.join(shlex.quote(c) for c in command)}"
+    return (
+        f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no"
+        f"{port} {hostname} {shlex.quote(inner)}"
+    )
+
+
+class _Job:
+    def __init__(self) -> None:
+        self.procs: List[subprocess.Popen] = []
+        self.failed: Optional[int] = None
+        self._lock = threading.Lock()
+
+    def kill_all(self, sig=signal.SIGTERM) -> None:
+        with self._lock:
+            for p in self.procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(sig)
+                    except OSError:
+                        pass
+
+
+def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
+    """Spawn workers, capture output, propagate failure
+    (reference gloo_run.py:142-259)."""
+    hosts = sorted({s.hostname for s in slots},
+                   key=[s.hostname for s in slots].index)
+    coordinator = f"{socket.gethostname()}:{env_util.get_int('HVD_COORD_PORT', 0) or _free_port()}"
+    envs = worker_envs(slots, env, coordinator)
+
+    job = _Job()
+
+    def handler(signum, frame):
+        job.kill_all(signal.SIGTERM)
+
+    old_int = signal.signal(signal.SIGINT, handler)
+    old_term = signal.signal(signal.SIGTERM, handler)
+
+    threads = []
+    try:
+        for pid, hostname in enumerate(hosts):
+            wenv = envs[pid]
+            if hostname in LOCAL_HOSTS:
+                full_env = dict(os.environ)
+                full_env.update(wenv)
+                proc = subprocess.Popen(
+                    args.command, env=full_env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            else:
+                cmd = ssh_command(hostname, wenv, args.command,
+                                  ssh_port=args.ssh_port, cwd=os.getcwd())
+                proc = subprocess.Popen(
+                    cmd, shell=True,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            job.procs.append(proc)
+
+            t = threading.Thread(
+                target=_pump_output,
+                args=(proc, pid, args.output_filename),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+
+        rc = 0
+        for pid, proc in enumerate(job.procs):
+            code = proc.wait()
+            if code != 0 and rc == 0:
+                rc = code
+                log.error("worker %d exited with code %d; terminating job",
+                          pid, code)
+                job.kill_all()
+        for t in threads:
+            t.join(timeout=5)
+        return rc
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def _pump_output(proc: subprocess.Popen, pid: int,
+                 output_dir: Optional[str]) -> None:
+    """Tag each line with the worker index (mpirun --tag-output style,
+    reference mpi_run.py:115-149) and/or tee to per-rank files
+    (reference gloo_run.py output capture)."""
+    sink = None
+    if output_dir:
+        os.makedirs(output_dir, exist_ok=True)
+        sink = open(os.path.join(output_dir, f"rank.{pid}.txt"), "w")
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        sys.stdout.write(f"[{pid}]<stdout>: {line}")
+        sys.stdout.flush()
+        if sink:
+            sink.write(line)
+            sink.flush()
+    if sink:
+        sink.close()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        from .. import __version__
+
+        print(__version__)
+        return 0
+    if not args.command:
+        print("tpurun: no command given", file=sys.stderr)
+        return 2
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    hosts = _resolve_hosts(args)
+    np = args.np or sum(h.slots for h in hosts)
+    slots = allocate_slots(hosts, np)
+    env = config_parser.env_from_args(args)
+    if args.verbose:
+        env[env_util.HVD_LOG_LEVEL] = env.get(env_util.HVD_LOG_LEVEL, "debug")
+    return launch_job(args, slots, env)
+
+
+# ---------------------------------------------------------------------------
+# function mode: horovod_tpu.run.run(fn, args=(), np=...)
+# ---------------------------------------------------------------------------
+def run(fn, args=(), kwargs=None, np: int = 1,
+        extra_env: Optional[Dict[str, str]] = None):
+    """Run ``fn(*args, **kwargs)`` on ``np`` local worker processes and
+    return the per-process results (reference run/run.py:870-956: the fn is
+    pickled, shipped through the KV store, executed by each rank, results
+    collected back through the KV store)."""
+    import cloudpickle
+
+    kwargs = kwargs or {}
+    secret = _secrets.token_bytes(16)
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    # cloudpickle so lambdas/closures ship (reference run/common/util/codec.py
+    # uses base64-cloudpickle for the same purpose)
+    server.put("job", "fn", cloudpickle.dumps((fn, args, kwargs)))
+
+    procs = []
+    try:
+        for pid in range(np):
+            env = dict(os.environ)
+            env.update(extra_env or {})
+            env.update({
+                "HVD_RUN_KV_ADDR": "127.0.0.1",
+                "HVD_RUN_KV_PORT": str(port),
+                "HVD_RUN_SECRET": secret.hex(),
+                "HVD_RUN_PID": str(pid),
+                "HVD_RUN_NP": str(np),
+                env_util.HVD_RANK: str(pid),
+                env_util.HVD_SIZE: str(np),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.run.task_fn"], env=env,
+            ))
+        rcs = [p.wait() for p in procs]
+        if any(rcs):
+            raise RuntimeError(f"function-mode workers failed: {rcs}")
+        results = []
+        for pid in range(np):
+            blob = server.get("result", str(pid))
+            if blob is None:
+                raise RuntimeError(f"worker {pid} returned no result")
+            payload = pickle.loads(blob)
+            if payload.get("error"):
+                raise RuntimeError(
+                    f"worker {pid} raised: {payload['error']}"
+                )
+            results.append(payload["value"])
+        return results
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        server.stop()
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

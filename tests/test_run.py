@@ -1,0 +1,247 @@
+"""Launcher tests without a cluster — modeled on reference test/test_run.py:
+arg/env translation (:68-176), YAML config override (:176-233), command-line
+string assertions with no execution (:259-362), plus live KV-store and
+local-spawn integration (reference test_interactiverun.py launches real
+2-proc jobs in-process)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.run.config_parser import env_from_args
+from horovod_tpu.run.hosts import (
+    HostInfo, allocate_slots, parse_hostfile, parse_hosts,
+)
+from horovod_tpu.run.http_client import delete_scope, get_kv, put_kv
+from horovod_tpu.run.http_server import RendezvousServer
+from horovod_tpu.run.run import parse_args, ssh_command, worker_envs
+
+
+# -- host parsing -----------------------------------------------------------
+def test_parse_hosts():
+    hosts = parse_hosts("h1:4,h2:8,h3")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 8), ("h3", 1),
+    ]
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text("h1 slots=2\n# comment\nh2 slots=4\nh3\n")
+    hosts = parse_hostfile(str(p))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 2), ("h2", 4), ("h3", 1),
+    ]
+
+
+def test_allocate_slots_ranks():
+    slots = allocate_slots(parse_hosts("a:2,b:2"), 4)
+    assert [(s.rank, s.hostname, s.local_rank, s.cross_rank)
+            for s in slots] == [
+        (0, "a", 0, 0), (1, "a", 1, 0), (2, "b", 0, 1), (3, "b", 1, 1),
+    ]
+    assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+               for s in slots)
+
+
+def test_allocate_slots_partial_last_host():
+    slots = allocate_slots(parse_hosts("a:4,b:4"), 6)
+    assert len(slots) == 6
+    assert slots[-1].hostname == "b"
+    assert slots[-1].local_size == 2
+    # cross sizes differ by column: local ranks 0,1 exist on both hosts;
+    # 2,3 only on a
+    assert slots[2].cross_size == 1  # a local_rank=2
+    assert slots[4].cross_size == 2  # b local_rank=0
+
+
+def test_allocate_too_many_raises():
+    with pytest.raises(ValueError):
+        allocate_slots([HostInfo("a", 2)], 3)
+
+
+# -- arg/env translation (reference test_run.py:68-176) ---------------------
+def test_env_from_args_all_groups():
+    args = parse_args([
+        "-np", "8",
+        "--fusion-threshold-mb", "32",
+        "--cycle-time-ms", "3.5",
+        "--cache-capacity", "2048",
+        "--hierarchical-allreduce",
+        "--autotune", "--autotune-log-file", "/tmp/at.csv",
+        "--autotune-warmup-samples", "5",
+        "--timeline-filename", "/tmp/tl",
+        "--timeline-mark-cycles",
+        "--trace-start-step", "10", "--trace-end-step", "20",
+        "--no-stall-check",
+        "--log-level", "debug",
+        "python", "train.py",
+    ])
+    env = env_from_args(args)
+    assert env["HVD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_CYCLE_TIME"] == "3.5"
+    assert env["HVD_CACHE_CAPACITY"] == "2048"
+    assert env["HVD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HVD_AUTOTUNE"] == "1"
+    assert env["HVD_AUTOTUNE_LOG"] == "/tmp/at.csv"
+    assert env["HVD_AUTOTUNE_WARMUP_SAMPLES"] == "5"
+    assert env["HVD_TIMELINE"] == "/tmp/tl"
+    assert env["HVD_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HVD_TRACE_START_STEP"] == "10"
+    assert env["HVD_TRACE_END_STEP"] == "20"
+    assert env["HVD_STALL_CHECK_DISABLE"] == "1"
+    assert env["HVD_LOG_LEVEL"] == "debug"
+    assert args.command == ["python", "train.py"]
+
+
+def test_stall_check_seconds():
+    args = parse_args([
+        "-np", "2",
+        "--stall-check-warning-time-seconds", "120",
+        "--stall-check-shutdown-time-seconds", "300",
+        "cmd",
+    ])
+    env = env_from_args(args)
+    assert env["HVD_STALL_CHECK_TIME_SECONDS"] == "120"
+    assert env["HVD_STALL_SHUTDOWN_TIME_SECONDS"] == "300"
+
+
+# -- YAML config override (reference test_run.py:176-233) --------------------
+def test_yaml_config_override(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        params:
+          fusion_threshold_mb: 16
+          cycle_time_ms: 2.5
+        autotune:
+          enabled: true
+          warmup_samples: 7
+        timeline:
+          filename: /tmp/yaml_tl
+        logging:
+          level: info
+    """))
+    args = parse_args(["-np", "2", "--config-file", str(cfg), "cmd"])
+    env = env_from_args(args)
+    assert env["HVD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+    assert env["HVD_CYCLE_TIME"] == "2.5"
+    assert env["HVD_AUTOTUNE"] == "1"
+    assert env["HVD_AUTOTUNE_WARMUP_SAMPLES"] == "7"
+    assert env["HVD_TIMELINE"] == "/tmp/yaml_tl"
+    assert env["HVD_LOG_LEVEL"] == "info"
+
+
+def test_yaml_does_not_override_explicit_cli(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("params:\n  cycle_time_ms: 2.5\n")
+    args = parse_args([
+        "-np", "2", "--cycle-time-ms", "9.0",
+        "--config-file", str(cfg), "cmd",
+    ])
+    assert env_from_args(args)["HVD_CYCLE_TIME"] == "9.0"
+
+
+# -- worker env + ssh command strings (reference test_run.py:259-362) --------
+def test_worker_envs_per_host():
+    slots = allocate_slots(parse_hosts("h1:4,h2:4"), 8)
+    envs = worker_envs(slots, {"HVD_LOG_LEVEL": "info"}, "coord:1234")
+    assert len(envs) == 2
+    e0, e1 = envs
+    assert e0["HVD_RANK"] == "0" and e1["HVD_RANK"] == "4"
+    assert e0["HVD_SIZE"] == e1["HVD_SIZE"] == "8"
+    assert e0["HVD_LOCAL_SIZE"] == "4"
+    assert e0["HVD_NUM_PROCESSES"] == "2"
+    assert e0["HVD_PROCESS_ID"] == "0" and e1["HVD_PROCESS_ID"] == "1"
+    assert e0["HVD_COORDINATOR_ADDR"] == "coord:1234"
+    assert e0["HVD_LOG_LEVEL"] == "info"
+    assert e0["HVD_CONTROLLER"] == "xla"
+
+
+def test_single_host_no_coordinator():
+    slots = allocate_slots(parse_hosts("localhost:8"), 8)
+    envs = worker_envs(slots, {}, "coord:1")
+    assert len(envs) == 1
+    assert "HVD_COORDINATOR_ADDR" not in envs[0]
+
+
+def test_ssh_command_string():
+    cmd = ssh_command(
+        "worker1", {"HVD_RANK": "1", "HVD_SIZE": "2"},
+        ["python", "train.py", "--lr", "0.1"],
+        ssh_port=2222, cwd="/job",
+    )
+    assert cmd.startswith(
+        "ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no "
+        "-p 2222 worker1 "
+    )
+    assert "HVD_RANK=1" in cmd and "HVD_SIZE=2" in cmd
+    assert "cd /job" in cmd
+    assert "python train.py --lr 0.1" in cmd
+
+
+# -- live KV store ----------------------------------------------------------
+def test_kvstore_roundtrip_and_auth():
+    secret = b"s3cret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    try:
+        put_kv("127.0.0.1", port, "scope", "k", b"hello", secret=secret)
+        assert get_kv("127.0.0.1", port, "scope", "k", secret=secret) == b"hello"
+        assert get_kv("127.0.0.1", port, "scope", "missing",
+                      secret=secret) is None
+        # wrong secret rejected
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            put_kv("127.0.0.1", port, "scope", "k", b"x", secret=b"wrong")
+        delete_scope("127.0.0.1", port, "scope", secret=secret)
+        assert get_kv("127.0.0.1", port, "scope", "k", secret=secret) is None
+    finally:
+        server.stop()
+
+
+# -- real local launches ----------------------------------------------------
+def test_tpurun_local_launch(tmp_path):
+    """End-to-end: tpurun spawns a local worker with the right env."""
+    from horovod_tpu.run.run import run_commandline
+
+    marker = tmp_path / "out.txt"
+    script = (
+        "import os;"
+        "open(r'%s','w').write("
+        "os.environ['HVD_RANK']+','+os.environ['HVD_SIZE']+','"
+        "+os.environ['HVD_LOCAL_SIZE'])" % marker
+    )
+    rc = run_commandline([
+        "-np", "4", "-H", "localhost:4",
+        "--output-filename", str(tmp_path / "logs"),
+        sys.executable, "-c", script,
+    ])
+    assert rc == 0
+    assert marker.read_text() == "0,4,4"
+    assert (tmp_path / "logs" / "rank.0.txt").exists()
+
+
+def test_tpurun_failure_propagates(tmp_path):
+    from horovod_tpu.run.run import run_commandline
+
+    rc = run_commandline([
+        "-np", "1", "-H", "localhost:1",
+        sys.executable, "-c", "import sys; sys.exit(3)",
+    ])
+    assert rc == 3
+
+
+def test_function_mode_run():
+    import horovod_tpu.run.run as tpurun
+
+    def fn(a, b):
+        import os
+
+        return a + b + int(os.environ["HVD_RANK"])
+
+    results = tpurun.run(fn, args=(10, 20), np=2)
+    assert results == [30, 31]
