@@ -1,0 +1,127 @@
+"""Training-loop callbacks.
+
+Re-design of the Keras callback set (reference horovod/_keras/callbacks.py:
+``BroadcastGlobalVariablesCallbackImpl`` (:21-45), ``MetricAverageCallback``
+(:46-60), ``LearningRateWarmupCallback`` / ``LearningRateScheduleCallback``;
+exposed via horovod/keras/callbacks.py) for flax/optax training loops.
+
+There's no Keras model object; callbacks hold the same *semantics* against
+a (state, metrics) training loop, and the LR policies are also exposed as
+optax schedules (the idiomatic carrier).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from . import core
+from . import eager
+from .optim.distributed import broadcast_parameters
+
+
+class Callback:
+    """Minimal protocol: wire into your loop where Keras would call these."""
+
+    def on_train_begin(self, state):  # noqa: B027
+        return state
+
+    def on_epoch_end(self, epoch: int, state, metrics: Dict[str, float]):
+        return metrics
+
+    def on_batch_end(self, step: int, state):  # noqa: B027
+        return state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial state from root at train start (reference
+    _keras/callbacks.py:21-45; ensures consistent init / checkpoint
+    restore across workers)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_begin(self, state):
+        state = broadcast_parameters(state, self.root_rank)
+        self.broadcast_done = True
+        return state
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all workers before reporting (reference
+    _keras/callbacks.py:46-60: allreduce each logged metric at epoch end)."""
+
+    def on_epoch_end(self, epoch, state, metrics):
+        if core.process_size() == 1:
+            return dict(metrics)
+        gathered = eager.allgather_object(metrics)
+        out: Dict[str, float] = {}
+        for k in metrics:
+            out[k] = float(np.mean([m[k] for m in gathered]))
+        return out
+
+
+class LearningRateWarmupCallback(Callback):
+    """Gradual LR warmup from lr to lr*multiplier over warmup_epochs
+    (reference _keras/callbacks.py LearningRateWarmupCallback, implementing
+    the Goyal et al. linear-scaling warmup).  ``lr(step)`` gives the
+    current value; ``as_optax_schedule`` returns the equivalent schedule."""
+
+    def __init__(self, initial_lr: float, multiplier: float,
+                 warmup_epochs: float = 5, steps_per_epoch: int = 1,
+                 verbose: bool = False):
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+
+    def lr(self, step: int) -> float:
+        total = self.warmup_epochs * self.steps_per_epoch
+        if step >= total:
+            return self.initial_lr * self.multiplier
+        frac = step / max(total, 1)
+        return self.initial_lr * (
+            1.0 + frac * (self.multiplier - 1.0)
+        )
+
+    def as_optax_schedule(self) -> Callable[[Any], Any]:
+        import jax.numpy as jnp
+
+        total = self.warmup_epochs * self.steps_per_epoch
+
+        def schedule(count):
+            frac = jnp.minimum(count / max(total, 1), 1.0)
+            return self.initial_lr * (1.0 + frac * (self.multiplier - 1.0))
+
+        return schedule
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiplier schedule over epoch ranges (reference
+    _keras/callbacks.py LearningRateScheduleCallback)."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, steps_per_epoch: int = 1):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.multiplier = (
+            multiplier if callable(multiplier) else (lambda epoch: multiplier)
+        )
+
+    def lr(self, step: int) -> float:
+        epoch = step / max(self.steps_per_epoch, 1)
+        if self.staircase:
+            epoch = math.floor(epoch)
+        if epoch < self.start_epoch:
+            return self.initial_lr
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return self.initial_lr
+        return self.initial_lr * self.multiplier(epoch)

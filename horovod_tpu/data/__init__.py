@@ -1,0 +1,1 @@
+from .loader import ShardedLoader  # noqa: F401

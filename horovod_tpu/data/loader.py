@@ -1,0 +1,74 @@
+"""Rank-sharded data loading with uneven-tail (Join) handling.
+
+Analog of the fork's data loader shim (reference horovod/mxnet/dataloader.py
+splits batches across ranks) plus the standard Horovod idiom of
+``DistributedSampler``-style per-rank sharding; the uneven tail integrates
+with Join semantics (elastic/join.py): the last partial global batch is
+padded and accompanied by a per-rank ``active`` mask so
+``join_allreduce`` divides by the true participant count — the compiled
+analog of "rank r joined early" (reference controller.cc:253-264).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from .. import core
+from ..training import shard_batch
+
+
+class ShardedLoader:
+    """Iterate (sharded_batch..., active_mask) over a host dataset.
+
+    Each yield is a *global* batch of ``batch_size * size()`` rows placed
+    so dim 0 is split across ranks.  When the data doesn't divide evenly,
+    the final batch is zero-padded and ``active`` marks which ranks hold
+    at least one real row (per-row validity is in ``valid_counts``).
+    """
+
+    def __init__(self, *arrays: np.ndarray, batch_size: int,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_remainder: bool = False):
+        assert arrays, "need at least one array"
+        n = arrays[0].shape[0]
+        assert all(a.shape[0] == n for a in arrays)
+        self.arrays = [np.asarray(a) for a in arrays]
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.n = n
+
+    def __len__(self) -> int:
+        g = self.batch_size * core.size()
+        return self.n // g if self.drop_remainder else -(-self.n // g)
+
+    def __iter__(self) -> Iterator[Tuple]:
+        size = core.size()
+        g = self.batch_size * size
+        idx = np.arange(self.n)
+        if self.shuffle:
+            # same permutation on every controller: seeded, not entropy-based
+            np.random.default_rng(self.seed).shuffle(idx)
+            self.seed += 1
+        stop = (self.n // g) * g if self.drop_remainder else self.n
+        for start in range(0, stop, g):
+            take = idx[start: start + g]
+            valid = take.shape[0]
+            rows_per_rank = np.full((size,), self.batch_size, np.int32)
+            if valid < g:
+                full, rem = divmod(valid, self.batch_size)
+                rows_per_rank = np.array(
+                    [self.batch_size] * full + ([rem] if rem else [])
+                    + [0] * (size - full - (1 if rem else 0)), np.int32,
+                )
+                take = np.concatenate([take, np.zeros(g - valid, np.int64)])
+            shards = tuple(
+                shard_batch(a[take]) for a in self.arrays
+            )
+            active = shard_batch(rows_per_rank > 0)
+            yield (*shards, active)

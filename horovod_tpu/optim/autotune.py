@@ -1,0 +1,263 @@
+"""Online autotuning of communication knobs via Bayesian optimization.
+
+Re-design of the reference autotuner (horovod/common/parameter_manager.cc/.h:
+joint Bayesian optimization of fusion-threshold + cycle-time plus
+categorical hierarchical-allreduce/allgather/cache flags, scored by
+bytes/sec, warmup-discard + steps-per-sample batching, winning params
+synced to all ranks; GP + expected-improvement machinery in
+horovod/common/optim/{bayesian_optimization.cc, gaussian_process.cc}).
+
+TPU translation (SURVEY §7.3(2)): the knobs that matter under XLA are the
+**gradient bucket size** (ops/fusion.py threshold) and **hierarchical vs
+flat** allreduce — the double-batching interaction with XLA's own combiner
+is exactly why the autotuner owns both.  Cycle time has no analog (no
+background negotiation loop on the hot path).  Re-tuning triggers a re-jit
+(shapes of fused buckets change), which is the compiled-world equivalent of
+the reference's "new parameters take effect next cycle".
+
+Pure NumPy GP (RBF kernel + jitter, Cholesky solves) — no SciPy needed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class GaussianProcessRegressor:
+    """RBF-kernel GP regression (reference optim/gaussian_process.cc)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-6,
+                 signal_var: float = 1.0):
+        self.length_scale = length_scale
+        self.noise = noise
+        self.signal_var = signal_var
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_var * np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        y = np.asarray(y, np.float64).reshape(-1)
+        self._ymean = y.mean() if y.size else 0.0
+        self._ystd = y.std() if y.size and y.std() > 0 else 1.0
+        yn = (y - self._ymean) / self._ystd
+        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, yn)
+        )
+        self._x, self._y = x, yn
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return np.zeros(len(x)), np.ones(len(x))
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.clip(
+            self.signal_var + self.noise - (v ** 2).sum(0), 1e-12, None
+        )
+        return mu * self._ystd + self._ymean, np.sqrt(var) * self._ystd
+
+
+def expected_improvement(mu: np.ndarray, sigma: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    """EI acquisition (reference optim/bayesian_optimization.cc)."""
+    from math import erf, sqrt
+
+    z = (mu - best - xi) / np.maximum(sigma, 1e-12)
+    phi = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+    Phi = 0.5 * (1.0 + np.vectorize(erf)(z / np.sqrt(2)))
+    return (mu - best - xi) * Phi + sigma * phi
+
+
+class BayesianOptimization:
+    """Sequential EI maximization over a normalized box with optional
+    categorical dimensions enumerated exhaustively."""
+
+    def __init__(self, bounds: Sequence[Tuple[float, float]],
+                 noise: float = 1e-3, seed: int = 0):
+        self.bounds = np.asarray(bounds, np.float64)
+        self.gp = GaussianProcessRegressor(length_scale=0.3, noise=noise)
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+        self._rng = np.random.default_rng(seed)
+
+    def _norm(self, x):
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (np.asarray(x, np.float64) - lo) / np.maximum(hi - lo, 1e-12)
+
+    def _denorm(self, u):
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + np.asarray(u) * (hi - lo)
+
+    def observe(self, x, y: float) -> None:
+        self.xs.append(self._norm(x))
+        self.ys.append(float(y))
+        self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
+
+    def suggest(self, n_candidates: int = 256):
+        if len(self.xs) < 2:
+            return self._denorm(self._rng.uniform(size=len(self.bounds)))
+        cand = self._rng.uniform(size=(n_candidates, len(self.bounds)))
+        mu, sigma = self.gp.predict(cand)
+        ei = expected_improvement(mu, sigma, max(self.ys))
+        return self._denorm(cand[int(np.argmax(ei))])
+
+    def best(self):
+        if not self.xs:
+            return None, None
+        i = int(np.argmax(self.ys))
+        return self._denorm(self.xs[i]), self.ys[i]
+
+
+@dataclass
+class TunableParams:
+    """The knob set (reference ParameterManager's tunables, translated)."""
+
+    fusion_threshold_bytes: int = env_util.DEFAULT_FUSION_THRESHOLD_BYTES
+    hierarchical_allreduce: bool = False
+
+    def as_vector(self) -> np.ndarray:
+        # log2 of threshold in MB-ish units for a smooth GP landscape
+        return np.array([np.log2(max(self.fusion_threshold_bytes, 1024))],
+                        np.float64)
+
+
+class ParameterManager:
+    """Collects per-step (bytes, time) scores and tunes the knobs.
+
+    Mirrors the reference flow (parameter_manager.cc): discard
+    ``warmup_samples``, average ``steps_per_sample`` steps per observation,
+    observe score = bytes/sec, move to the next suggestion; after
+    ``bayes_opt_max_samples`` observations, freeze at the best.  The
+    categorical hierarchical flag is handled by running a separate GP per
+    category (the reference enumerates categorical combinations the same
+    way).  ``on_update(params)`` fires when the active knobs change so the
+    training step can re-build (re-jit) its fusion plan.
+    """
+
+    def __init__(
+        self,
+        *,
+        enabled: Optional[bool] = None,
+        warmup_samples: Optional[int] = None,
+        steps_per_sample: Optional[int] = None,
+        max_samples: Optional[int] = None,
+        log_file: Optional[str] = None,
+        on_update: Optional[Callable[[TunableParams], None]] = None,
+        tune_hierarchical: bool = True,
+    ):
+        self.enabled = enabled if enabled is not None else \
+            env_util.get_bool(env_util.HVD_AUTOTUNE)
+        self.warmup_samples = warmup_samples if warmup_samples is not None \
+            else env_util.get_int(env_util.HVD_AUTOTUNE_WARMUP_SAMPLES, 3)
+        self.steps_per_sample = steps_per_sample if steps_per_sample is not None \
+            else env_util.get_int(env_util.HVD_AUTOTUNE_STEPS_PER_SAMPLE, 10)
+        self.max_samples = max_samples if max_samples is not None \
+            else env_util.get_int(env_util.HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20)
+        noise = env_util.get_float(
+            env_util.HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8
+        )
+        self.log_file = log_file or env_util.get_str(env_util.HVD_AUTOTUNE_LOG)
+        self.on_update = on_update
+
+        # log2(threshold bytes) in [log2(1MB), log2(256MB)]
+        self._categories = [False, True] if tune_hierarchical else [False]
+        self._bo = {
+            cat: BayesianOptimization([(20.0, 28.0)], noise=noise, seed=17 + i)
+            for i, cat in enumerate(self._categories)
+        }
+        self._cat_idx = 0
+        self.current = TunableParams()
+        self._samples_seen = 0
+        self._warmup_left = self.warmup_samples
+        self._step_scores: List[float] = []
+        self.frozen = not self.enabled
+        self._log_header_written = False
+
+    # -- scoring ------------------------------------------------------------
+    def record_step(self, nbytes: float, seconds: float) -> None:
+        """Feed one training step's communication volume and duration
+        (reference scores bytes/sec over all tensors in the cycle)."""
+        if self.frozen:
+            return
+        if seconds <= 0:
+            return
+        self._step_scores.append(nbytes / seconds)
+        if len(self._step_scores) >= self.steps_per_sample:
+            self._finish_sample()
+
+    def _finish_sample(self) -> None:
+        score = float(np.median(self._step_scores))
+        self._step_scores = []
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            return
+        cat = self._categories[self._cat_idx]
+        self._bo[cat].observe(self.current.as_vector(), score)
+        self._log(score)
+        self._samples_seen += 1
+        if self._samples_seen >= self.max_samples:
+            self._freeze()
+            return
+        # round-robin categories; suggest next threshold within category
+        self._cat_idx = (self._cat_idx + 1) % len(self._categories)
+        nxt_cat = self._categories[self._cat_idx]
+        vec = self._bo[nxt_cat].suggest()
+        self._set_params(TunableParams(
+            fusion_threshold_bytes=int(2 ** float(vec[0])),
+            hierarchical_allreduce=nxt_cat,
+        ))
+
+    def _freeze(self) -> None:
+        best_cat, best_vec, best_y = None, None, -np.inf
+        for cat, bo in self._bo.items():
+            vec, y = bo.best()
+            if y is not None and y > best_y:
+                best_cat, best_vec, best_y = cat, vec, y
+        if best_vec is not None:
+            self._set_params(TunableParams(
+                fusion_threshold_bytes=int(2 ** float(best_vec[0])),
+                hierarchical_allreduce=bool(best_cat),
+            ))
+        self.frozen = True
+        log.info("autotune frozen: threshold=%d hierarchical=%s (score %.3g)",
+                 self.current.fusion_threshold_bytes,
+                 self.current.hierarchical_allreduce, best_y)
+
+    def _set_params(self, p: TunableParams) -> None:
+        changed = (
+            p.fusion_threshold_bytes != self.current.fusion_threshold_bytes
+            or p.hierarchical_allreduce != self.current.hierarchical_allreduce
+        )
+        self.current = p
+        if changed and self.on_update:
+            self.on_update(p)
+
+    def _log(self, score: float) -> None:
+        if not self.log_file:
+            return
+        new = not os.path.exists(self.log_file) and not self._log_header_written
+        with open(self.log_file, "a") as f:
+            if new:
+                f.write("timestamp,fusion_threshold,hierarchical,score_bytes_per_sec\n")
+                self._log_header_written = True
+            f.write(f"{time.time()},{self.current.fusion_threshold_bytes},"
+                    f"{int(self.current.hierarchical_allreduce)},{score}\n")

@@ -1,0 +1,88 @@
+"""Autotuner: GP regression, EI acquisition, and the ParameterManager loop
+(reference parameter_manager.cc + optim/bayesian_optimization.cc tests-by-
+construction: the manager converges toward the best-scoring knob)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.optim.autotune import (
+    BayesianOptimization,
+    GaussianProcessRegressor,
+    ParameterManager,
+    TunableParams,
+    expected_improvement,
+)
+
+
+def test_gp_fits_and_interpolates():
+    gp = GaussianProcessRegressor(length_scale=0.5, noise=1e-6)
+    x = np.linspace(0, 1, 8)[:, None]
+    y = np.sin(3 * x[:, 0])
+    gp.fit(x, y)
+    mu, sigma = gp.predict(x)
+    np.testing.assert_allclose(mu, y, atol=1e-3)
+    assert (sigma < 0.1).all()
+    # uncertainty grows away from data
+    _, s_far = gp.predict(np.array([[3.0]]))
+    assert s_far[0] > 3 * sigma.max()
+
+
+def test_expected_improvement_prefers_unexplored():
+    mu = np.array([0.0, 1.0])
+    sigma = np.array([1.0, 0.0])
+    ei = expected_improvement(mu, sigma, best=1.0)
+    assert ei[0] > ei[1]
+
+
+def test_bo_finds_peak():
+    # maximize -(x-42)^2 on [0, 100]
+    bo = BayesianOptimization([(0.0, 100.0)], noise=1e-4, seed=3)
+    for _ in range(25):
+        x = bo.suggest()
+        bo.observe(x, -(float(x[0]) - 42.0) ** 2)
+    best_x, _ = bo.best()
+    assert abs(float(best_x[0]) - 42.0) < 10.0
+
+
+def test_parameter_manager_converges_to_best_threshold():
+    # simulated system: bytes/sec peaks at threshold ~2^24 (16MB), flat
+    # categorical preference for hierarchical=True (+20%)
+    def score(p: TunableParams) -> float:
+        x = np.log2(p.fusion_threshold_bytes)
+        base = 1e9 * np.exp(-0.5 * (x - 24.0) ** 2)
+        return base * (1.2 if p.hierarchical_allreduce else 1.0)
+
+    updates = []
+    pm = ParameterManager(
+        enabled=True, warmup_samples=1, steps_per_sample=2, max_samples=24,
+        on_update=updates.append,
+    )
+    rng = np.random.default_rng(0)
+    while not pm.frozen:
+        s = score(pm.current) * rng.uniform(0.95, 1.05)
+        # record_step takes (bytes, seconds): feed score as bytes/1s
+        pm.record_step(s, 1.0)
+        pm.record_step(s, 1.0)
+    assert pm.frozen
+    x = np.log2(pm.current.fusion_threshold_bytes)
+    assert 21.0 <= x <= 27.0, pm.current
+    assert updates, "on_update must fire when knobs move"
+
+
+def test_parameter_manager_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("HVD_AUTOTUNE", raising=False)
+    pm = ParameterManager()
+    assert pm.frozen
+    pm.record_step(1e6, 0.01)  # no-op
+
+
+def test_autotune_log_file(tmp_path):
+    pm = ParameterManager(enabled=True, warmup_samples=0, steps_per_sample=1,
+                          max_samples=3, log_file=str(tmp_path / "at.csv"))
+    for _ in range(5):
+        if pm.frozen:
+            break
+        pm.record_step(1e8, 1.0)
+    text = (tmp_path / "at.csv").read_text()
+    assert text.startswith("timestamp,fusion_threshold,hierarchical,score")
+    assert len(text.strip().splitlines()) >= 2

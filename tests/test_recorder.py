@@ -1,0 +1,88 @@
+"""Recorder outputs: dag.gml / tensor_shapes.json / gradient_name_list.json /
+metadata.json — the fork's auto-profiling artifacts (reference
+tensorflow/recorder.py:339-521, mxnet/recorder.py:187-302)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.timeline.recorder import Recorder, TimelineHook, jaxpr_dag
+
+
+def _step(w, x):
+    return jnp.tanh(x @ w).sum()
+
+
+def test_jaxpr_dag_structure():
+    closed = jax.make_jaxpr(_step)(jnp.ones((3, 4)), jnp.ones((2, 3)))
+    nodes, edges = jaxpr_dag(closed)
+    kinds = {n["kind"] for n in nodes}
+    assert {"input", "op", "output"} <= kinds
+    labels = {n["label"] for n in nodes}
+    assert "dot_general" in labels and "tanh" in labels
+    assert edges, "dag must have edges"
+    # every edge endpoint is a valid node id
+    ids = {n["id"] for n in nodes}
+    assert all(s in ids and t in ids for s, t in edges)
+
+
+def test_recorder_dumps(hvd_init, tmp_path):
+    rec = Recorder(str(tmp_path))
+    assert rec.enabled
+    rec.record_step_function(_step, jnp.ones((3, 4)), jnp.ones((2, 3)))
+    rec.register_gradients({"dense": {"kernel": np.zeros((3, 4)),
+                                      "bias": np.zeros((4,))}})
+    rec.dump_metadata(model="TestNet", batch_size=2)
+
+    d = tmp_path / "0"
+    gml = (d / "dag.gml").read_text()
+    assert gml.startswith("graph [")
+    assert "dot_general" in gml
+    shapes = json.loads((d / "tensor_shapes.json").read_text())
+    assert any(v == [2, 4] for v in shapes.values())
+    grads = json.loads((d / "gradient_name_list.json").read_text())
+    assert "gradients/dense/kernel" in grads
+    assert "gradients/dense/bias" in grads
+    meta = json.loads((d / "metadata.json").read_text())
+    assert meta["model"] == "TestNet"
+    assert meta["size"] == 8
+
+
+def test_gml_readable_by_networkx_if_available(hvd_init, tmp_path):
+    try:
+        import networkx as nx
+    except ImportError:
+        import pytest
+
+        pytest.skip("networkx not installed")
+    rec = Recorder(str(tmp_path))
+    rec.record_step_function(_step, jnp.ones((3, 4)), jnp.ones((2, 3)))
+    g = nx.read_gml(str(tmp_path / "0" / "dag.gml"), label="id")
+    assert g.number_of_nodes() > 0
+
+
+def test_timeline_hook_window(hvd_init, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TRACE_DIR", str(tmp_path))
+    rec = Recorder()
+    hook = TimelineHook(rec, start_step=1, end_step=3)
+    for _ in range(4):
+        with hook.step():
+            pass
+    from horovod_tpu.timeline.timeline import timeline
+
+    timeline.shutdown()
+    p = tmp_path / "0" / "comm.json"
+    assert p.exists()
+
+
+def test_recorder_disabled(tmp_path, monkeypatch):
+    monkeypatch.delenv("HVD_TRACE_DIR", raising=False)
+    monkeypatch.delenv("HVD_TIMELINE", raising=False)
+    rec = Recorder(None)
+    assert not rec.enabled
+    rec.record_step_function(_step, jnp.ones((3, 4)), jnp.ones((2, 3)))
+    rec.dump_metadata()  # no-ops, no crash

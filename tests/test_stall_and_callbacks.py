@@ -1,0 +1,142 @@
+"""Stall inspector (reference test/test_stall.py:12-25: deliberate delay +
+watchdog) and callbacks/loader behavior."""
+
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.runtime.stall_inspector import StallInspector
+
+
+def test_stall_warning_fires():
+    insp = StallInspector(enabled=True, warning_seconds=0.05,
+                          shutdown_seconds=0, check_interval=0.01)
+    insp.begin("allreduce.stuck")
+    time.sleep(0.08)
+    insp.check_once()
+    assert insp.warnings and insp.warnings[0][0] == "allreduce.stuck"
+    insp.end("allreduce.stuck")
+
+
+def test_stall_no_warning_when_fast():
+    insp = StallInspector(enabled=True, warning_seconds=1.0,
+                          shutdown_seconds=0)
+    with insp.watch("allreduce.fast"):
+        pass
+    insp.check_once()
+    assert not insp.warnings
+
+
+def test_stall_shutdown_callback():
+    killed = []
+    insp = StallInspector(enabled=True, warning_seconds=0.01,
+                          shutdown_seconds=0.05,
+                          on_shutdown=killed.append)
+    insp.begin("x")
+    time.sleep(0.08)
+    insp.check_once()
+    assert killed == ["x"]
+
+
+def test_stall_disabled():
+    insp = StallInspector(enabled=False, warning_seconds=0)
+    insp.begin("x")
+    insp.check_once()
+    assert not insp.warnings
+
+
+# -- callbacks ---------------------------------------------------------------
+def test_warmup_callback_lr():
+    from horovod_tpu.callbacks import LearningRateWarmupCallback
+
+    cb = LearningRateWarmupCallback(initial_lr=0.1, multiplier=8,
+                                    warmup_epochs=2, steps_per_epoch=10)
+    assert cb.lr(0) == pytest.approx(0.1)
+    assert cb.lr(10) == pytest.approx(0.1 * 4.5)
+    assert cb.lr(20) == pytest.approx(0.8)
+    assert cb.lr(100) == pytest.approx(0.8)
+    sched = cb.as_optax_schedule()
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(20)) == pytest.approx(0.8)
+
+
+def test_schedule_callback():
+    from horovod_tpu.callbacks import LearningRateScheduleCallback
+
+    cb = LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e,
+        start_epoch=1, end_epoch=3, steps_per_epoch=1,
+    )
+    assert cb.lr(0) == 1.0
+    assert cb.lr(1) == pytest.approx(0.1)
+    assert cb.lr(2) == pytest.approx(0.01)
+    assert cb.lr(3) == 1.0
+
+
+def test_broadcast_callback_single_process(hvd_init):
+    from horovod_tpu.callbacks import BroadcastGlobalVariablesCallback
+
+    cb = BroadcastGlobalVariablesCallback(root_rank=0)
+    state = {"w": np.ones(3)}
+    out = cb.on_train_begin(state)
+    np.testing.assert_array_equal(out["w"], state["w"])
+    assert cb.broadcast_done
+
+
+def test_metric_average_single_process(hvd_init):
+    from horovod_tpu.callbacks import MetricAverageCallback
+
+    cb = MetricAverageCallback()
+    out = cb.on_epoch_end(0, None, {"loss": 0.5})
+    assert out == {"loss": 0.5}
+
+
+# -- data loader -------------------------------------------------------------
+def test_sharded_loader_even(hvd_init):
+    from horovod_tpu.data import ShardedLoader
+
+    x = np.arange(32, dtype=np.float32).reshape(32, 1)
+    y = np.arange(32, dtype=np.int32)
+    loader = ShardedLoader(x, y, batch_size=2)
+    assert len(loader) == 2
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, yb, active = batches[0]
+    assert xb.shape == (16, 1)
+    assert np.asarray(active).all()
+    np.testing.assert_array_equal(np.asarray(yb), np.arange(16))
+
+
+def test_sharded_loader_uneven_tail(hvd_init):
+    from horovod_tpu.data import ShardedLoader
+
+    x = np.arange(20, dtype=np.float32).reshape(20, 1)
+    loader = ShardedLoader(x, batch_size=2)  # global batch 16 → tail of 4
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, active = batches[1]
+    active = np.asarray(active)
+    # tail: 4 rows → ranks 0,1 full, ranks 2..7 joined
+    assert active.tolist() == [True, True] + [False] * 6
+
+
+def test_sharded_loader_drop_remainder(hvd_init):
+    from horovod_tpu.data import ShardedLoader
+
+    x = np.arange(20, dtype=np.float32).reshape(20, 1)
+    loader = ShardedLoader(x, batch_size=2, drop_remainder=True)
+    assert len(loader) == 1
+    assert len(list(loader)) == 1
+
+
+def test_sharded_loader_shuffle_deterministic(hvd_init):
+    from horovod_tpu.data import ShardedLoader
+
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    l1 = ShardedLoader(x, batch_size=2, shuffle=True, seed=7)
+    l2 = ShardedLoader(x, batch_size=2, shuffle=True, seed=7)
+    b1 = np.asarray(next(iter(l1))[0])
+    b2 = np.asarray(next(iter(l2))[0])
+    np.testing.assert_array_equal(b1, b2)
