@@ -1,0 +1,144 @@
+// Common types for the native runtime core.
+//
+// TPU-native re-design of horovod/common/common.h (reference): Status,
+// DataType, the Request/Response message vocabulary (reference
+// common/message.h:49-51 RequestType {ALLREDUCE, ALLGATHER, BROADCAST,
+// JOIN, ADASUM}, :134-136 ResponseType + ERROR), and the env-knob
+// defaults.  The wire format is a hand-rolled length-prefixed binary
+// encoding instead of FlatBuffers (reference wire/message.fbs) — the
+// controller traffic is tiny (names + shapes), so zero-copy buys nothing.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class RequestType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kJoin = 3,
+  kAdasum = 4,
+  kAlltoall = 5,
+};
+
+enum class ResponseType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kJoin = 3,
+  kAdasum = 4,
+  kAlltoall = 5,
+  kError = 6,
+};
+
+enum class DataType : uint8_t {
+  kFloat32 = 0,
+  kBFloat16 = 1,
+  kFloat16 = 2,
+  kFloat64 = 3,
+  kInt32 = 4,
+  kInt64 = 5,
+  kUInt8 = 6,
+  kBool = 7,
+};
+
+inline size_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kFloat64: case DataType::kInt64: return 8;
+    case DataType::kFloat32: case DataType::kInt32: return 4;
+    case DataType::kBFloat16: case DataType::kFloat16: return 2;
+    default: return 1;
+  }
+}
+
+// A worker's announcement that tensor `name` is ready on `rank`
+// (reference common/message.h Request).
+struct Request {
+  int32_t rank = 0;
+  RequestType type = RequestType::kAllreduce;
+  DataType dtype = DataType::kFloat32;
+  int32_t root_rank = 0;  // broadcast only
+  std::vector<int64_t> shape;
+  std::string name;
+
+  void Serialize(std::string* out) const;
+  static bool Parse(const char* data, size_t len, Request* out);
+};
+
+// Coordinator verdict for one fused group (reference common/message.h
+// Response: type, tensor_names, error_message, devices).
+struct Response {
+  ResponseType type = ResponseType::kAllreduce;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+
+  void Serialize(std::string* out) const;
+  static bool Parse(const char* data, size_t len, Response* out,
+                    size_t* consumed);
+};
+
+// ResponseList = one negotiation cycle's output (reference
+// message.h ResponseList).
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+
+  void Serialize(std::string* out) const;
+  static bool Parse(const char* data, size_t len, ResponseList* out);
+};
+
+// -- little-endian primitive packing ----------------------------------------
+inline void PutU32(std::string* s, uint32_t v) {
+  char b[4];
+  std::memcpy(b, &v, 4);
+  s->append(b, 4);
+}
+inline void PutI64(std::string* s, int64_t v) {
+  char b[8];
+  std::memcpy(b, &v, 8);
+  s->append(b, 8);
+}
+inline void PutStr(std::string* s, const std::string& v) {
+  PutU32(s, static_cast<uint32_t>(v.size()));
+  s->append(v);
+}
+
+struct Cursor {
+  const char* p;
+  size_t left;
+  bool ok = true;
+
+  uint8_t U8() {
+    if (left < 1) { ok = false; return 0; }
+    uint8_t v = static_cast<uint8_t>(*p);
+    p += 1; left -= 1;
+    return v;
+  }
+  uint32_t U32() {
+    if (left < 4) { ok = false; return 0; }
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    p += 4; left -= 4;
+    return v;
+  }
+  int64_t I64() {
+    if (left < 8) { ok = false; return 0; }
+    int64_t v;
+    std::memcpy(&v, p, 8);
+    p += 8; left -= 8;
+    return v;
+  }
+  std::string Str() {
+    uint32_t n = U32();
+    if (!ok || left < n) { ok = false; return ""; }
+    std::string v(p, n);
+    p += n; left -= n;
+    return v;
+  }
+};
+
+}  // namespace hvd

@@ -1,0 +1,594 @@
+// Native coordination control plane: rank-0 coordinator + worker clients
+// over TCP.
+//
+// Re-design of the reference's controller stack for the eager
+// (multi-controller) path:
+//   * negotiation protocol — reference horovod/common/controller.cc:55
+//     ComputeResponseList and the protocol doc comment controller.h:58-99:
+//     workers announce ready tensors, the coordinator counts them
+//     (IncrementTensorCount, controller.cc:814), validates cross-rank
+//     shape/dtype/op agreement (ConstructResponse, :377), fuses small
+//     tensors (FuseResponses, :665) and broadcasts the ResponseList;
+//   * transport — reference mpi_controller.cc (MPI_Gatherv/Bcast) and
+//     gloo_controller.cc (TCP p2p); on TPU pods there is no MPI, so the
+//     transport is plain TCP like the Gloo path, with the coordinator
+//     socket standing in for MPI collectives (SURVEY §2.7);
+//   * tensor queue — reference tensor_queue.cc: thread-safe pending table,
+//     duplicate in-flight names rejected (common.h:160-163);
+//   * response cache — reference response_cache.cc:45-102: repeat
+//     submissions of an identical (name, shape, dtype, op) skip
+//     re-validation; hits are counted (the XLA executable cache is the
+//     data-plane analog; this one serves the eager plane);
+//   * stall inspector — reference stall_inspector.cc: warn when a tensor
+//     has waited > warning threshold with the list of missing ranks;
+//   * Join — reference controller.cc:253-264: a joined rank participates
+//     implicitly in every outstanding negotiation; when all ranks join,
+//     a JOIN response is emitted.
+//
+// Why this exists on TPU: inside one compiled SPMD program the schedule is
+// static and needs no negotiation — but *across controller processes*
+// (multi-host eager mode) each process must issue the same XLA collective
+// in the same order or the job deadlocks.  This controller provides that
+// agreement, exactly Horovod's original purpose.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+namespace {
+
+enum MsgType : uint8_t {
+  kHello = 1,
+  kRequest = 2,
+  kJoinMsg = 3,
+  kResponseList = 4,
+  kShutdown = 5,
+};
+
+double NowSec() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool WriteFull(int fd, const char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = ::send(fd, data, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool ReadFull(int fd, char* data, size_t len) {
+  while (len > 0) {
+    ssize_t n = ::recv(fd, data, len, 0);
+    if (n <= 0) return false;
+    data += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool SendMsg(int fd, uint8_t type, const std::string& payload) {
+  std::string frame;
+  PutU32(&frame, static_cast<uint32_t>(payload.size() + 1));
+  frame.push_back(static_cast<char>(type));
+  frame += payload;
+  return WriteFull(fd, frame.data(), frame.size());
+}
+
+bool RecvMsg(int fd, uint8_t* type, std::string* payload) {
+  char hdr[4];
+  if (!ReadFull(fd, hdr, 4)) return false;
+  uint32_t len;
+  std::memcpy(&len, hdr, 4);
+  if (len == 0 || len > (64u << 20)) return false;
+  std::string buf(len, '\0');
+  if (!ReadFull(fd, buf.data(), len)) return false;
+  *type = static_cast<uint8_t>(buf[0]);
+  payload->assign(buf.data() + 1, len - 1);
+  return true;
+}
+
+std::string MetaKey(const Request& r) {
+  std::string k = r.name;
+  k.push_back('|');
+  k.push_back(static_cast<char>(r.type));
+  k.push_back(static_cast<char>(r.dtype));
+  for (int64_t d : r.shape) {
+    k += std::to_string(d);
+    k.push_back(',');
+  }
+  k += std::to_string(r.root_rank);
+  return k;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Coordinator
+// ---------------------------------------------------------------------------
+class ControllerServer {
+ public:
+  ControllerServer(int port, int nranks, double cycle_ms,
+                   int64_t fusion_threshold, double stall_warn_sec)
+      : nranks_(nranks),
+        cycle_ms_(cycle_ms),
+        fusion_threshold_(fusion_threshold),
+        stall_warn_sec_(stall_warn_sec) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, nranks) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  ~ControllerServer() { Stop(); }
+
+  int port() const { return port_; }
+  bool ok() const { return listen_fd_ >= 0; }
+  int64_t cache_hits() const { return cache_hits_.load(); }
+  int64_t cycles() const { return cycles_.load(); }
+  int64_t stall_warnings() const { return stall_warnings_.load(); }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    if (thread_.joinable()) thread_.join();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    for (auto& [fd, rank] : clients_) ::close(fd);
+  }
+
+ private:
+  struct PendingTensor {
+    Request first;                 // canonical metadata (first submitter)
+    std::vector<bool> ready;       // per-rank submitted?
+    int count = 0;
+    double first_ts = 0;
+    bool error = false;
+    std::string error_message;
+    bool warned = false;
+  };
+
+  void Loop() {
+    while (!stopping_.load()) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      for (auto& [fd, rank] : clients_) fds.push_back({fd, POLLIN, 0});
+      int timeout = static_cast<int>(cycle_ms_);
+      ::poll(fds.data(), fds.size(), timeout < 1 ? 1 : timeout);
+
+      if (fds[0].revents & POLLIN) Accept();
+      size_t i = 1;
+      std::vector<int> dead;
+      for (auto& [fd, rank] : clients_) {
+        if (i < fds.size() && (fds[i].revents & (POLLIN | POLLHUP))) {
+          if (!HandleClient(fd)) dead.push_back(fd);
+        }
+        ++i;
+      }
+      for (int fd : dead) {
+        ::close(fd);
+        clients_.erase(fd);
+      }
+      RunCycle();
+    }
+  }
+
+  void Accept() {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint8_t type;
+    std::string payload;
+    if (!RecvMsg(fd, &type, &payload) || type != kHello || payload.size() < 4) {
+      ::close(fd);
+      return;
+    }
+    int32_t rank;
+    std::memcpy(&rank, payload.data(), 4);
+    clients_[fd] = rank;
+  }
+
+  bool HandleClient(int fd) {
+    uint8_t type;
+    std::string payload;
+    if (!RecvMsg(fd, &type, &payload)) return false;
+    if (type == kRequest) {
+      Request r;
+      if (Request::Parse(payload.data(), payload.size(), &r)) AddRequest(r);
+    } else if (type == kJoinMsg) {
+      int32_t rank;
+      if (payload.size() >= 4) {
+        std::memcpy(&rank, payload.data(), 4);
+        joined_.insert(rank);
+      }
+    } else if (type == kShutdown) {
+      stopping_.store(true);
+    }
+    return true;
+  }
+
+  void AddRequest(const Request& r) {
+    auto& t = table_[r.name];
+    if (t.ready.empty()) {
+      t.ready.assign(nranks_, false);
+      t.first = r;
+      t.first_ts = NowSec();
+      // response-cache check: identical metadata seen before → hit,
+      // validation skipped (reference response_cache.h:45-102)
+      auto it = cache_.find(r.name);
+      if (it != cache_.end() && it->second == MetaKey(r)) {
+        cache_hits_.fetch_add(1);
+        t.error = false;
+      }
+    } else if (t.ready[r.rank]) {
+      // duplicate in-flight submission from the same rank
+      // (reference common.h:160-163 DUPLICATE_NAME_ERROR)
+      t.error = true;
+      t.error_message = "Duplicate tensor name in flight: " + r.name +
+                        " submitted twice by rank " + std::to_string(r.rank);
+      return;
+    }
+    if (!t.error) {
+      // cross-rank metadata validation (reference controller.cc:377-610)
+      if (MetaKey(r) != MetaKey(t.first)) {
+        t.error = true;
+        t.error_message =
+            "Mismatched tensor metadata for " + r.name +
+            ": ranks disagree on shape/dtype/op (rank " +
+            std::to_string(r.rank) + " vs rank " +
+            std::to_string(t.first.rank) + ")";
+      }
+    }
+    if (!t.ready[r.rank]) {
+      t.ready[r.rank] = true;
+      t.count += 1;
+    }
+  }
+
+  void RunCycle() {
+    cycles_.fetch_add(1);
+    ResponseList rl;
+    double now = NowSec();
+
+    std::vector<std::string> done;
+    for (auto& [name, t] : table_) {
+      int effective = t.count;
+      for (int r = 0; r < nranks_; ++r)
+        if (!t.ready[r] && joined_.count(r)) effective += 1;
+      if (effective >= nranks_) {
+        Response resp;
+        if (t.error) {
+          resp.type = ResponseType::kError;
+          resp.error_message = t.error_message;
+        } else {
+          resp.type = static_cast<ResponseType>(t.first.type);
+          cache_[name] = MetaKey(t.first);
+        }
+        resp.tensor_names.push_back(name);
+        rl.responses.push_back(std::move(resp));
+        done.push_back(name);
+      } else if (stall_warn_sec_ > 0 && !t.warned &&
+                 now - t.first_ts > stall_warn_sec_) {
+        t.warned = true;
+        stall_warnings_.fetch_add(1);
+        std::string missing;
+        for (int r = 0; r < nranks_; ++r)
+          if (!t.ready[r] && !joined_.count(r))
+            missing += std::to_string(r) + " ";
+        std::fprintf(stderr,
+                     "[hvd controller] tensor %s stalled %.0fs waiting for "
+                     "ranks: %s\n",
+                     name.c_str(), now - t.first_ts, missing.c_str());
+      }
+    }
+    for (const auto& n : done) table_.erase(n);
+
+    if (static_cast<int>(joined_.size()) >= nranks_ && table_.empty()) {
+      Response resp;
+      resp.type = ResponseType::kJoin;
+      resp.tensor_names.push_back("join");
+      rl.responses.push_back(std::move(resp));
+      joined_.clear();
+    }
+
+    if (rl.responses.empty()) return;
+    FuseResponses(&rl);
+    std::string payload;
+    rl.Serialize(&payload);
+    for (auto& [fd, rank] : clients_) SendMsg(fd, kResponseList, payload);
+  }
+
+  // Merge adjacent same-(type) OK responses until the byte budget is hit
+  // (reference controller.cc:665 FuseResponses; byte size from the
+  // canonical metadata).
+  void FuseResponses(ResponseList* rl) {
+    std::vector<Response> fused;
+    for (auto& r : rl->responses) {
+      bool merged = false;
+      if (r.type != ResponseType::kError && !fused.empty()) {
+        Response& last = fused.back();
+        if (last.type == r.type &&
+            FusedBytes(last) + FusedBytes(r) <= fusion_threshold_) {
+          for (auto& n : r.tensor_names)
+            last.tensor_names.push_back(std::move(n));
+          merged = true;
+        }
+      }
+      if (!merged) fused.push_back(std::move(r));
+    }
+    rl->responses = std::move(fused);
+  }
+
+  int64_t FusedBytes(const Response& r) {
+    int64_t total = 0;
+    for (const auto& n : r.tensor_names) {
+      auto it = sizes_.find(n);
+      if (it != sizes_.end()) total += it->second;
+    }
+    return total;
+  }
+
+ public:
+  // populated by AddRequest via MetaKey bookkeeping
+  std::unordered_map<std::string, int64_t> sizes_;
+
+ private:
+  int nranks_;
+  double cycle_ms_;
+  int64_t fusion_threshold_;
+  double stall_warn_sec_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread thread_;
+  std::atomic<bool> stopping_{false};
+  std::map<int, int32_t> clients_;  // fd → rank
+  std::map<std::string, PendingTensor> table_;
+  std::unordered_map<std::string, std::string> cache_;
+  std::set<int32_t> joined_;
+  std::atomic<int64_t> cache_hits_{0};
+  std::atomic<int64_t> cycles_{0};
+  std::atomic<int64_t> stall_warnings_{0};
+};
+
+// ---------------------------------------------------------------------------
+// Worker client
+// ---------------------------------------------------------------------------
+class ControllerClient {
+ public:
+  ControllerClient(const std::string& host, int port, int rank)
+      : rank_(rank) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    for (int attempt = 0; attempt < 100; ++attempt) {
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        connected_ = true;
+        break;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
+    if (!connected_) return;
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::string hello(4, '\0');
+    std::memcpy(hello.data(), &rank_, 4);
+    SendMsg(fd_, kHello, hello);
+    reader_ = std::thread([this] { ReadLoop(); });
+  }
+
+  ~ControllerClient() {
+    closing_.store(true);
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+    if (reader_.joinable()) reader_.join();
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return connected_; }
+
+  bool Submit(const Request& r) {
+    std::string payload;
+    r.Serialize(&payload);
+    std::lock_guard<std::mutex> lk(wmu_);
+    return SendMsg(fd_, kRequest, payload);
+  }
+
+  bool Join() {
+    std::string payload(4, '\0');
+    std::memcpy(payload.data(), &rank_, 4);
+    std::lock_guard<std::mutex> lk(wmu_);
+    return SendMsg(fd_, kJoinMsg, payload);
+  }
+
+  // Block until `name` is negotiated.  Returns 0 = OK, 1 = error response
+  // (message in *err), 2 = timeout, 3 = connection lost.
+  int Wait(const std::string& name, double timeout_ms, std::string* err,
+           std::string* group) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool got = cv_.wait_for(
+        lk, std::chrono::milliseconds(static_cast<int64_t>(timeout_ms)),
+        [&] { return results_.count(name) || dead_; });
+    if (!got) return 2;
+    if (!results_.count(name)) return dead_ ? 3 : 2;
+    auto res = results_[name];
+    results_.erase(name);
+    if (group) *group = res.second;
+    if (!res.first.empty()) {
+      if (err) *err = res.first;
+      return 1;
+    }
+    return 0;
+  }
+
+  int WaitJoin(double timeout_ms) {
+    std::string err, group;
+    return Wait("join", timeout_ms, &err, &group);
+  }
+
+ private:
+  void ReadLoop() {
+    for (;;) {
+      uint8_t type;
+      std::string payload;
+      if (!RecvMsg(fd_, &type, &payload)) break;
+      if (type != kResponseList) continue;
+      ResponseList rl;
+      if (!ResponseList::Parse(payload.data(), payload.size(), &rl)) continue;
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& resp : rl.responses) {
+        std::string group;
+        for (const auto& n : resp.tensor_names) {
+          if (!group.empty()) group.push_back(';');
+          group += n;
+        }
+        for (const auto& n : resp.tensor_names) {
+          results_[n] = {resp.type == ResponseType::kError
+                             ? resp.error_message
+                             : "",
+                         group};
+        }
+      }
+      cv_.notify_all();
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    dead_ = true;
+    cv_.notify_all();
+  }
+
+  int32_t rank_;
+  int fd_ = -1;
+  bool connected_ = false;
+  std::thread reader_;
+  std::mutex wmu_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  // name → (error_message or "", fused group "a;b;c")
+  std::unordered_map<std::string, std::pair<std::string, std::string>>
+      results_;
+  bool dead_ = false;
+  std::atomic<bool> closing_{false};
+};
+
+}  // namespace hvd
+
+// ----------------------------- C API ---------------------------------------
+extern "C" {
+
+void* hvd_server_start(int port, int nranks, double cycle_ms,
+                       long long fusion_threshold, double stall_warn_sec) {
+  auto* s = new hvd::ControllerServer(port, nranks, cycle_ms,
+                                      fusion_threshold, stall_warn_sec);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int hvd_server_port(void* h) {
+  return static_cast<hvd::ControllerServer*>(h)->port();
+}
+long long hvd_server_cache_hits(void* h) {
+  return static_cast<hvd::ControllerServer*>(h)->cache_hits();
+}
+long long hvd_server_cycles(void* h) {
+  return static_cast<hvd::ControllerServer*>(h)->cycles();
+}
+long long hvd_server_stall_warnings(void* h) {
+  return static_cast<hvd::ControllerServer*>(h)->stall_warnings();
+}
+void hvd_server_stop(void* h) {
+  auto* s = static_cast<hvd::ControllerServer*>(h);
+  s->Stop();
+  delete s;
+}
+
+void* hvd_client_connect(const char* host, int port, int rank) {
+  auto* c = new hvd::ControllerClient(host, port, rank);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int hvd_client_submit(void* h, const char* name, int type, int dtype,
+                      int rank, int root_rank, const long long* shape,
+                      int ndims) {
+  hvd::Request r;
+  r.name = name;
+  r.type = static_cast<hvd::RequestType>(type);
+  r.dtype = static_cast<hvd::DataType>(dtype);
+  r.rank = rank;
+  r.root_rank = root_rank;
+  for (int i = 0; i < ndims; ++i) r.shape.push_back(shape[i]);
+  return static_cast<hvd::ControllerClient*>(h)->Submit(r) ? 0 : -1;
+}
+
+int hvd_client_join(void* h) {
+  return static_cast<hvd::ControllerClient*>(h)->Join() ? 0 : -1;
+}
+
+int hvd_client_wait(void* h, const char* name, double timeout_ms,
+                    char* err_buf, int err_len, char* group_buf,
+                    int group_len) {
+  std::string err, group;
+  int rc = static_cast<hvd::ControllerClient*>(h)->Wait(name, timeout_ms,
+                                                        &err, &group);
+  if (err_buf && err_len > 0) {
+    std::snprintf(err_buf, err_len, "%s", err.c_str());
+  }
+  if (group_buf && group_len > 0) {
+    std::snprintf(group_buf, group_len, "%s", group.c_str());
+  }
+  return rc;
+}
+
+int hvd_client_wait_join(void* h, double timeout_ms) {
+  return static_cast<hvd::ControllerClient*>(h)->WaitJoin(timeout_ms);
+}
+
+void hvd_client_close(void* h) {
+  delete static_cast<hvd::ControllerClient*>(h);
+}
+
+}  // extern "C"

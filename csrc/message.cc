@@ -1,0 +1,73 @@
+// Wire serialization for controller traffic (reference
+// horovod/common/message.cc SerializeToString/ParseFromBytes over
+// FlatBuffers; here a hand-rolled little-endian encoding, see common.h).
+#include "common.h"
+
+namespace hvd {
+
+void Request::Serialize(std::string* out) const {
+  out->push_back(static_cast<char>(type));
+  out->push_back(static_cast<char>(dtype));
+  PutU32(out, static_cast<uint32_t>(rank));
+  PutU32(out, static_cast<uint32_t>(root_rank));
+  PutU32(out, static_cast<uint32_t>(shape.size()));
+  for (int64_t d : shape) PutI64(out, d);
+  PutStr(out, name);
+}
+
+bool Request::Parse(const char* data, size_t len, Request* out) {
+  Cursor c{data, len};
+  out->type = static_cast<RequestType>(c.U8());
+  out->dtype = static_cast<DataType>(c.U8());
+  out->rank = static_cast<int32_t>(c.U32());
+  out->root_rank = static_cast<int32_t>(c.U32());
+  uint32_t nd = c.U32();
+  out->shape.clear();
+  for (uint32_t i = 0; i < nd && c.ok; ++i) out->shape.push_back(c.I64());
+  out->name = c.Str();
+  return c.ok;
+}
+
+void Response::Serialize(std::string* out) const {
+  out->push_back(static_cast<char>(type));
+  PutU32(out, static_cast<uint32_t>(tensor_names.size()));
+  for (const auto& n : tensor_names) PutStr(out, n);
+  PutStr(out, error_message);
+}
+
+bool Response::Parse(const char* data, size_t len, Response* out,
+                     size_t* consumed) {
+  Cursor c{data, len};
+  out->type = static_cast<ResponseType>(c.U8());
+  uint32_t n = c.U32();
+  out->tensor_names.clear();
+  for (uint32_t i = 0; i < n && c.ok; ++i)
+    out->tensor_names.push_back(c.Str());
+  out->error_message = c.Str();
+  if (c.ok && consumed) *consumed = len - c.left;
+  return c.ok;
+}
+
+void ResponseList::Serialize(std::string* out) const {
+  out->push_back(shutdown ? 1 : 0);
+  PutU32(out, static_cast<uint32_t>(responses.size()));
+  for (const auto& r : responses) r.Serialize(out);
+}
+
+bool ResponseList::Parse(const char* data, size_t len, ResponseList* out) {
+  Cursor c{data, len};
+  out->shutdown = c.U8() != 0;
+  uint32_t n = c.U32();
+  out->responses.clear();
+  for (uint32_t i = 0; i < n && c.ok; ++i) {
+    Response r;
+    size_t used = 0;
+    if (!Response::Parse(c.p, c.left, &r, &used)) return false;
+    c.p += used;
+    c.left -= used;
+    out->responses.push_back(std::move(r));
+  }
+  return c.ok;
+}
+
+}  // namespace hvd

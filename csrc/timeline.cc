@@ -1,0 +1,145 @@
+// Native Chrome-trace timeline writer.
+//
+// Re-design of horovod/common/timeline.cc/.h (reference): a dedicated
+// writer thread drains a bounded event ring (reference uses a boost SPSC
+// lock-free queue, timeline.h:68-70; here a fixed-capacity ring guarded by
+// a mutex + condvar — the producers are Python-side dispatch calls, far
+// from any device hot loop) and streams JSON to the per-rank file
+// <dir>/<rank>/comm.json (fork layout, reference timeline.cc:205-228).
+// Step-window semantics (BYTEPS_TRACE_START/END_STEP, reference
+// timeline.cc:30-31,101-144) are enforced by the Python layer, which owns
+// the step counter; this writer just honors Close().
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <thread>
+
+namespace hvd {
+
+struct TimelineEvent {
+  std::string name;
+  std::string cat;
+  std::string tid;
+  char ph;
+  double ts_us;
+  double dur_us;
+  int32_t pid;
+};
+
+class TimelineWriter {
+ public:
+  explicit TimelineWriter(const std::string& path) : path_(path) {
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  ~TimelineWriter() { Close(); }
+
+  void Put(TimelineEvent ev) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (closed_) return;
+      if (q_.size() >= kCapacity) return;  // drop on overflow, never block
+      q_.push_back(std::move(ev));
+    }
+    cv_.notify_one();
+  }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (closed_) return;
+      closed_ = true;
+    }
+    cv_.notify_one();
+    if (thread_.joinable()) thread_.join();
+  }
+
+ private:
+  static constexpr size_t kCapacity = 1 << 16;
+
+  static std::string Escape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char ch : s) {
+      if (ch == '"' || ch == '\\') { out.push_back('\\'); out.push_back(ch); }
+      else if (ch == '\n') out += "\\n";
+      else out.push_back(ch);
+    }
+    return out;
+  }
+
+  void Loop() {
+    FILE* f = std::fopen(path_.c_str(), "w");
+    if (!f) return;
+    std::fputs("[\n", f);
+    bool first = true;
+    for (;;) {
+      std::deque<TimelineEvent> batch;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return closed_ || !q_.empty(); });
+        std::swap(batch, q_);
+        if (batch.empty() && closed_) break;
+      }
+      for (const auto& ev : batch) {
+        if (!first) std::fputs(",\n", f);
+        first = false;
+        std::fprintf(
+            f,
+            "{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"%c\", "
+            "\"ts\": %.3f, \"pid\": %d, \"tid\": \"%s\"",
+            Escape(ev.name).c_str(), Escape(ev.cat).c_str(), ev.ph,
+            ev.ts_us, ev.pid, Escape(ev.tid).c_str());
+        if (ev.ph == 'X') std::fprintf(f, ", \"dur\": %.3f", ev.dur_us);
+        if (ev.ph == 'i') std::fputs(", \"s\": \"g\"", f);
+        std::fputs("}", f);
+      }
+      std::fflush(f);
+    }
+    std::fputs("\n]\n", f);
+    std::fclose(f);
+  }
+
+  std::string path_;
+  std::deque<TimelineEvent> q_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool closed_ = false;
+  std::thread thread_;
+};
+
+}  // namespace hvd
+
+// ----------------------------- C API ---------------------------------------
+extern "C" {
+
+void* hvd_timeline_open(const char* path) {
+  // mkdir -p for the parent (the per-rank directory)
+  std::string p(path);
+  for (size_t i = 1; i < p.size(); ++i) {
+    if (p[i] == '/') {
+      std::string dir = p.substr(0, i);
+      ::mkdir(dir.c_str(), 0755);
+    }
+  }
+  return new hvd::TimelineWriter(p);
+}
+
+void hvd_timeline_event(void* handle, const char* name, const char* cat,
+                        const char* tid, char ph, double ts_us,
+                        double dur_us, int pid) {
+  auto* w = static_cast<hvd::TimelineWriter*>(handle);
+  w->Put(hvd::TimelineEvent{name, cat, tid, ph, ts_us, dur_us, pid});
+}
+
+void hvd_timeline_close(void* handle) {
+  auto* w = static_cast<hvd::TimelineWriter*>(handle);
+  w->Close();
+  delete w;
+}
+
+}  // extern "C"

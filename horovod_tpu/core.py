@@ -192,12 +192,26 @@ def init(
             "initialized: size=%d local_size=%d cross_size=%d platform=%s",
             size, local_size, cross_size, _state.platform,
         )
+        try:
+            from .runtime import eager_controller
+
+            eager_controller.setup_from_env(
+                _state.process_index, _state.process_count
+            )
+        except Exception as e:  # noqa: BLE001
+            log.warning("eager controller setup failed: %s", e)
 
 
 def shutdown() -> None:
     """Tear down state (reference horovod/common/basics.py:67-70 →
     operations.cc ``horovod_shutdown``)."""
     global _state
+    try:
+        from .runtime import eager_controller
+
+        eager_controller.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
     with _lock:
         _state = _GlobalState(epoch=_state.epoch + 1)
 

@@ -38,7 +38,33 @@ from . import core
 from .spmd import put_per_rank, get_per_rank, rank_context
 from .core import Average, Sum, Adasum, Min, Max
 from .ops import collectives
+from .runtime import eager_controller
+from .runtime.stall_inspector import inspector
 from .timeline.timeline import timeline
+
+
+def _dispatch_guard(name: str, op: str, tensors):
+    """Shared pre-dispatch path for eager collectives: stall watchdog +
+    timeline NEGOTIATE span + (in multi-controller jobs) the native
+    controller handshake that guarantees identical op ordering across
+    processes (see runtime/eager_controller.py)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        sample = tensors[0] if _is_per_rank_list(tensors) else tensors
+        shape = np.shape(sample)
+        dtype = getattr(sample, "dtype", "float32")
+        with inspector.watch(name):
+            timeline.negotiate_start(name, op.upper())
+            eager_controller.negotiate(
+                name, op=op, shape=shape, dtype=dtype
+            )
+            timeline.negotiate_end(name, op.upper())
+            with timeline.span(name, op.upper()):
+                yield
+
+    return ctx()
 
 
 def _is_per_rank_list(x) -> bool:
@@ -67,7 +93,7 @@ def allreduce_(tensors, *, op: str = Average, name: Optional[str] = None):
     is the ``synchronize`` step.
     """
     name = name or "allreduce.eager"
-    with timeline.span(name, "ALLREDUCE"):
+    with _dispatch_guard(name, "allreduce", tensors):
         as_list = _is_per_rank_list(tensors)
         x = put_per_rank(list(tensors)) if as_list else tensors
 
@@ -82,7 +108,7 @@ def allreduce_(tensors, *, op: str = Average, name: Optional[str] = None):
 def allgather_(tensors, *, name: Optional[str] = None):
     """Eager allgather along axis 0 (equal shapes).  List-in/list-out."""
     name = name or "allgather.eager"
-    with timeline.span(name, "ALLGATHER"):
+    with _dispatch_guard(name, "allgather", tensors):
         as_list = _is_per_rank_list(tensors)
         x = put_per_rank(list(tensors)) if as_list else tensors
 
@@ -100,7 +126,7 @@ def allgather_(tensors, *, name: Optional[str] = None):
 def broadcast_(tensors, root_rank: int = 0, *, name: Optional[str] = None):
     """Eager broadcast of per-rank values from ``root_rank``."""
     name = name or "broadcast.eager"
-    with timeline.span(name, "BROADCAST"):
+    with _dispatch_guard(name, "broadcast", tensors):
         as_list = _is_per_rank_list(tensors)
         x = put_per_rank(list(tensors)) if as_list else tensors
 

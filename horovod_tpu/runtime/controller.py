@@ -1,0 +1,151 @@
+"""Python interface to the native negotiation controller.
+
+The eager-plane control protocol (see csrc/controller.cc for the design
+rationale and reference citations): worker processes submit named tensors;
+the rank-0 coordinator validates cross-rank agreement, fuses, and
+broadcasts response lists.  In multi-controller deployments this runs
+before each eager XLA collective so all processes issue identical
+collectives in identical order — Horovod's original raison d'être
+(reference controller.h:58-99 protocol doc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import env as env_util
+from . import native
+
+# RequestType / DataType codes must match csrc/common.h.
+REQUEST_TYPES = {
+    "allreduce": 0, "allgather": 1, "broadcast": 2, "join": 3,
+    "adasum": 4, "alltoall": 5,
+}
+_DTYPES = {
+    "float32": 0, "bfloat16": 1, "float16": 2, "float64": 3,
+    "int32": 4, "int64": 5, "uint8": 6, "bool": 7,
+}
+
+
+def _dtype_code(dtype) -> int:
+    return _DTYPES.get(str(np.dtype(dtype) if dtype != "bfloat16" else "bfloat16")
+                       if dtype != "bfloat16" else "bfloat16",
+                       _DTYPES.get(str(dtype), 0))
+
+
+class ControllerServer:
+    """Coordinator (rank 0 owns it; reference: the coordinator role in
+    controller.cc:196-326)."""
+
+    def __init__(self, nranks: int, *, port: int = 0,
+                 cycle_ms: Optional[float] = None,
+                 fusion_threshold: Optional[int] = None,
+                 stall_warn_sec: Optional[float] = None):
+        lib = native.load()
+        self._lib = lib
+        self._h = lib.hvd_server_start(
+            port, nranks,
+            cycle_ms if cycle_ms is not None else env_util.cycle_time_ms(),
+            fusion_threshold if fusion_threshold is not None
+            else env_util.fusion_threshold_bytes(),
+            stall_warn_sec if stall_warn_sec is not None
+            else env_util.get_float(env_util.HVD_STALL_CHECK_TIME_SECONDS,
+                                    env_util.DEFAULT_STALL_WARNING_SECONDS),
+        )
+        if not self._h:
+            raise RuntimeError("failed to start controller server")
+
+    @property
+    def port(self) -> int:
+        return self._lib.hvd_server_port(self._h)
+
+    @property
+    def cache_hits(self) -> int:
+        return self._lib.hvd_server_cache_hits(self._h)
+
+    @property
+    def cycles(self) -> int:
+        return self._lib.hvd_server_cycles(self._h)
+
+    @property
+    def stall_warnings(self) -> int:
+        return self._lib.hvd_server_stall_warnings(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.hvd_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ControllerClient:
+    """Per-process worker client (reference: the worker role,
+    SendReadyTensors/RecvFinalTensors in mpi_controller.cc:107-120)."""
+
+    def __init__(self, host: str, port: int, rank: int):
+        lib = native.load()
+        self._lib = lib
+        self._h = lib.hvd_client_connect(host.encode(), port, rank)
+        if not self._h:
+            raise RuntimeError(f"failed to connect controller {host}:{port}")
+        self.rank = rank
+
+    def submit(self, name: str, *, op: str = "allreduce",
+               shape: Sequence[int] = (), dtype="float32",
+               root_rank: int = 0) -> None:
+        arr = (ctypes.c_longlong * len(shape))(*shape)
+        rc = self._lib.hvd_client_submit(
+            self._h, name.encode(), REQUEST_TYPES[op], _dtype_code(dtype),
+            self.rank, root_rank, arr, len(shape),
+        )
+        if rc != 0:
+            raise RuntimeError("controller submit failed (connection lost)")
+
+    def wait(self, name: str, timeout: float = 60.0) -> List[str]:
+        """Block until `name` is negotiated; returns the fused group (the
+        tensors to execute in one collective).  Raises on error responses
+        (the reference surfaces coordinator ERROR responses as Python
+        exceptions, ops/collective_operations.cc:230-232)."""
+        err = ctypes.create_string_buffer(4096)
+        group = ctypes.create_string_buffer(1 << 16)
+        rc = self._lib.hvd_client_wait(
+            self._h, name.encode(), timeout * 1000.0,
+            err, len(err), group, len(group),
+        )
+        if rc == 0:
+            g = group.value.decode()
+            return g.split(";") if g else [name]
+        if rc == 1:
+            raise RuntimeError(err.value.decode())
+        if rc == 2:
+            raise TimeoutError(f"negotiation of {name!r} timed out")
+        raise ConnectionError("controller connection lost")
+
+    def join(self) -> None:
+        self._lib.hvd_client_join(self._h)
+
+    def wait_join(self, timeout: float = 60.0) -> None:
+        rc = self._lib.hvd_client_wait_join(self._h, timeout * 1000.0)
+        if rc == 2:
+            raise TimeoutError("join timed out")
+        if rc == 3:
+            raise ConnectionError("controller connection lost")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

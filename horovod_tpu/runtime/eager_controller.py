@@ -1,0 +1,96 @@
+"""Process-wide eager-plane controller wiring.
+
+Connects the eager collectives (horovod_tpu/eager.py) to the native
+negotiation controller (runtime/controller.py) in multi-controller
+deployments: before each eager XLA collective, every process submits the
+tensor name/shape/dtype and waits for the coordinator's response — so all
+processes issue identical collectives in identical order (the deadlock /
+mismatch protection that is Horovod's original purpose; reference
+controller.h:58-99).  Single-process jobs skip negotiation entirely — the
+analog of the reference's bypass when the response cache fully covers the
+cycle (controller.cc:164-193).
+
+The launcher (tpurun) selects this with HVD_CONTROLLER=native and points
+workers at the coordinator with HVD_CONTROLLER_ADDR=host:port; process 0
+hosts the server.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import List, Optional, Sequence
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_server = None
+_client = None
+
+
+def setup_from_env(process_id: int, num_processes: int) -> None:
+    """Called from hvd.init().  No-op unless HVD_CONTROLLER=native and the
+    job spans multiple controller processes."""
+    global _server, _client
+    if _client is not None or num_processes <= 1:
+        return
+    if env_util.get_str(env_util.HVD_CONTROLLER) != "native":
+        return
+    addr = env_util.get_str("HVD_CONTROLLER_ADDR")
+    if not addr:
+        log.warning("HVD_CONTROLLER=native but HVD_CONTROLLER_ADDR unset")
+        return
+    host, port_s = addr.rsplit(":", 1)
+    port = int(port_s)
+    from .controller import ControllerClient, ControllerServer
+
+    if process_id == 0:
+        _server = ControllerServer(num_processes, port=port)
+    _client = ControllerClient(host, port, process_id)
+    atexit.register(shutdown)
+    log.info("eager controller active: %s (process %d/%d)",
+             addr, process_id, num_processes)
+
+
+def active() -> bool:
+    return _client is not None
+
+
+def negotiate(name: str, *, op: str, shape: Sequence[int], dtype,
+              root_rank: int = 0, timeout: float = 60.0) -> Optional[List[str]]:
+    """Submit + wait; returns the fused group, or None when negotiation is
+    inactive (single controller)."""
+    if _client is None:
+        return None
+    _client.submit(name, op=op, shape=tuple(int(d) for d in shape),
+                   dtype=str(dtype), root_rank=root_rank)
+    return _client.wait(name, timeout=timeout)
+
+
+def join(timeout: float = 60.0) -> None:
+    if _client is None:
+        return
+    _client.join()
+    _client.wait_join(timeout=timeout)
+
+
+def server_stats() -> Optional[dict]:
+    if _server is None:
+        return None
+    return {
+        "cache_hits": _server.cache_hits,
+        "cycles": _server.cycles,
+        "stall_warnings": _server.stall_warnings,
+    }
+
+
+def shutdown() -> None:
+    global _server, _client
+    if _client is not None:
+        _client.close()
+        _client = None
+    if _server is not None:
+        _server.stop()
+        _server = None

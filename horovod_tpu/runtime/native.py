@@ -1,0 +1,118 @@
+"""ctypes loader for the native runtime core (build/libhvdcore.so).
+
+Analog of horovod/common/basics.py (reference :22-30 loads the compiled
+extension and declares the C ABI) — but instead of a pip-time build, the
+library is compiled on demand from csrc/ with g++ (cached under build/).
+pybind11 isn't assumed; the C ABI + ctypes keeps the binding dependency-free.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_ROOT, "build", "libhvdcore.so")
+_CSRC = os.path.join(_ROOT, "csrc")
+
+_lib: Optional[ctypes.CDLL] = None
+_lock = threading.Lock()
+
+
+def _build() -> None:
+    log.info("building native core: make -C %s", _CSRC)
+    subprocess.run(
+        ["make", "-C", _CSRC, f"OUT={_SO_PATH}"],
+        check=True, capture_output=True,
+    )
+
+
+def _sources_newer() -> bool:
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    for f in os.listdir(_CSRC):
+        if f.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_CSRC, f)) > so_mtime:
+                return True
+    return False
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if stale) and type the C API."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _sources_newer():
+            _build()
+        lib = ctypes.CDLL(_SO_PATH)
+
+        # timeline
+        lib.hvd_timeline_open.restype = ctypes.c_void_p
+        lib.hvd_timeline_open.argtypes = [ctypes.c_char_p]
+        lib.hvd_timeline_event.restype = None
+        lib.hvd_timeline_event.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int,
+        ]
+        lib.hvd_timeline_close.restype = None
+        lib.hvd_timeline_close.argtypes = [ctypes.c_void_p]
+
+        # controller server
+        lib.hvd_server_start.restype = ctypes.c_void_p
+        lib.hvd_server_start.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_longlong, ctypes.c_double,
+        ]
+        lib.hvd_server_port.restype = ctypes.c_int
+        lib.hvd_server_port.argtypes = [ctypes.c_void_p]
+        for fn in ("hvd_server_cache_hits", "hvd_server_cycles",
+                   "hvd_server_stall_warnings"):
+            getattr(lib, fn).restype = ctypes.c_longlong
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.hvd_server_stop.restype = None
+        lib.hvd_server_stop.argtypes = [ctypes.c_void_p]
+
+        # controller client
+        lib.hvd_client_connect.restype = ctypes.c_void_p
+        lib.hvd_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.hvd_client_submit.restype = ctypes.c_int
+        lib.hvd_client_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ]
+        lib.hvd_client_join.restype = ctypes.c_int
+        lib.hvd_client_join.argtypes = [ctypes.c_void_p]
+        lib.hvd_client_wait.restype = ctypes.c_int
+        lib.hvd_client_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.hvd_client_wait_join.restype = ctypes.c_int
+        lib.hvd_client_wait_join.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.hvd_client_close.restype = None
+        lib.hvd_client_close.argtypes = [ctypes.c_void_p]
+
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except Exception as e:  # noqa: BLE001
+        log.warning("native core unavailable: %s", e)
+        return False

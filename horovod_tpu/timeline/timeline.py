@@ -83,6 +83,46 @@ class _Writer:
         self._closed.set()
 
 
+class _NativeWriter:
+    """Adapter over the C++ writer thread (csrc/timeline.cc) — the native
+    path, used when build/libhvdcore.so is available; same file format."""
+
+    def __init__(self, path: str):
+        from ..runtime import native
+
+        self._lib = native.load()
+        self._h = self._lib.hvd_timeline_open(path.encode())
+        if not self._h:
+            raise RuntimeError(f"native timeline open failed: {path}")
+
+    def put(self, ev: dict) -> None:
+        if self._h:
+            self._lib.hvd_timeline_event(
+                self._h, str(ev.get("name", "")).encode(),
+                str(ev.get("cat", "")).encode(),
+                str(ev.get("tid", "")).encode(),
+                str(ev.get("ph", "X")).encode()[:1],
+                float(ev.get("ts", 0.0)), float(ev.get("dur", 0.0)),
+                int(ev.get("pid", 0)),
+            )
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_timeline_close(self._h)
+            self._h = None
+
+
+def _make_writer(path: str):
+    """Prefer the native writer; fall back to the Python thread
+    (HVD_TIMELINE_PYTHON=1 forces the fallback)."""
+    if not env_util.get_bool("HVD_TIMELINE_PYTHON"):
+        try:
+            return _NativeWriter(path)
+        except Exception as e:  # noqa: BLE001
+            log.debug("native timeline unavailable (%s); python fallback", e)
+    return _Writer(path)
+
+
 class Timeline:
     """Process-wide timeline recorder; one writer per controller process,
     pid field = rank so merged traces line up per-rank."""
@@ -107,7 +147,7 @@ class Timeline:
         path = os.path.join(directory, str(rank), "comm.json")
         with self._lock:
             if self._writer is None:
-                self._writer = _Writer(path)
+                self._writer = _make_writer(path)
                 log.debug("timeline → %s", path)
 
     def shutdown(self) -> None:

@@ -1,0 +1,167 @@
+"""Native (C++) runtime core: controller negotiation protocol, response
+cache, stall warnings, Join, duplicate/mismatch errors, timeline writer.
+Protocol semantics mirror reference controller.cc / tensor_queue.cc /
+response_cache.cc / stall_inspector.cc behaviors (see csrc/controller.cc)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runtime import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core failed to build"
+)
+
+
+@pytest.fixture()
+def server():
+    from horovod_tpu.runtime.controller import ControllerServer
+
+    s = ControllerServer(2, cycle_ms=2.0, fusion_threshold=1 << 20,
+                         stall_warn_sec=0.2)
+    yield s
+    s.stop()
+
+
+def _client(server, rank):
+    from horovod_tpu.runtime.controller import ControllerClient
+
+    return ControllerClient("127.0.0.1", server.port, rank)
+
+
+def test_negotiation_completes_when_all_ranks_submit(server):
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        c0.submit("grad.w", shape=(4, 4), dtype="float32")
+        # not ready yet: only one rank has submitted
+        with pytest.raises(TimeoutError):
+            c0.wait("grad.w", timeout=0.15)
+        c1.submit("grad.w", shape=(4, 4), dtype="float32")
+        assert c0.wait("grad.w", timeout=5) == ["grad.w"]
+        assert c1.wait("grad.w", timeout=5) == ["grad.w"]
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_shape_mismatch_is_error(server):
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        c0.submit("grad.x", shape=(4,), dtype="float32")
+        c1.submit("grad.x", shape=(5,), dtype="float32")
+        with pytest.raises(RuntimeError, match="Mismatched"):
+            c0.wait("grad.x", timeout=5)
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_dtype_mismatch_is_error(server):
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        c0.submit("grad.y", shape=(4,), dtype="float32")
+        c1.submit("grad.y", shape=(4,), dtype="int32")
+        with pytest.raises(RuntimeError, match="Mismatched"):
+            c1.wait("grad.y", timeout=5)
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_duplicate_submission_is_error(server):
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        c0.submit("grad.z", shape=(4,))
+        c0.submit("grad.z", shape=(4,))
+        c1.submit("grad.z", shape=(4,))
+        with pytest.raises(RuntimeError, match="Duplicate"):
+            c0.wait("grad.z", timeout=5)
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_response_cache_hits(server):
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        for _ in range(3):
+            c0.submit("grad.c", shape=(8,))
+            c1.submit("grad.c", shape=(8,))
+            c0.wait("grad.c", timeout=5)
+            c1.wait("grad.c", timeout=5)
+        assert server.cache_hits >= 2
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_join_counts_for_missing_rank(server):
+    """A joined rank participates implicitly (reference
+    controller.cc:253-264): rank 1 joins, rank 0's tensors negotiate."""
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        c1.join()
+        c0.submit("grad.j", shape=(4,))
+        assert c0.wait("grad.j", timeout=5) == ["grad.j"]
+        # once rank 0 also joins, JOIN response fires on both
+        c0.join()
+        c0.wait_join(timeout=5)
+        c1.wait_join(timeout=5)
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_stall_warning_counted(server):
+    c0 = _client(server, 0)
+    try:
+        c0.submit("grad.stall", shape=(4,))
+        time.sleep(0.6)  # > stall_warn_sec=0.2
+        assert server.stall_warnings >= 1
+    finally:
+        c0.close()
+
+
+def test_concurrent_many_tensors(server):
+    """Fusion/ordering stress: 50 tensors submitted in different orders by
+    the two ranks all negotiate (reference fusion stress
+    test_torch.py:237)."""
+    c0, c1 = _client(server, 0), _client(server, 1)
+    names = [f"grad.{i}" for i in range(50)]
+    try:
+        def submit(client, order):
+            for n in order:
+                client.submit(n, shape=(16,))
+
+        t0 = threading.Thread(target=submit, args=(c0, names))
+        t1 = threading.Thread(target=submit, args=(c1, list(reversed(names))))
+        t0.start(); t1.start(); t0.join(); t1.join()
+        for n in names:
+            g0 = c0.wait(n, timeout=10)
+            assert n in g0
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_native_timeline_writer(tmp_path):
+    lib = native.load()
+    path = str(tmp_path / "3" / "comm.json").encode()
+    h = lib.hvd_timeline_open(path)
+    assert h
+    lib.hvd_timeline_event(h, b"ALLREDUCE", b"allreduce.g", b"t0", b"X",
+                           100.0, 50.0, 3)
+    lib.hvd_timeline_event(h, b"CYCLE_START", b"", b"cycle", b"i",
+                           200.0, 0.0, 3)
+    lib.hvd_timeline_close(h)
+    events = json.loads((tmp_path / "3" / "comm.json").read_text())
+    assert events[0]["name"] == "ALLREDUCE"
+    assert events[0]["dur"] == 50.0
+    assert events[1]["ph"] == "i"
+    assert events[0]["pid"] == 3
