@@ -1,0 +1,163 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no model or attention code (SURVEY §5: "long-context /
+sequence parallelism: absent"), but its communication shapes are exactly
+what ring attention is built on — the reduce-scatter/allgather
+decomposition of hierarchical allreduce (reference
+nccl_operations.cc:241-246) and Adasum's distance-doubling exchanges
+(adasum/adasum.h:167-195).  This module adds the long-context layer the
+TPU build treats as first-class, on the same collective backend:
+
+* :func:`ring_attention` — blockwise attention with the K/V shards rotating
+  around the ring via ``lax.ppermute`` (one hop per step, rides ICI
+  neighbor links), accumulating with an online-softmax (the
+  numerically-stable streaming form), so sequence length scales linearly
+  with rank count while activation memory stays per-shard.  Causal masking
+  is applied from global block positions.
+* :func:`ulysses_attention` — the all-to-all alternative: switch from
+  sequence-sharded to head-sharded with one ``all_to_all``, run full local
+  attention per head group, and switch back.  Cheaper for moderate
+  sequence lengths when head count ≥ ranks.
+
+Both run inside ``hvd.spmd`` regions on the flat mesh axis and compose
+with the data-parallel dimension by using a 2-D (dp, sp) mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import core
+
+
+def _axis():
+    axes = core._spmd_axes()
+    if axes is None:
+        raise RuntimeError("ring attention must run inside an SPMD region")
+    if len(axes) != 1:
+        raise NotImplementedError("ring attention over hierarchical mesh")
+    return axes[0]
+
+
+def _block_attn(q, k, v, *, scale, mask=None):
+    """One q-block × kv-block partial attention, returning the streaming
+    triple (unnormalized out, row max, row sumexp) in f32."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                       # [b,h,q]
+    # guard fully-masked rows (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)                       # [b,h,q]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m_safe, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two streaming-softmax partials (flash-attention combine)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    # broadcast [b,h,q] → [b,q,h,1]
+    b1 = jnp.transpose(a1, (0, 2, 1))[..., None]
+    b2 = jnp.transpose(a2, (0, 2, 1))[..., None]
+    o = o1 * b1 + o2 * b2
+    return o, m, l
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention over a sequence sharded across ranks.
+
+    Args:
+      q, k, v: per-rank shards ``[batch, seq_local, heads, head_dim]``;
+        global sequence = ``seq_local * size()``, shard r owns positions
+        ``[r*seq_local, (r+1)*seq_local)``.
+      causal: apply causal masking in *global* positions.
+      scale: logit scale; default ``1/sqrt(head_dim)``.
+
+    Returns the attention output for the local q shard, same shape/dtype
+    as ``q``.
+    """
+    axis = _axis()
+    n = core.size()
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    seq_local = q.shape[1]
+    my = lax.axis_index(axis)
+
+    # neighbor ring: step s receives the kv block originally on rank
+    # (my - 1 - ...) — we rotate kv by one hop each iteration.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def causal_mask(kv_owner):
+        if not causal:
+            return None
+        q_pos = my * seq_local + jnp.arange(seq_local)          # [q]
+        k_pos = kv_owner * seq_local + jnp.arange(seq_local)    # [k]
+        return (q_pos[:, None] >= k_pos[None, :])[None, None]   # [1,1,q,k]
+
+    def body(carry, _):
+        o, m, l, kc, vc, owner = carry
+        po, pm, pl = _block_attn(q, kc, vc, scale=scale,
+                                 mask=causal_mask(owner))
+        o, m, l = _merge(o, m, l, po, pm, pl)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        owner = (owner - 1) % n
+        return (o, m, l, kc, vc, owner), None
+
+    o0 = jnp.zeros(q.shape[:1] + q.shape[1:], jnp.float32)
+    m0 = jnp.full((q.shape[0], q.shape[2], seq_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], seq_local), jnp.float32)
+
+    (o, m, l, _, _, _), _ = lax.scan(
+        body, (o0, m0, l0, k, v, my), None, length=n
+    )
+    denom = jnp.transpose(l, (0, 2, 1))[..., None]
+    return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all ("Ulysses") sequence parallelism.
+
+    Per-rank inputs ``[batch, seq_local, heads, head_dim]`` with
+    ``heads % size() == 0``: one all_to_all reshards to
+    ``[batch, seq_global, heads/size, head_dim]``, full attention runs
+    locally on the head subset, and a second all_to_all restores sequence
+    sharding.
+    """
+    axis = _axis()
+    n = core.size()
+    b, s_local, h, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by ranks {n}")
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+
+    def to_heads(x):
+        # split heads across ranks, gather sequence
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [b, s_g, h/n, d]
+    sg = qh.shape[1]
+    sl = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(sg)
+        sl = jnp.where((pos[:, None] >= pos[None, :])[None, None], sl,
+                       -jnp.inf)
+    p = jax.nn.softmax(sl, axis=-1).astype(vh.dtype)
+    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return to_seq(oh).astype(q.dtype)

@@ -1,0 +1,102 @@
+"""Sequence-parallel attention correctness: ring and Ulysses forms vs a
+single-device full-attention oracle (numpy, f64)."""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+
+
+def _full_attention(q, k, v, causal=False):
+    """numpy oracle in float64."""
+    q, k, v = (x.astype(np.float64) for x in (q, k, v))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        L = s.shape[-1]
+        mask = np.tril(np.ones((L, L), bool))
+        s = np.where(mask[None, None], s, -np.inf)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _shards(rng, b=2, s_local=4, h=8, d=16, n=8):
+    q = rng.normal(size=(b, s_local * n, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s_local * n, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s_local * n, h, d)).astype(np.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(hvd_init, rng, causal):
+    q, k, v = _shards(rng)
+
+    @hvd.spmd(in_specs=(P(None, hvd.AXIS), P(None, hvd.AXIS),
+                        P(None, hvd.AXIS)),
+              out_specs=P(None, hvd.AXIS))
+    def step(q, k, v):
+        return ring_attention(q, k, v, causal=causal)
+
+    out = np.asarray(step(q, k, v))
+    expected = _full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, expected, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(hvd_init, rng, causal):
+    q, k, v = _shards(rng)
+
+    @hvd.spmd(in_specs=(P(None, hvd.AXIS), P(None, hvd.AXIS),
+                        P(None, hvd.AXIS)),
+              out_specs=P(None, hvd.AXIS))
+    def step(q, k, v):
+        return ulysses_attention(q, k, v, causal=causal)
+
+    out = np.asarray(step(q, k, v))
+    expected = _full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_attention_long_sequence_scales(hvd_init, rng):
+    # 8 ranks x 32 local = 256 global positions, 1 head
+    q, k, v = _shards(rng, b=1, s_local=32, h=2, d=8)
+
+    @hvd.spmd(in_specs=(P(None, hvd.AXIS),) * 3, out_specs=P(None, hvd.AXIS))
+    def step(q, k, v):
+        return ring_attention(q, k, v, causal=True)
+
+    out = np.asarray(step(q, k, v))
+    expected = _full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_bert_with_ring_attention(hvd_init, rng):
+    """The model hook: BertEncoder(attention_fn=ring wrapper) runs under
+    sequence sharding."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.bert import bert_tiny
+
+    def ring_fn(q, k, v, mask):
+        return ring_attention(q, k, v, causal=False)
+
+    model = bert_tiny(dtype=jnp.float32, attention_fn=ring_fn)
+    ids = rng.integers(0, 1024, size=(2, 64)).astype(np.int32)
+
+    # init on a single device with the plain model shape
+    variables = bert_tiny(dtype=jnp.float32).init(jax.random.PRNGKey(0), ids)
+
+    @hvd.spmd(in_specs=(P(), P(None, hvd.AXIS)), out_specs=P(None, hvd.AXIS))
+    def fwd(vars_, ids_shard):
+        return model.apply(vars_, ids_shard)
+
+    # note: position embeddings are per-shard-local here; this test checks
+    # execution + finiteness of the sequence-sharded path, not equivalence
+    out = np.asarray(fwd(variables, ids))
+    assert out.shape == (2, 64, 128)
+    assert np.isfinite(out).all()
