@@ -1,0 +1,289 @@
+"""horovod_tpu.torch: the PyTorch-flavored API surface.
+
+Mirror of horovod/torch (reference horovod/torch/__init__.py,
+torch/mpi_ops.py): ``allreduce[_async_]``, ``allgather``, ``broadcast``,
+``synchronize``/``poll`` handles, ``DistributedOptimizer`` with
+``backward_passes_per_step``, ``broadcast_parameters`` /
+``broadcast_optimizer_state``, Compression.
+
+Architecture: the reference routes torch tensors through a C++ extension
+(mpi_ops_v2.cc) into the background-thread/NCCL stack; here torch tensors
+bridge to the XLA data plane via zero-ceremony numpy interchange and the
+eager SPMD programs (horovod_tpu/eager.py), with a ``HandleManager``
+mirroring the v2 handle API (reference torch/handle_manager.cc,
+mpi_ops.py:72-75).  On this image torch is CPU-only, so the device hop is
+host→TPU→host per call — the *contract* (hooks, handles, in-place
+semantics) is what this module preserves; torch-on-TPU compute would ride
+torch-xla, which is out of scope for the runtime (SURVEY §7.3(4)).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import core, eager
+from ..core import Average, Sum, Adasum, Min, Max  # noqa: F401
+from ..ops.compression import Compression  # noqa: F401
+
+init = core.init
+shutdown = core.shutdown
+rank = core.rank
+local_rank = core.local_rank
+size = core.size
+local_size = core.local_size
+cross_rank = core.cross_rank
+cross_size = core.cross_size
+is_initialized = core.is_initialized
+mpi_enabled = core.mpi_enabled
+nccl_built = core.nccl_built
+
+
+class HandleManager:
+    """Async-op handle registry (reference torch/handle_manager.cc:
+    AllocateHandle/MarkDone/PollHandle/WaitForCompletion + the outputs
+    map in torch/mpi_ops.py:72-75)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Any] = {}
+        self._done: Dict[int, bool] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._done[h] = False
+            return h
+
+    def mark_done(self, handle: int, result: Any) -> None:
+        with self._lock:
+            self._results[handle] = result
+            self._done[handle] = True
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            return self._done.get(handle, False)
+
+    def wait(self, handle: int) -> Any:
+        # JAX dispatch is async under the hood; by the time we store the
+        # result it is a future — materialize here (the "synchronize").
+        with self._lock:
+            if handle not in self._done:
+                raise ValueError(f"unknown handle {handle}")
+            result = self._results.pop(handle)
+            del self._done[handle]
+        return result
+
+
+_handles = HandleManager()
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    if hasattr(tensor, "detach"):
+        return tensor.detach().cpu().numpy()
+    return np.asarray(tensor)
+
+
+def _like(tensor, arr: np.ndarray):
+    if hasattr(tensor, "detach"):
+        import torch as th
+
+        return th.from_numpy(np.ascontiguousarray(arr)).to(tensor.dtype)
+    return arr
+
+
+def _eager_collective(fn, tensor, *fn_args, **fn_kw):
+    """Run a host-plane collective on one per-process tensor.  With a
+    single controller the process IS every rank's controller, so the
+    reduction is the identity family; multi-process goes through the
+    process-plane collectives (eager.py)."""
+    arr = _to_numpy(tensor)
+    return fn(arr, *fn_args, **fn_kw)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None):
+    """reference torch/mpi_ops.py:94-129 (op/average normalization and the
+    divisor trick: Average → Sum + divide)."""
+    op = _normalize_op(average, op)
+    h = _handles.allocate()
+
+    arr = _to_numpy(tensor)
+    if core.process_size() == 1:
+        out = arr if op != Sum else arr * core.process_size()
+    else:
+        gathered = eager.allgather_object(arr)
+        stacked = np.stack(gathered)
+        out = stacked.mean(0) if op == Average else stacked.sum(0)
+    _handles.mark_done(h, _like(tensor, out))
+    return h
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              compression=Compression.none):
+    return synchronize(allreduce_async(tensor, average, name, op))
+
+
+def allreduce_(tensor, average=None, name=None, op=None):
+    """In-place variant (reference mpi_ops.py allreduce_)."""
+    out = allreduce(tensor, average, name, op)
+    if hasattr(tensor, "copy_"):
+        tensor.copy_(out)
+        return tensor
+    tensor[...] = out
+    return tensor
+
+
+def allgather_async(tensor, name=None):
+    h = _handles.allocate()
+    arr = _to_numpy(tensor)
+    if core.process_size() == 1:
+        out = arr
+    else:
+        out = np.concatenate(eager.allgather_object(arr), axis=0)
+    _handles.mark_done(h, _like(tensor, out))
+    return h
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank, name=None):
+    h = _handles.allocate()
+    arr = _to_numpy(tensor)
+    out = eager.broadcast_object(arr, root_rank=root_rank) \
+        if core.process_size() > 1 else arr
+    _handles.mark_done(h, _like(tensor, out))
+    return h
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor, root_rank, name=None):
+    out = broadcast(tensor, root_rank, name)
+    if hasattr(tensor, "copy_"):
+        tensor.copy_(out)
+        return tensor
+    tensor[...] = out
+    return tensor
+
+
+def poll(handle: int) -> bool:
+    return _handles.poll(handle)
+
+
+def synchronize(handle: int):
+    return _handles.wait(handle)
+
+
+def join() -> int:
+    from ..elastic.join import join as _join
+
+    return _join()
+
+
+def _normalize_op(average, op):
+    """reference mpi_ops.py handle_average_backwards_compatibility."""
+    if average is not None and op is not None:
+        raise ValueError("cannot specify both average and op")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+# ---------------------------------------------------------------------------
+# optimizer + parameter sync
+# ---------------------------------------------------------------------------
+class _DistributedOptimizer:
+    """Wraps a torch.optim.Optimizer: allreduce each parameter gradient
+    before step() (reference torch/__init__.py:122-217; the per-parameter
+    backward hooks collapse to a pre-step sweep here because the host
+    collective is synchronous — overlap belongs to the compiled plane)."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1, op=Average):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        self._counter = 0
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+    def synchronize(self) -> None:
+        """Allreduce all gradients now (reference torch/__init__.py:159-176
+        synchronize())."""
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if getattr(p, "grad", None) is not None:
+                    g = p.grad
+                    comp, ctx = self._compression.compress(_to_numpy(g))
+                    if core.process_size() > 1:
+                        gathered = eager.allgather_object(np.asarray(comp))
+                        stacked = np.stack(gathered)
+                        red = stacked.mean(0) if self._op == Average \
+                            else stacked.sum(0)
+                    else:
+                        red = np.asarray(comp)
+                    red = self._compression.decompress(red, ctx)
+                    if hasattr(g, "copy_"):
+                        import torch as th
+
+                        g.copy_(th.from_numpy(
+                            np.ascontiguousarray(red)).to(g.dtype))
+                    else:
+                        g[...] = red
+
+    def step(self, closure=None):
+        self._counter += 1
+        if self._counter % self.backward_passes_per_step == 0:
+            self.synchronize()
+            return self._opt.step(closure)
+        return None
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=Average):
+    return _DistributedOptimizer(
+        optimizer, named_parameters, compression,
+        backward_passes_per_step, op,
+    )
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place parameter broadcast (reference torch/__init__.py:446-478;
+    accepts a state_dict or an iterable of (name, tensor))."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    for _, p in items:
+        if hasattr(p, "copy_"):
+            broadcast_(p, root_rank)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """reference torch/__init__.py:480-578: walk optimizer.state_dict(),
+    broadcast every tensor entry, scalars via broadcast_object."""
+    state = optimizer.state_dict()
+    synced = eager.broadcast_object(state, root_rank=root_rank) \
+        if core.process_size() > 1 else state
+    optimizer.load_state_dict(synced)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None):
+    return eager.broadcast_object(obj, root_rank=root_rank)

@@ -1,0 +1,117 @@
+"""horovod_tpu.torch API surface — modeled on reference test/test_torch.py
+(handles/poll/synchronize :237, optimizer state broadcast :911-1046,
+in-place ops)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd_torch  # noqa: E402
+
+
+@pytest.fixture()
+def torch_init(cpu_devices):
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(devices=cpu_devices, local_size=4)
+    yield hvd_torch
+    hvd.shutdown()
+
+
+def test_rank_size(torch_init):
+    assert hvd_torch.size() == 8
+    assert hvd_torch.is_initialized()
+
+
+def test_allreduce_single_process_average(torch_init):
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvd_torch.allreduce(t)
+    assert torch.allclose(out, t)  # single controller: mean of itself
+
+
+def test_allreduce_op_normalization(torch_init):
+    t = torch.ones(3)
+    with pytest.raises(ValueError):
+        hvd_torch.allreduce(t, average=True, op=hvd_torch.Sum)
+    out = hvd_torch.allreduce(t, average=False)  # Sum
+    assert torch.allclose(out, torch.ones(3))
+
+
+def test_async_handle_poll_synchronize(torch_init):
+    t = torch.ones(4)
+    h = hvd_torch.allreduce_async(t)
+    assert hvd_torch.poll(h)
+    out = hvd_torch.synchronize(h)
+    assert torch.allclose(out, t)
+    with pytest.raises(ValueError):
+        hvd_torch.synchronize(h)  # handle consumed
+
+
+def test_inplace_allreduce(torch_init):
+    t = torch.full((3,), 2.0)
+    r = hvd_torch.allreduce_(t)
+    assert r is t
+    assert torch.allclose(t, torch.full((3,), 2.0))
+
+
+def test_broadcast_inplace(torch_init):
+    t = torch.zeros(3)
+    hvd_torch.broadcast_(t, root_rank=0)
+    assert torch.allclose(t, torch.zeros(3))
+
+
+def test_distributed_optimizer_step(torch_init):
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd_torch.DistributedOptimizer(opt)
+    x = torch.randn(8, 4)
+    y = torch.randn(8, 2)
+    before = [p.detach().clone() for p in model.parameters()]
+    loss = torch.nn.functional.mse_loss(model(x), y)
+    opt.zero_grad()
+    loss.backward()
+    opt.step()
+    after = list(model.parameters())
+    assert any(not torch.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_backward_passes_per_step(torch_init):
+    model = torch.nn.Linear(2, 1)
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        backward_passes_per_step=2,
+    )
+    x = torch.randn(4, 2)
+    before = [p.detach().clone() for p in model.parameters()]
+    loss = model(x).sum()
+    loss.backward()
+    opt.step()  # accumulating: parameters must not move
+    after_first = [p.detach().clone() for p in model.parameters()]
+    assert all(torch.allclose(b, a) for b, a in zip(before, after_first))
+    loss = model(x).sum()
+    loss.backward()
+    opt.step()  # sync step: parameters move
+    after_second = list(model.parameters())
+    assert any(not torch.allclose(b, a)
+               for b, a in zip(before, after_second))
+
+
+def test_broadcast_parameters_state_dict(torch_init):
+    model = torch.nn.Linear(3, 3)
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+def test_broadcast_optimizer_state(torch_init):
+    model = torch.nn.Linear(3, 3)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    model(torch.randn(2, 3)).sum().backward()
+    opt.step()
+    hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+
+
+def test_compression_roundtrip(torch_init):
+    t = torch.randn(16)
+    out = hvd_torch.allreduce(t, compression=hvd_torch.Compression.fp16)
+    assert out.dtype == t.dtype
