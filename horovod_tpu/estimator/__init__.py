@@ -1,0 +1,2 @@
+from .store import Store, LocalStore  # noqa: F401
+from .estimator import Estimator, EstimatorModel  # noqa: F401

@@ -1,0 +1,162 @@
+"""High-level Estimator: fit a flax model over the mesh, checkpoint to a
+Store, return a servable model.
+
+Re-design of the Spark estimator slice (reference
+horovod/spark/common/estimator.py + spark/keras/estimator.py /
+spark/torch/estimator.py: a Spark ML ``Estimator`` whose ``fit(df)`` runs
+``horovod.spark.run`` training with data and checkpoints in the ``Store``,
+returning a Spark ML ``Model``).  TPU translation: the cluster scheduler
+role Spark played is the ``tpurun`` launcher; data arrives as arrays (or a
+ShardedLoader), checkpoints round-trip through the Store (local FS or GCS
+prefix), and the returned :class:`EstimatorModel` serves predictions with
+the trained params — same shape: estimator.fit(data) → model.predict.
+
+Checkpoint format: msgpack-free pickle of the param pytree (orbax is
+available for production use; pickle keeps the Store interface trivially
+portable).  Rank-0-writes semantics (reference: checkpoint callbacks gated
+on rank 0, examples/keras_mnist.py) apply in multi-controller runs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import core
+from ..core import Average
+from ..ops.compression import Compression
+from ..training import TrainState, init_train_state, make_train_step
+from ..data.loader import ShardedLoader
+from ..utils.logging import get_logger
+from .store import Store
+
+log = get_logger(__name__)
+
+
+class EstimatorModel:
+    """The fitted artifact (reference spark/common/estimator.py Model
+    counterpart): holds params + apply_fn, serves predict(), reloadable
+    from a Store checkpoint."""
+
+    def __init__(self, model, params, model_state=None):
+        self.model = model
+        self.params = params
+        self.model_state = model_state or {}
+
+    def predict(self, x) -> np.ndarray:
+        variables = {"params": self.params, **self.model_state}
+        kw = {}
+        if self.model_state:
+            kw["train"] = False
+        out = self.model.apply(variables, jnp.asarray(x), **kw)
+        return np.asarray(jax.device_get(out))
+
+    def save(self, store: Store, run_id: str, name: str = "model.ckpt"):
+        path = os.path.join(store.get_checkpoint_path(run_id), name)
+        store.save_obj(path, {
+            "params": jax.device_get(self.params),
+            "model_state": jax.device_get(self.model_state),
+        })
+        return path
+
+    @classmethod
+    def load(cls, store: Store, run_id: str, model,
+             name: str = "model.ckpt") -> "EstimatorModel":
+        path = os.path.join(store.get_checkpoint_path(run_id), name)
+        blob = store.load_obj(path)
+        return cls(model, blob["params"], blob["model_state"])
+
+
+class Estimator:
+    """fit(x, y) → EstimatorModel (reference KerasEstimator/TorchEstimator
+    parameter names kept where they transfer: store, model, optimizer,
+    loss, batch_size, epochs, callbacks, run_id)."""
+
+    def __init__(
+        self,
+        *,
+        model,
+        optimizer,
+        loss: Callable,
+        store: Optional[Store] = None,
+        batch_size: int = 32,
+        epochs: int = 1,
+        callbacks: Optional[list] = None,
+        run_id: Optional[str] = None,
+        compression=Compression.none,
+        op: str = Average,
+        has_batch_stats: bool = False,
+        sample_input_shape: Optional[tuple] = None,
+        shuffle: bool = True,
+        verbose: int = 1,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.store = store
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.callbacks = callbacks or []
+        self.run_id = run_id or f"run_{int(time.time())}"
+        self.compression = compression
+        self.op = op
+        self.has_batch_stats = has_batch_stats
+        self.sample_input_shape = sample_input_shape
+        self.shuffle = shuffle
+        self.verbose = verbose
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> EstimatorModel:
+        if not core.is_initialized():
+            core.init()
+
+        sample_shape = self.sample_input_shape or (2,) + tuple(x.shape[1:])
+        step = make_train_step(
+            apply_fn=self.model.apply,
+            loss_fn=self.loss,
+            optimizer=self.optimizer,
+            op=self.op,
+            compression=self.compression,
+            has_batch_stats=self.has_batch_stats,
+        )
+        state = init_train_state(
+            self.model, self.optimizer, jnp.zeros(sample_shape, x.dtype),
+            has_batch_stats=self.has_batch_stats,
+        )
+        for cb in self.callbacks:
+            state = cb.on_train_begin(state) or state
+
+        loader = ShardedLoader(
+            x, y, batch_size=self.batch_size, shuffle=self.shuffle,
+            drop_remainder=True,
+        )
+        history = []
+        for epoch in range(self.epochs):
+            losses = []
+            for batch in loader:
+                xb, yb, _active = batch
+                state, loss = step(state, xb, yb)
+                losses.append(loss)
+            metrics = {
+                "loss": float(np.mean([
+                    np.asarray(jax.device_get(l)) for l in losses
+                ]))
+            }
+            for cb in self.callbacks:
+                metrics = cb.on_epoch_end(epoch, state, metrics) or metrics
+            history.append(metrics)
+            if self.verbose and core.rank() == 0:
+                log.info("epoch %d: %s", epoch, metrics)
+
+        fitted = EstimatorModel(
+            self.model, state.params, state.model_state
+        )
+        fitted.history = history
+        if self.store is not None and core.rank() == 0:
+            fitted.save(self.store, self.run_id)
+        return fitted

@@ -1,0 +1,80 @@
+"""Store: persistent storage abstraction for estimator data/checkpoints.
+
+Mirror of horovod/spark/common/store.py (reference): a ``Store`` exposes
+train-data, checkpoint, and run-output locations plus filesystem helpers;
+``LocalStore`` is the local-FS implementation (reference LocalStore; the
+HDFS variant maps to GCS/fuse mounts on TPU VMs — same interface, prefix
+swap)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+from typing import Any, Optional
+
+
+class Store:
+    """Interface (reference spark/common/store.py Store)."""
+
+    def get_train_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """Factory (reference Store.create dispatches on URL scheme)."""
+        return LocalStore(prefix_path)
+
+
+class LocalStore(Store):
+    def __init__(self, prefix_path: str):
+        self.prefix = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def _sub(self, run_id: str, name: str) -> str:
+        p = os.path.join(self.prefix, run_id, name)
+        os.makedirs(p, exist_ok=True)
+        return p
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return self._sub(run_id, "train_data")
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._sub(run_id, "checkpoints")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._sub(run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def save_obj(self, path: str, obj: Any) -> None:
+        self.write(path, pickle.dumps(obj))
+
+    def load_obj(self, path: str) -> Any:
+        return pickle.loads(self.read(path))

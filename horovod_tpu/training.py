@@ -62,7 +62,7 @@ def make_train_step(
                     variables, x, train=True, mutable=["batch_stats"]
                 )
                 return loss_fn(logits, y), updates
-            logits = apply_fn(variables, x, train=True)
+            logits = apply_fn(variables, x)
             return loss_fn(logits, y), {}
 
         (loss, new_model_state), grads = jax.value_and_grad(

@@ -1,0 +1,85 @@
+"""Estimator + Store — modeled on reference test/test_spark_keras.py /
+test_spark_torch.py (end-to-end local estimator fit with a temp Store) and
+spark_common.py fakes."""
+
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.estimator import Estimator, EstimatorModel, LocalStore, Store
+from horovod_tpu.models.mlp import MLP
+
+
+def _toy_problem(rng, n=64):
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _loss(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels
+    ).mean()
+
+
+def test_store_paths_and_io(tmp_path):
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, LocalStore)
+    p = store.get_checkpoint_path("run1")
+    assert store.exists(p)
+    store.write(p + "/blob.bin", b"abc")
+    assert store.read(p + "/blob.bin") == b"abc"
+    store.save_obj(p + "/obj.pkl", {"a": 1})
+    assert store.load_obj(p + "/obj.pkl") == {"a": 1}
+
+
+def test_estimator_fit_and_predict(hvd_init, rng, tmp_path):
+    x, y = _toy_problem(rng)
+    store = LocalStore(str(tmp_path / "store"))
+    est = Estimator(
+        model=MLP(features=(16, 3)),
+        optimizer=optax.adam(5e-3),
+        loss=_loss,
+        store=store,
+        batch_size=4,
+        epochs=8,
+        run_id="test_run",
+        verbose=0,
+    )
+    model = est.fit(x, y)
+    assert model.history[-1]["loss"] < model.history[0]["loss"]
+    preds = model.predict(x[:10])
+    assert preds.shape == (10, 3)
+
+
+def test_estimator_checkpoint_roundtrip(hvd_init, rng, tmp_path):
+    x, y = _toy_problem(rng, n=32)
+    store = LocalStore(str(tmp_path / "store"))
+    est = Estimator(
+        model=MLP(features=(8, 3)), optimizer=optax.sgd(0.1), loss=_loss,
+        store=store, batch_size=4, epochs=1, run_id="ckpt_run", verbose=0,
+    )
+    model = est.fit(x, y)
+    reloaded = EstimatorModel.load(store, "ckpt_run", MLP(features=(8, 3)))
+    np.testing.assert_allclose(
+        model.predict(x[:4]), reloaded.predict(x[:4]), rtol=1e-6
+    )
+
+
+def test_estimator_with_callbacks(hvd_init, rng, tmp_path):
+    from horovod_tpu.callbacks import (
+        BroadcastGlobalVariablesCallback, MetricAverageCallback,
+    )
+
+    x, y = _toy_problem(rng, n=32)
+    bcast = BroadcastGlobalVariablesCallback(0)
+    est = Estimator(
+        model=MLP(features=(8, 3)), optimizer=optax.sgd(0.1), loss=_loss,
+        batch_size=4, epochs=1, verbose=0,
+        callbacks=[bcast, MetricAverageCallback()],
+    )
+    model = est.fit(x, y)
+    assert bcast.broadcast_done
+    assert "loss" in model.history[0]
