@@ -46,8 +46,10 @@ class EstimatorModel:
 
     def __init__(self, model, params, model_state=None):
         self.model = model
-        self.params = params
-        self.model_state = model_state or {}
+        # normalize to host arrays: predictions then run on the default
+        # backend regardless of which mesh trained the params
+        self.params = jax.device_get(params)
+        self.model_state = jax.device_get(model_state or {})
 
     def predict(self, x) -> np.ndarray:
         variables = {"params": self.params, **self.model_state}
