@@ -22,9 +22,9 @@ def main() -> None:
     from examples.synthetic_benchmark import parse_args, run
 
     args = parse_args([
-        "--batch-size", "64",
+        "--batch-size", "256",
         "--num-warmup-batches", "3",
-        "--num-batches-per-iter", "5",
+        "--num-batches-per-iter", "10",
         "--num-iters", "3",
     ])
     result = run(args)

@@ -55,6 +55,9 @@ def parse_args(argv=None):
                         help="use two-level (ICI/DCN-style) allreduce")
     parser.add_argument("--platform", type=str, default=None,
                         help="jax platform override (tpu/cpu)")
+    parser.add_argument("--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"],
+                        help="model compute dtype (params stay float32)")
     return parser.parse_args(argv)
 
 
@@ -67,7 +70,9 @@ def log(s, nl=True):
 def run(args) -> dict:
     hvd.init(platform=args.platform)
 
-    model = MODELS[args.model](num_classes=args.num_classes)
+    model = MODELS[args.model](
+        num_classes=args.num_classes, dtype=jnp.dtype(args.dtype)
+    )
     opt = optax.sgd(0.01, momentum=0.9)
 
     global_batch = args.batch_size * hvd.size()
@@ -106,10 +111,14 @@ def run(args) -> dict:
     log(f"Batch size: {args.batch_size} (global {global_batch})")
     log(f"Number of chips: {hvd.size()}")
 
+    # NOTE: sync via device_get of the chained loss, not block_until_ready —
+    # on tunneled/remote platforms block_until_ready can return before remote
+    # execution finishes, which silently inflates throughput. Fetching the
+    # scalar forces the whole sequential step chain to complete.
     log("Running warmup...")
     for _ in range(max(args.num_warmup_batches, 1)):
         state, loss = step(state, x, y)
-    jax.block_until_ready(loss)
+    float(np.asarray(jax.device_get(loss)))
 
     log("Running benchmark...")
     img_secs = []
@@ -117,7 +126,7 @@ def run(args) -> dict:
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
             state, loss = step(state, x, y)
-        jax.block_until_ready(loss)
+        float(np.asarray(jax.device_get(loss)))
         dt = time.perf_counter() - t0
         img_sec = args.batch_size * args.num_batches_per_iter * hvd.size() / dt
         log(f"Iter: Img/sec total: {img_sec:.1f}")
