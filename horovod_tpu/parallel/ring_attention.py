@@ -25,6 +25,7 @@ with the data-parallel dimension by using a 2-D (dp, sp) mesh.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -33,6 +34,7 @@ import numpy as np
 from jax import lax
 
 from .. import core
+from ..ops import flash_attention as fa
 
 
 def _axis():
@@ -74,7 +76,11 @@ def _merge(o1, m1, l1, o2, m2, l2):
 
 
 def ring_attention(q, k, v, *, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   impl: str = "xla",
+                   block_q: int = fa.DEFAULT_BLOCK_Q,
+                   block_k: int = fa.DEFAULT_BLOCK_K,
+                   interpret: Optional[bool] = None):
     """Attention over a sequence sharded across ranks.
 
     Args:
@@ -83,10 +89,26 @@ def ring_attention(q, k, v, *, causal: bool = False,
         ``[r*seq_local, (r+1)*seq_local)``.
       causal: apply causal masking in *global* positions.
       scale: logit scale; default ``1/sqrt(head_dim)``.
+      impl: ``"xla"`` (lax einsums, XLA fuses) or ``"pallas"`` (flash
+        kernels on the MXU per hop, custom VJP rotating gradients around
+        the ring; see :mod:`horovod_tpu.ops.flash_attention`).
 
     Returns the attention output for the local q shard, same shape/dtype
     as ``q``.
     """
+    if impl == "pallas":
+        axis = _axis()
+        if scale is None:
+            scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        fn = _ring_pallas_fn(
+            axis, core.size(), bool(causal), float(scale), int(block_q),
+            int(block_k), fa._resolve_interpret(interpret),
+        )
+        out = fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                 jnp.swapaxes(v, 1, 2))
+        return jnp.swapaxes(out, 1, 2)
+    if impl != "xla":
+        raise ValueError(f"unknown impl {impl!r} (want 'xla' or 'pallas')")
     axis = _axis()
     n = core.size()
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
@@ -125,8 +147,104 @@ def ring_attention(q, k, v, *, causal: bool = False,
     return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# pallas ring: flash kernels per hop, gradients rotate with their kv shards
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_pallas_fn(axis, n, causal, scale, block_q, block_k, interpret):
+    """Differentiable ring attention in ``[b,h,s,d]`` layout.
+
+    Forward: scan ``n`` hops; each hop runs the Pallas partial kernel on the
+    resident kv shard (global-position causal offsets), merges the streaming
+    triple, and rotates kv one neighbor over ICI.  Backward: a second ring
+    pass where dk/dv accumulators travel *with* their kv shards, so after n
+    hops each rank holds exactly the gradient of its own shard.
+    """
+    kw = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+              interpret=interpret)
+    perm = tuple((i, (i + 1) % n) for i in range(n))
+
+    def fwd_scan(q, k, v):
+        b, h, seq, d = q.shape
+        my = lax.axis_index(axis)
+
+        def body(carry, _):
+            o, m, l, kc, vc, owner = carry
+            po, pm, plv = fa.mha_partial(q, kc, vc, my * seq, owner * seq,
+                                         **kw)
+            m_new = jnp.maximum(m, pm)
+            a1 = jnp.exp(m - m_new)
+            a2 = jnp.exp(pm - m_new)
+            o = o * a1 + po * a2
+            l = l * a1 + plv * a2
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            return (o, m_new, l, kc, vc, (owner - 1) % n), None
+
+        o0 = jnp.zeros((b, h, seq, d), jnp.float32)
+        m0 = jnp.full((b, h, seq, 1), fa.NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, seq, 1), jnp.float32)
+        (o, m, l, _, _, _), _ = lax.scan(
+            body, (o0, m0, l0, k, v, my), None, length=n
+        )
+        l_safe = jnp.maximum(l, 1e-30)
+        out = (o / l_safe).astype(q.dtype)
+        lse = m + jnp.log(l_safe)
+        return out, lse
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        out, _ = fwd_scan(q, k, v)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = fwd_scan(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        seq = q.shape[2]
+        my = lax.axis_index(axis)
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+
+        def body(carry, _):
+            dq, kc, vc, dkc, dvc, owner = carry
+            q_off = my * seq
+            kv_off = owner * seq
+            dq = dq + fa.mha_bwd_dq(q, kc, vc, do, lse, delta, q_off,
+                                    kv_off, **kw)
+            dkb, dvb = fa.mha_bwd_dkv(q, kc, vc, do, lse, delta, q_off,
+                                      kv_off, **kw)
+            dkc = dkc + dkb
+            dvc = dvc + dvb
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            dkc = lax.ppermute(dkc, axis, perm)
+            dvc = lax.ppermute(dvc, axis, perm)
+            return (dq, kc, vc, dkc, dvc, (owner - 1) % n), None
+
+        (dq, _, _, dk, dv, _), _ = lax.scan(
+            body,
+            (jnp.zeros(q.shape, jnp.float32), k, v,
+             jnp.zeros(k.shape, jnp.float32),
+             jnp.zeros(v.shape, jnp.float32), my),
+            None, length=n,
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
 def ulysses_attention(q, k, v, *, causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      impl: str = "xla",
+                      block_q: int = fa.DEFAULT_BLOCK_Q,
+                      block_k: int = fa.DEFAULT_BLOCK_K,
+                      interpret: Optional[bool] = None):
     """All-to-all ("Ulysses") sequence parallelism.
 
     Per-rank inputs ``[batch, seq_local, heads, head_dim]`` with
@@ -153,11 +271,18 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [b, s_g, h/n, d]
     sg = qh.shape[1]
-    sl = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
-    if causal:
-        pos = jnp.arange(sg)
-        sl = jnp.where((pos[:, None] >= pos[None, :])[None, None], sl,
-                       -jnp.inf)
-    p = jax.nn.softmax(sl, axis=-1).astype(vh.dtype)
-    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    if impl == "pallas":
+        oh = fa.flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                block_q=block_q, block_k=block_k,
+                                interpret=interpret)
+    elif impl == "xla":
+        sl = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
+        if causal:
+            pos = jnp.arange(sg)
+            sl = jnp.where((pos[:, None] >= pos[None, :])[None, None], sl,
+                           -jnp.inf)
+        p = jax.nn.softmax(sl, axis=-1).astype(vh.dtype)
+        oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    else:
+        raise ValueError(f"unknown impl {impl!r} (want 'xla' or 'pallas')")
     return to_seq(oh).astype(q.dtype)
