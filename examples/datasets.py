@@ -1,0 +1,31 @@
+"""Synthetic, dependency-free datasets for the examples.
+
+The reference examples download MNIST (e.g. reference
+examples/tensorflow2_mnist.py:28-34, pytorch_mnist.py:98-108); this image
+has zero egress, so the examples use a procedurally generated stand-in with
+the same shape contract (28x28x1 images, 10 classes) and a *learnable*
+structure: labels are the argmax of 10 fixed random linear probes of the
+image, so a model can actually drive the loss down and the examples behave
+like real training runs (loss curves, accuracy climbing), deterministically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_mnist(n: int = 4096, seed: int = 1234):
+    """Returns ``(x, y)``: x ``[n, 28, 28, 1]`` float32 in [0, 1],
+    y ``[n]`` int32 in [0, 10)."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(size=(n, 28, 28, 1)).astype(np.float32)
+    probes = rng.normal(size=(10, 28 * 28)).astype(np.float32)
+    y = np.argmax(x.reshape(n, -1) @ probes.T, axis=1).astype(np.int32)
+    return x, y
+
+
+def synthetic_tokens(n: int = 1024, seq_len: int = 128, vocab: int = 1024,
+                     seed: int = 99):
+    """Token-id sequences for the BERT examples: ``[n, seq_len]`` int32."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, size=(n, seq_len)).astype(np.int32)

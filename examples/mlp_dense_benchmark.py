@@ -1,0 +1,104 @@
+"""Dense-MLP synthetic benchmark — the "dense" profiling target.
+
+Analog of the reference's dense sweep target (reference
+examples/mxnet_dense.py + test_dense.sh: a stack of fully-connected
+layers used to stress pure-allreduce communication patterns under the
+byteprofile tracer).  Gradient size dominates compute here, so this is
+the benchmark that exercises the fusion planner and (optionally) the
+timeline — set ``HVD_TIMELINE=<dir>`` to capture per-rank traces while
+it runs.
+
+Run:  python examples/mlp_dense_benchmark.py --hidden 4096 --layers 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.mlp import MLP
+from horovod_tpu.training import (
+    init_train_state, make_train_step, shard_batch,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="horovod_tpu dense benchmark")
+    p.add_argument("--hidden", type=int, default=4096)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--input-dim", type=int, default=1024)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--num-warmup-batches", type=int, default=5)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=5)
+    return p.parse_args(argv)
+
+
+def run(args) -> dict:
+    hvd.init()
+
+    model = MLP(features=[args.hidden] * args.layers + [args.num_classes])
+    opt = optax.sgd(0.01)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    step = make_train_step(
+        apply_fn=lambda vars_, bx, **kw: model.apply(vars_, bx),
+        loss_fn=loss_fn, optimizer=opt,
+        compression=hvd.Compression.fp16 if args.fp16_allreduce
+        else hvd.Compression.none,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, args.input_dim)))
+
+    rng = np.random.default_rng(0)
+    n = args.batch_size * hvd.size()
+    bx = shard_batch(rng.normal(size=(n, args.input_dim)).astype(np.float32))
+    by = shard_batch(rng.integers(0, args.num_classes, size=(n,))
+                     .astype(np.int32))
+
+    param_bytes = sum(p.size * p.dtype.itemsize
+                      for p in jax.tree_util.tree_leaves(state.params))
+    if hvd.rank() == 0:
+        print(f"Dense model: {args.layers}x{args.hidden}, "
+              f"{param_bytes / 1e6:.1f} MB of gradients per step")
+
+    for _ in range(args.num_warmup_batches):
+        state, loss = step(state, bx, by)
+    float(np.asarray(jax.device_get(loss)))
+
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            state, loss = step(state, bx, by)
+        float(np.asarray(jax.device_get(loss)))
+        dt = time.perf_counter() - t0
+        steps_sec = args.num_batches_per_iter / dt
+        # the interesting number for a dense stack: allreduced bytes/sec
+        gbps = param_bytes * steps_sec / 1e9
+        rates.append(gbps)
+        if hvd.rank() == 0:
+            print(f"Iter: {steps_sec:.2f} steps/sec, "
+                  f"{gbps:.2f} GB/s gradient traffic")
+
+    return {"grad_gbytes_sec": float(np.mean(rates)),
+            "final_loss": float(np.asarray(jax.device_get(loss)))}
+
+
+if __name__ == "__main__":
+    run(parse_args())

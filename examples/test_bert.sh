@@ -1,0 +1,23 @@
+#!/bin/bash
+# BERT trace-collection sweep — analog of the reference's
+# examples/test_bert.sh (gluon-nlp BERT with synthetic data under the
+# byteprofile tracer).  Sweeps attention/sequence-parallel variants.
+set -e
+cd "$(dirname "$0")/.."
+
+export HVD_TIMELINE="${TRACE_DIR:-/tmp/hvd_traces/bert}"
+export HVD_TRACE_START_STEP="${HVD_TRACE_START_STEP:-5}"
+export HVD_TRACE_END_STEP="${HVD_TRACE_END_STEP:-15}"
+
+MODEL="${MODEL:-base}"
+BATCH="${BATCH:-8}"
+SEQ="${SEQ:-512}"
+
+for ATTN in xla pallas; do
+    echo "=== bert-$MODEL attn=$ATTN ==="
+    python examples/bert_synthetic_benchmark.py \
+        --model "$MODEL" --batch-size "$BATCH" --seq-len "$SEQ" \
+        --attn "$ATTN" "$@"
+done
+
+echo "traces in $HVD_TIMELINE"

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Dense-stack trace-collection sweep — analog of the reference's
+# examples/test_dense.sh (mxnet_dense.py under the byteprofile tracer):
+# an allreduce-dominated workload for profiling the communication plane,
+# swept over gradient compression.
+set -e
+cd "$(dirname "$0")/.."
+
+export HVD_TIMELINE="${TRACE_DIR:-/tmp/hvd_traces/dense}"
+export HVD_TRACE_START_STEP="${HVD_TRACE_START_STEP:-5}"
+export HVD_TRACE_END_STEP="${HVD_TRACE_END_STEP:-25}"
+
+HIDDEN="${HIDDEN:-4096}"
+LAYERS="${LAYERS:-8}"
+
+for COMPRESS in "" "--fp16-allreduce"; do
+    echo "=== dense ${HIDDEN}x${LAYERS} ${COMPRESS:-fp32} ==="
+    python examples/mlp_dense_benchmark.py \
+        --hidden "$HIDDEN" --layers "$LAYERS" $COMPRESS "$@"
+done
+
+echo "traces in $HVD_TIMELINE"

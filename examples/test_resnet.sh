@@ -1,0 +1,23 @@
+#!/bin/bash
+# ResNet trace-collection sweep — analog of the reference's profiling
+# sweep script (reference examples/test_resnet.sh: runs the synthetic
+# benchmark under BYTEPS_TRACE_* so the byteprofile tracer captures a
+# step window per rank).  Here the tracer is the built-in timeline:
+# per-rank Chrome traces land in $TRACE_DIR/<rank>/comm.json.
+set -e
+cd "$(dirname "$0")/.."
+
+export HVD_TIMELINE="${TRACE_DIR:-/tmp/hvd_traces/resnet}"
+export HVD_TRACE_START_STEP="${HVD_TRACE_START_STEP:-10}"
+export HVD_TRACE_END_STEP="${HVD_TRACE_END_STEP:-20}"
+export HVD_TIMELINE_MARK_CYCLES=1
+
+MODEL="${MODEL:-ResNet50}"
+BATCH="${BATCH:-32}"
+
+python examples/synthetic_benchmark.py \
+    --model "$MODEL" \
+    --batch-size "$BATCH" \
+    --num-warmup-batches 5 --num-batches-per-iter 5 --num-iters 4 "$@"
+
+echo "traces in $HVD_TIMELINE"

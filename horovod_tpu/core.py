@@ -200,6 +200,12 @@ def init(
             )
         except Exception as e:  # noqa: BLE001
             log.warning("eager controller setup failed: %s", e)
+        # Env-driven timeline startup, as the reference core does when
+        # HOROVOD_TIMELINE is set (reference operations.cc:392-400):
+        # initialize() is a no-op when HVD_TIMELINE/HVD_TRACE_DIR is unset.
+        from .timeline.timeline import timeline
+
+        timeline.initialize()
 
 
 def shutdown() -> None:
@@ -210,6 +216,12 @@ def shutdown() -> None:
         from .runtime import eager_controller
 
         eager_controller.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .timeline.timeline import timeline
+
+        timeline.shutdown()
     except Exception:  # noqa: BLE001
         pass
     with _lock:

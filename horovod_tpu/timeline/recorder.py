@@ -214,7 +214,7 @@ class TimelineHook:
 
         @contextlib.contextmanager
         def ctx():
-            self._step = timeline.record_step()
+            self._step = timeline.record_step(owner="timeline_hook")
             enabled = self.recorder.enabled and self._in_window()
             if enabled and self.xla_profile and not self._profiling:
                 jax.profiler.start_trace(self.recorder._path("xla_trace"))

@@ -131,6 +131,7 @@ class Timeline:
         self._writer: Optional[_Writer] = None
         self._lock = threading.Lock()
         self._step = 0
+        self._stepper: Optional[str] = None
         self._start_step = env_util.get_int(env_util.HVD_TRACE_START_STEP, 0)
         self._end_step = env_util.get_int(env_util.HVD_TRACE_END_STEP, 1 << 62)
         self._mark_cycles = env_util.get_bool(env_util.HVD_TIMELINE_MARK_CYCLES)
@@ -148,13 +149,34 @@ class Timeline:
         with self._lock:
             if self._writer is None:
                 self._writer = _make_writer(path)
+                # fresh trace file = fresh step window: an init() after a
+                # previous run's auto-close must not inherit its counter
+                # (else the new trace instantly re-closes empty)
+                self._step = 0
+                self._stepper = None
+                self._start_step = env_util.get_int(
+                    env_util.HVD_TRACE_START_STEP, 0)
+                self._end_step = env_util.get_int(
+                    env_util.HVD_TRACE_END_STEP, 1 << 62)
                 log.debug("timeline → %s", path)
+                # finalize the JSON even when the user never calls
+                # shutdown() (reference closes via the writer thread at
+                # process teardown / end-step auto-close)
+                import atexit
+
+                atexit.register(self.shutdown)
 
     def shutdown(self) -> None:
         with self._lock:
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None
+
+    @property
+    def active(self) -> bool:
+        """Writer open (regardless of the step window) — callers that
+        advance the step counter must keep doing so before the window."""
+        return self._writer is not None
 
     @property
     def enabled(self) -> bool:
@@ -164,9 +186,19 @@ class Timeline:
         return self._start_step <= self._step <= self._end_step
 
     # -- step windowing (fork: BYTEPS_TRACE_*_STEP) -------------------------
-    def record_step(self) -> int:
+    def record_step(self, owner: str = "default") -> int:
         """Advance the step counter; auto-finalize at the end step
-        (reference timeline.cc:101-144)."""
+        (reference timeline.cc:101-144).
+
+        ``owner`` dedupes composed steppers: the first component to call
+        this (e.g. a ``TimelineHook`` wrapping a ``make_train_step`` loop —
+        both record steps) claims the counter; other owners' calls return
+        without advancing, so the window isn't double-advanced.
+        """
+        if self._stepper is None:
+            self._stepper = owner
+        if owner != self._stepper:
+            return self._step
         self._step += 1
         if self._step > self._end_step:
             self.shutdown()

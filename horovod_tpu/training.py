@@ -93,12 +93,35 @@ def make_train_step(
 
     # params/opt_state replicated; batch sharded across ranks on dim 0.
     state_spec = TrainState(params=P(), opt_state=P(), model_state=P(), step=P())
-    return spmd(
+    compiled = spmd(
         per_rank_step,
         in_specs=(state_spec, P(core.AXIS), P(core.AXIS)),
         out_specs=(state_spec, P()),
         donate_argnums=(0,) if donate else (),
     )
+
+    from .timeline.timeline import timeline
+
+    def step_with_timeline(state, x, y):
+        # Host-side step record: advances the trace window (reference
+        # BYTEPS_TRACE_START/END_STEP semantics) and emits a STEP dispatch
+        # span.  On the compiled path collective timing lives inside XLA;
+        # this records the per-step cadence the tracer windows key on.
+        # Skipped while under a jax trace (e.g. Recorder.record_step_function
+        # running make_jaxpr) so abstract evaluation doesn't consume window
+        # steps or emit phantom spans.
+        under_trace = any(
+            isinstance(leaf, jax.core.Tracer)
+            for leaf in jax.tree_util.tree_leaves((state, x, y))
+        )
+        if timeline.active and not under_trace:
+            timeline.record_step(owner="train_step")
+            timeline.mark_cycle_start()
+            with timeline.span("train_step", "STEP"):
+                return compiled(state, x, y)
+        return compiled(state, x, y)
+
+    return step_with_timeline
 
 
 def init_train_state(model, optimizer, sample_input, *, rngs=None,

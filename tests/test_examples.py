@@ -1,0 +1,87 @@
+"""Examples as smoke tests — the reference CI runs examples/*_mnist.py
+under mpirun as integration coverage (reference
+.buildkite/gen-pipeline.sh:127-174); here each example's ``run()`` is
+invoked tiny on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture()
+def mesh8(cpu_devices):
+    hvd.shutdown()
+    hvd.init(devices=cpu_devices)
+    yield
+    hvd.shutdown()
+
+
+def test_mnist_example_loss_decreases(mesh8):
+    from examples.mnist import parse_args, run
+
+    r = run(parse_args(["--epochs", "2", "--batch-size", "16",
+                        "--num-samples", "512"]))
+    assert np.isfinite(r["final_loss"])
+    assert r["final_loss"] < r["losses"][0] + 1e-6
+    assert r["final_loss"] < 2.3   # below chance-level cross-entropy
+
+
+def test_keras_mnist_example_with_callbacks(mesh8, tmp_path):
+    from examples.keras_mnist import parse_args, run
+
+    r = run(parse_args(["--epochs", "2", "--batch-size", "16",
+                        "--num-samples", "256",
+                        "--checkpoint-dir", str(tmp_path)]))
+    assert np.isfinite(r["final_loss"])
+    assert (tmp_path / "checkpoint-1.npz").exists()
+
+
+def test_torch_mnist_example(mesh8):
+    pytest.importorskip("torch")
+    from examples.torch_mnist import parse_args, run
+
+    r = run(parse_args(["--epochs", "1", "--batch-size", "32",
+                        "--num-samples", "256"]))
+    assert np.isfinite(r["final_loss"])
+
+
+def test_estimator_mnist_example(mesh8):
+    from examples.estimator_mnist import parse_args, run
+
+    r = run(parse_args(["--epochs", "1", "--batch-size", "16",
+                        "--num-samples", "256"]))
+    assert 0.0 <= r["accuracy"] <= 1.0
+
+
+def test_bert_benchmark_dp(mesh8):
+    from examples.bert_synthetic_benchmark import parse_args, run
+
+    r = run(parse_args(["--model", "tiny", "--batch-size", "2",
+                        "--seq-len", "64", "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "1", "--num-iters", "1",
+                        "--dtype", "float32"]))
+    assert np.isfinite(r["final_loss"])
+    assert r["sent_sec_total"] > 0
+
+
+def test_bert_benchmark_ring_pallas(mesh8):
+    from examples.bert_synthetic_benchmark import parse_args, run
+
+    r = run(parse_args(["--model", "tiny", "--batch-size", "2",
+                        "--seq-len", "64", "--seq-parallel", "ring",
+                        "--attn", "pallas", "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "1", "--num-iters", "1",
+                        "--dtype", "float32"]))
+    assert np.isfinite(r["final_loss"])
+
+
+def test_dense_benchmark(mesh8):
+    from examples.mlp_dense_benchmark import parse_args, run
+
+    r = run(parse_args(["--hidden", "64", "--layers", "2",
+                        "--input-dim", "32", "--num-classes", "8",
+                        "--batch-size", "4", "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "2", "--num-iters", "1"]))
+    assert np.isfinite(r["final_loss"])
+    assert r["grad_gbytes_sec"] > 0
