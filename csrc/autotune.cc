@@ -1,0 +1,360 @@
+// Native Bayesian autotuner: Gaussian-process regression + expected
+// improvement + the parameter-manager state machine.
+//
+// Native equivalent of the reference's autotune stack
+// (horovod/common/parameter_manager.cc: warmup-discard, steps-per-sample
+// batching, per-category Bayesian optimization scored by bytes/sec, freeze
+// at max samples; horovod/common/optim/gaussian_process.cc: RBF-kernel GP
+// with Cholesky solves; optim/bayesian_optimization.cc: EI acquisition
+// maximized over sampled candidates).  The reference leans on Eigen +
+// lbfgs; at autotuner scale (tens of observations, 1-D knob per category)
+// a self-contained Cholesky is all that's needed, so this file has no
+// third-party dependencies.
+//
+// Exposed through the C ABI at the bottom; horovod_tpu/optim/autotune.py
+// prefers this implementation and falls back to its NumPy twin.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace hvd {
+namespace {
+
+// ---------------------------------------------------------------------------
+// small dense linear algebra (row-major, n <= ~100)
+// ---------------------------------------------------------------------------
+
+// In-place Cholesky of SPD matrix a (n x n); returns false if not SPD.
+bool cholesky(std::vector<double>& a, int n) {
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double s = a[i * n + j];
+      for (int k = 0; k < j; ++k) s -= a[i * n + k] * a[j * n + k];
+      if (i == j) {
+        if (s <= 0.0) return false;
+        a[i * n + i] = std::sqrt(s);
+      } else {
+        a[i * n + j] = s / a[j * n + j];
+      }
+    }
+    for (int j = i + 1; j < n; ++j) a[i * n + j] = 0.0;  // lower triangular
+  }
+  return true;
+}
+
+// Solve L x = b in place (forward substitution).
+void solve_lower(const std::vector<double>& l, int n, std::vector<double>& b) {
+  for (int i = 0; i < n; ++i) {
+    double s = b[i];
+    for (int k = 0; k < i; ++k) s -= l[i * n + k] * b[k];
+    b[i] = s / l[i * n + i];
+  }
+}
+
+// Solve L^T x = b in place (back substitution).
+void solve_upper_t(const std::vector<double>& l, int n,
+                   std::vector<double>& b) {
+  for (int i = n - 1; i >= 0; --i) {
+    double s = b[i];
+    for (int k = i + 1; k < n; ++k) s -= l[k * n + i] * b[k];
+    b[i] = s / l[i * n + i];
+  }
+}
+
+// xorshift64* PRNG — deterministic across platforms, no <random> needed.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  double uniform() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return double((s * 0x2545F4914F6CDD1Dull) >> 11) /
+           double(1ull << 53);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// GP regression, RBF kernel (reference optim/gaussian_process.cc)
+// ---------------------------------------------------------------------------
+
+class Gp {
+ public:
+  Gp(double length_scale, double noise, double signal_var)
+      : ls_(length_scale), noise_(noise), sv_(signal_var) {}
+
+  void Fit(const std::vector<double>& x, const std::vector<double>& y) {
+    const int n = int(y.size());
+    x_ = x;
+    // normalize targets
+    double mean = 0, var = 0;
+    for (double v : y) mean += v;
+    mean /= std::max(n, 1);
+    for (double v : y) var += (v - mean) * (v - mean);
+    var /= std::max(n, 1);
+    ymean_ = mean;
+    ystd_ = var > 0 ? std::sqrt(var) : 1.0;
+    yn_.resize(n);
+    for (int i = 0; i < n; ++i) yn_[i] = (y[i] - mean) / ystd_;
+
+    chol_.assign(size_t(n) * n, 0.0);
+    for (int i = 0; i < n; ++i) {
+      for (int j = 0; j < n; ++j) {
+        chol_[i * n + j] = Kernel(x_[i], x_[j]) + (i == j ? noise_ : 0.0);
+      }
+    }
+    fitted_ = cholesky(chol_, n);
+    if (!fitted_) return;
+    alpha_ = yn_;
+    solve_lower(chol_, n, alpha_);
+    solve_upper_t(chol_, n, alpha_);
+    n_ = n;
+  }
+
+  // mu, sigma at one point
+  void Predict(double x, double* mu, double* sigma) const {
+    if (!fitted_ || n_ == 0) {
+      *mu = 0.0;
+      *sigma = 1.0;
+      return;
+    }
+    std::vector<double> ks(n_);
+    for (int i = 0; i < n_; ++i) ks[i] = Kernel(x, x_[i]);
+    double m = 0;
+    for (int i = 0; i < n_; ++i) m += ks[i] * alpha_[i];
+    std::vector<double> v = ks;
+    solve_lower(chol_, n_, v);
+    double vv = 0;
+    for (int i = 0; i < n_; ++i) vv += v[i] * v[i];
+    double var = std::max(sv_ + noise_ - vv, 1e-12);
+    *mu = m * ystd_ + ymean_;
+    *sigma = std::sqrt(var) * ystd_;
+  }
+
+ private:
+  double Kernel(double a, double b) const {
+    const double d = a - b;
+    return sv_ * std::exp(-0.5 * d * d / (ls_ * ls_));
+  }
+
+  double ls_, noise_, sv_;
+  std::vector<double> x_, yn_, chol_, alpha_;
+  double ymean_ = 0, ystd_ = 1;
+  int n_ = 0;
+  bool fitted_ = false;
+};
+
+// EI acquisition (reference optim/bayesian_optimization.cc).
+double ExpectedImprovement(double mu, double sigma, double best,
+                           double xi = 0.01) {
+  const double s = std::max(sigma, 1e-12);
+  const double z = (mu - best - xi) / s;
+  const double phi = std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+  const double Phi = 0.5 * (1.0 + std::erf(z / std::sqrt(2.0)));
+  return (mu - best - xi) * Phi + s * phi;
+}
+
+// 1-D Bayesian optimization over a normalized [0,1] knob.
+class BayesOpt {
+ public:
+  BayesOpt(double lo, double hi, double noise, uint64_t seed)
+      : lo_(lo), hi_(hi), gp_(0.3, noise, 1.0), rng_(seed) {}
+
+  void Observe(double x, double y) {
+    xs_.push_back((x - lo_) / std::max(hi_ - lo_, 1e-12));
+    ys_.push_back(y);
+    gp_.Fit(xs_, ys_);
+  }
+
+  double Suggest(int n_candidates = 256) {
+    if (xs_.size() < 2) return lo_ + rng_.uniform() * (hi_ - lo_);
+    const double best = *std::max_element(ys_.begin(), ys_.end());
+    double best_ei = -1, best_u = 0.5;
+    for (int i = 0; i < n_candidates; ++i) {
+      const double u = rng_.uniform();
+      double mu, sigma;
+      gp_.Predict(u, &mu, &sigma);
+      const double ei = ExpectedImprovement(mu, sigma, best);
+      if (ei > best_ei) {
+        best_ei = ei;
+        best_u = u;
+      }
+    }
+    return lo_ + best_u * (hi_ - lo_);
+  }
+
+  bool Best(double* x, double* y) const {
+    if (xs_.empty()) return false;
+    size_t i = size_t(std::max_element(ys_.begin(), ys_.end()) - ys_.begin());
+    *x = lo_ + xs_[i] * (hi_ - lo_);
+    *y = ys_[i];
+    return true;
+  }
+
+ private:
+  double lo_, hi_;
+  Gp gp_;
+  Rng rng_;
+  std::vector<double> xs_, ys_;
+};
+
+// ---------------------------------------------------------------------------
+// parameter manager state machine (reference parameter_manager.cc)
+// ---------------------------------------------------------------------------
+
+class Tuner {
+ public:
+  Tuner(double lo, double hi, double init_x, int n_categories, double noise,
+        int warmup, int steps_per_sample, int max_samples, uint64_t seed)
+      : warmup_left_(warmup),
+        steps_per_sample_(std::max(steps_per_sample, 1)),
+        max_samples_(max_samples),
+        current_x_(std::min(std::max(init_x, lo), hi)) {
+    for (int c = 0; c < std::max(n_categories, 1); ++c) {
+      bo_.emplace_back(lo, hi, noise, seed + 17 * (c + 1));
+    }
+  }
+
+  // Bitmask: 1 = active params changed (caller re-plans),
+  //          2 = a sample was observed (caller logs last_score()).
+  int RecordStep(double nbytes, double seconds) {
+    if (frozen_ || seconds <= 0) return 0;
+    scores_.push_back(nbytes / seconds);
+    if (int(scores_.size()) < steps_per_sample_) return 0;
+    return FinishSample();
+  }
+
+  double current_x() const { return current_x_; }
+  int current_category() const { return cat_; }
+  bool frozen() const { return frozen_; }
+  double best_score() const { return best_score_; }
+  double last_score() const { return last_score_; }
+  int samples_seen() const { return samples_seen_; }
+
+ private:
+  int FinishSample() {
+    // median score of the window — numpy semantics (mean of the two
+    // middle values for even windows) so the Python fallback stays a
+    // bit-for-bit oracle of this state machine
+    std::vector<double> s = scores_;
+    scores_.clear();
+    std::sort(s.begin(), s.end());
+    const size_t n = s.size();
+    const double score = (n % 2) ? s[n / 2]
+                                 : 0.5 * (s[n / 2 - 1] + s[n / 2]);
+    if (warmup_left_ > 0) {
+      --warmup_left_;
+      return 0;
+    }
+    bo_[cat_].Observe(current_x_, score);
+    last_score_ = score;
+    ++samples_seen_;
+    if (samples_seen_ >= max_samples_) {
+      Freeze();
+      return 1 | 2;
+    }
+    cat_ = (cat_ + 1) % int(bo_.size());
+    const double nxt = bo_[cat_].Suggest();
+    const bool changed = nxt != current_x_;
+    current_x_ = nxt;
+    return (changed ? 1 : 0) | 2;
+  }
+
+  void Freeze() {
+    double bx = current_x_, by = -1e300;
+    int bc = cat_;
+    for (size_t c = 0; c < bo_.size(); ++c) {
+      double x, y;
+      if (bo_[c].Best(&x, &y) && y > by) {
+        bx = x;
+        by = y;
+        bc = int(c);
+      }
+    }
+    current_x_ = bx;
+    cat_ = bc;
+    best_score_ = by;
+    frozen_ = true;
+  }
+
+  std::vector<BayesOpt> bo_;
+  std::vector<double> scores_;
+  int warmup_left_;
+  int steps_per_sample_;
+  int max_samples_;
+  int samples_seen_ = 0;
+  int cat_ = 0;
+  double current_x_;
+  double best_score_ = 0;
+  double last_score_ = 0;
+  bool frozen_ = false;
+};
+
+}  // namespace
+}  // namespace hvd
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* hvd_tuner_create(double lo, double hi, double init_x,
+                       int n_categories, double noise, int warmup,
+                       int steps_per_sample, int max_samples,
+                       unsigned long long seed) {
+  return new hvd::Tuner(lo, hi, init_x, n_categories, noise, warmup,
+                        steps_per_sample, max_samples, seed);
+}
+
+// Bitmask: 1 = suggested params changed (re-plan), 2 = sample observed
+// (read hvd_tuner_last_score for logging).
+int hvd_tuner_record(void* t, double nbytes, double seconds) {
+  return static_cast<hvd::Tuner*>(t)->RecordStep(nbytes, seconds);
+}
+
+double hvd_tuner_x(void* t) { return static_cast<hvd::Tuner*>(t)->current_x(); }
+
+int hvd_tuner_category(void* t) {
+  return static_cast<hvd::Tuner*>(t)->current_category();
+}
+
+int hvd_tuner_frozen(void* t) {
+  return static_cast<hvd::Tuner*>(t)->frozen() ? 1 : 0;
+}
+
+double hvd_tuner_best_score(void* t) {
+  return static_cast<hvd::Tuner*>(t)->best_score();
+}
+
+double hvd_tuner_last_score(void* t) {
+  return static_cast<hvd::Tuner*>(t)->last_score();
+}
+
+int hvd_tuner_samples_seen(void* t) {
+  return static_cast<hvd::Tuner*>(t)->samples_seen();
+}
+
+void hvd_tuner_destroy(void* t) { delete static_cast<hvd::Tuner*>(t); }
+
+// Standalone GP + EI entry points (used by tests to cross-check the
+// native math against the NumPy implementation).
+void* hvd_gp_create(double length_scale, double noise, double signal_var) {
+  return new hvd::Gp(length_scale, noise, signal_var);
+}
+
+void hvd_gp_fit(void* g, const double* x, const double* y, int n) {
+  std::vector<double> xv(x, x + n), yv(y, y + n);
+  static_cast<hvd::Gp*>(g)->Fit(xv, yv);
+}
+
+void hvd_gp_predict(void* g, double x, double* mu, double* sigma) {
+  static_cast<hvd::Gp*>(g)->Predict(x, mu, sigma);
+}
+
+void hvd_gp_destroy(void* g) { delete static_cast<hvd::Gp*>(g); }
+
+}  // extern "C"

@@ -192,6 +192,25 @@ class ParameterManager:
         self.frozen = not self.enabled
         self._log_header_written = False
 
+        # Prefer the native state machine (csrc/autotune.cc — the analog of
+        # the reference's C++ parameter_manager + optim/ GP); the NumPy
+        # implementation above stays as the fallback and the test oracle.
+        self._native = None
+        self._native_lib = None
+        if self.enabled and not env_util.get_bool("HVD_AUTOTUNE_PYTHON"):
+            try:
+                from ..runtime import native
+
+                self._native_lib = native.load()
+                self._native = self._native_lib.hvd_tuner_create(
+                    20.0, 28.0, len(self._categories), float(noise),
+                    int(self.warmup_samples), int(self.steps_per_sample),
+                    int(self.max_samples), 17,
+                )
+            except Exception as e:  # noqa: BLE001
+                log.debug("native autotuner unavailable (%s); python path", e)
+                self._native = None
+
     # -- scoring ------------------------------------------------------------
     def record_step(self, nbytes: float, seconds: float) -> None:
         """Feed one training step's communication volume and duration
@@ -199,6 +218,27 @@ class ParameterManager:
         if self.frozen:
             return
         if seconds <= 0:
+            return
+        if self._native is not None:
+            changed = self._native_lib.hvd_tuner_record(
+                self._native, float(nbytes), float(seconds)
+            )
+            if changed:
+                x = self._native_lib.hvd_tuner_x(self._native)
+                cat = self._native_lib.hvd_tuner_category(self._native)
+                self._set_params(TunableParams(
+                    fusion_threshold_bytes=int(2 ** float(x)),
+                    hierarchical_allreduce=self._categories[cat],
+                ))
+                self._log(self._native_lib.hvd_tuner_last_score(self._native))
+            if self._native_lib.hvd_tuner_frozen(self._native):
+                self.frozen = True
+                log.info(
+                    "autotune frozen (native): threshold=%d hierarchical=%s "
+                    "(score %.3g)", self.current.fusion_threshold_bytes,
+                    self.current.hierarchical_allreduce,
+                    self._native_lib.hvd_tuner_best_score(self._native),
+                )
             return
         self._step_scores.append(nbytes / seconds)
         if len(self._step_scores) >= self.steps_per_sample:

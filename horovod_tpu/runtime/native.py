@@ -105,6 +105,50 @@ def load() -> ctypes.CDLL:
         lib.hvd_client_close.restype = None
         lib.hvd_client_close.argtypes = [ctypes.c_void_p]
 
+        # autotuner
+        lib.hvd_tuner_create.restype = ctypes.c_void_p
+        lib.hvd_tuner_create.argtypes = [
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int, ctypes.c_double,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong,
+        ]
+        lib.hvd_tuner_record.restype = ctypes.c_int
+        lib.hvd_tuner_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_double,
+        ]
+        lib.hvd_tuner_x.restype = ctypes.c_double
+        lib.hvd_tuner_x.argtypes = [ctypes.c_void_p]
+        lib.hvd_tuner_category.restype = ctypes.c_int
+        lib.hvd_tuner_category.argtypes = [ctypes.c_void_p]
+        lib.hvd_tuner_frozen.restype = ctypes.c_int
+        lib.hvd_tuner_frozen.argtypes = [ctypes.c_void_p]
+        lib.hvd_tuner_best_score.restype = ctypes.c_double
+        lib.hvd_tuner_best_score.argtypes = [ctypes.c_void_p]
+        lib.hvd_tuner_last_score.restype = ctypes.c_double
+        lib.hvd_tuner_last_score.argtypes = [ctypes.c_void_p]
+        lib.hvd_tuner_samples_seen.restype = ctypes.c_int
+        lib.hvd_tuner_samples_seen.argtypes = [ctypes.c_void_p]
+        lib.hvd_tuner_destroy.restype = None
+        lib.hvd_tuner_destroy.argtypes = [ctypes.c_void_p]
+
+        # GP (test cross-check surface)
+        lib.hvd_gp_create.restype = ctypes.c_void_p
+        lib.hvd_gp_create.argtypes = [
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ]
+        lib.hvd_gp_fit.restype = None
+        lib.hvd_gp_fit.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        lib.hvd_gp_predict.restype = None
+        lib.hvd_gp_predict.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.hvd_gp_destroy.restype = None
+        lib.hvd_gp_destroy.argtypes = [ctypes.c_void_p]
+
         _lib = lib
         return lib
 
