@@ -203,12 +203,13 @@ class ParameterManager:
 
                 self._native_lib = native.load()
                 self._native = self._native_lib.hvd_tuner_create(
-                    20.0, 28.0, len(self._categories), float(noise),
+                    20.0, 28.0, float(self.current.as_vector()[0]),
+                    len(self._categories), float(noise),
                     int(self.warmup_samples), int(self.steps_per_sample),
                     int(self.max_samples), 17,
                 )
             except Exception as e:  # noqa: BLE001
-                log.debug("native autotuner unavailable (%s); python path", e)
+                log.warning("native autotuner unavailable (%s); python path", e)
                 self._native = None
 
     # -- scoring ------------------------------------------------------------

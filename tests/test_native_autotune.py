@@ -51,7 +51,9 @@ def test_native_tuner_converges_toward_optimum():
     """Synthetic objective: throughput peaks at log2(threshold)=24 — the
     native tuner's frozen choice must land near it."""
     lib = native.load()
-    t = lib.hvd_tuner_create(20.0, 28.0, 1, 0.01, 1, 2, 12, 7)
+    # init deliberately far from the optimum (24.0) so the test proves
+    # the tuner actually moves, not just that it froze where it started
+    t = lib.hvd_tuner_create(20.0, 28.0, 20.5, 1, 0.01, 1, 2, 12, 7)
     try:
         def objective(x):
             return 100.0 * np.exp(-0.5 * (x - 24.0) ** 2)
