@@ -54,47 +54,31 @@ def _adasum_combine(a, b, dot, na2, nb2):
     return (ca * a.astype(jnp.float32) + cb * b.astype(jnp.float32)).astype(a.dtype)
 
 
-def adasum_allreduce(tensor, *, process_set: Optional[object] = None):
-    """Adasum-allreduce ``tensor`` across all ranks (power-of-two count).
+def _vhdd(tensor, axis, n, pos, *, perm_for_level, dot_reduce=None):
+    """The distance-doubling Adasum recursion shared by the flat,
+    process-set, and hierarchical variants.
 
-    Exposed through ``hvd.allreduce(x, op=hvd.Adasum)`` exactly as the
-    reference exposes ``ReduceOp.ADASUM`` (horovod/torch/mpi_ops.py:103-119,
-    which also asserts the power-of-two requirement).
+    ``pos`` is this rank's position within the reducing group (traced);
+    ``perm_for_level(level)`` builds the ppermute pairing; ``dot_reduce``,
+    when set, sums the partial dot/norm values over the ranks sharding the
+    vector — the analog of the reference's SumAllreduceWithComm over the
+    reduction communicator (adasum.h:370-372), which makes sharded ranks
+    use FULL-vector dot products.
     """
-    axes = core._spmd_axes()
-    if axes is None:
-        raise RuntimeError("adasum_allreduce must run inside an SPMD region")
-    if process_set is not None:
-        raise NotImplementedError("Adasum over a process subset")
-    n = core.size()
-    if n & (n - 1):
-        raise ValueError(f"Adasum requires a power-of-two rank count, got {n}")
-    if n == 1:
-        return tensor
-
-    axis = axes[0] if len(axes) == 1 else axes[0]
-    if len(axes) == 2:
-        raise NotImplementedError(
-            "Adasum over the hierarchical mesh: flatten with hvd.spmd "
-            "(hierarchical=False)"
-        )
-
-    rank = lax.axis_index(axis)
     a = tensor
     level = 1
     while level < n:
-        # partner = rank XOR level — the distance-doubling pairing of VHDD
-        # (reference adasum.h:167-195).
-        perm = [(r, r ^ level) for r in range(n)]
-        b = lax.ppermute(a, axis, perm)
+        b = lax.ppermute(a, axis, perm_for_level(level))
         af = a.astype(jnp.float32)
         bf = b.astype(jnp.float32)
         dot = jnp.sum(af * bf)
         na2 = jnp.sum(af * af)
         nb2 = jnp.sum(bf * bf)
+        if dot_reduce is not None:
+            dot, na2, nb2 = dot_reduce(jnp.stack([dot, na2, nb2]))
         # Both members of a pair must compute the SAME combination, so order
-        # the operands canonically by rank parity at this level.
-        low_first = (rank // level) % 2 == 0
+        # the operands canonically by position parity at this level.
+        low_first = (pos // level) % 2 == 0
         first = jnp.where(low_first, 1.0, 0.0)
         a_c = first * af + (1 - first) * bf
         b_c = first * bf + (1 - first) * af
@@ -103,6 +87,151 @@ def adasum_allreduce(tensor, *, process_set: Optional[object] = None):
         a = _adasum_combine(a_c, b_c, dot, na_c, nb_c).astype(tensor.dtype)
         level *= 2
     return a
+
+
+def adasum_allreduce(tensor, *, process_set: Optional[object] = None,
+                     hierarchical: bool = False):
+    """Adasum-allreduce ``tensor`` across all ranks (power-of-two count).
+
+    Exposed through ``hvd.allreduce(x, op=hvd.Adasum)`` exactly as the
+    reference exposes ``ReduceOp.ADASUM`` (horovod/torch/mpi_ops.py:103-119,
+    which also asserts the power-of-two requirement).  With
+    ``hierarchical=True`` (or on the 2-D (cross, local) mesh) this is the
+    reference's GPU-hierarchical variant (adasum_gpu_operations.cc:250-261):
+    plain reduce-scatter within the node, Adasum VHDD across nodes on each
+    local shard (with full-vector dot products via a local psum),
+    allgather back.
+    """
+    axes = core._spmd_axes()
+    if axes is None:
+        raise RuntimeError("adasum_allreduce must run inside an SPMD region")
+    if len(axes) == 2:
+        if process_set is not None:
+            raise NotImplementedError(
+                "Adasum over a process subset of the hierarchical mesh"
+            )
+        return _hierarchical_adasum_2d(tensor, axes)
+    if hierarchical:
+        if process_set is not None:
+            raise NotImplementedError(
+                "hierarchical Adasum over a process subset"
+            )
+        return _hierarchical_adasum_flat(tensor, axes[0])
+
+    axis = axes[0]
+    if process_set is not None:
+        k = process_set.size()
+        if k & (k - 1):
+            raise ValueError(
+                f"Adasum requires a power-of-two rank count, got {k}"
+            )
+        if k == 1:
+            return tensor
+        ranks = list(process_set.ranks)
+        member_set = set(ranks)
+        _, pos = process_set.member_position()
+
+        def perm_for_level(level):
+            # XOR pairing on positions *within the set*; non-members map to
+            # themselves — an identity exchange is an Adasum fixed point
+            # (ca = cb = 1/2 with a == b), so they pass through unchanged.
+            perm = [(r, r) for r in range(core.size()) if r not in member_set]
+            perm += [(ranks[i], ranks[i ^ level]) for i in range(k)]
+            return perm
+
+        return _vhdd(tensor, axis, k, pos, perm_for_level=perm_for_level)
+
+    n = core.size()
+    if n & (n - 1):
+        raise ValueError(f"Adasum requires a power-of-two rank count, got {n}")
+    if n == 1:
+        return tensor
+    rank = lax.axis_index(axis)
+    # partner = rank XOR level — the distance-doubling pairing of VHDD
+    # (reference adasum.h:167-195).
+    return _vhdd(
+        tensor, axis, n, rank,
+        perm_for_level=lambda level: [(r, r ^ level) for r in range(n)],
+    )
+
+
+def _check_cross_pow2(cross_n: int) -> None:
+    if cross_n & (cross_n - 1):
+        raise ValueError(
+            f"hierarchical Adasum requires a power-of-two node count, "
+            f"got {cross_n}"
+        )
+
+
+def _hierarchical_adasum_2d(tensor, axes):
+    """Local reduce-scatter → cross VHDD on shards → local allgather, on
+    the 2-D (cross, local) mesh."""
+    cross_axis, local_axis = axes
+    cross_n = core.cross_size()
+    local_n = core.local_size()
+    _check_cross_pow2(cross_n)
+    flat = tensor.reshape(-1)
+    pad = (-flat.shape[0]) % local_n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    # Node-internal stage: plain sum reduce-scatter (the reference's NCCL
+    # ReduceScatter with start_level=local_size skipping the local VHDD
+    # levels, adasum_gpu_operations.cc:257).
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
+    if cross_n > 1:
+        crank = lax.axis_index(cross_axis)
+        shard = _vhdd(
+            shard, cross_axis, cross_n, crank,
+            perm_for_level=lambda level: [
+                (r, r ^ level) for r in range(cross_n)
+            ],
+            dot_reduce=lambda v: lax.psum(v, local_axis),
+        )
+    out = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(tensor.shape)
+
+
+def _hierarchical_adasum_flat(tensor, axis):
+    """Same three phases on the flat 1-D mesh with axis_index_groups (the
+    style of parallel/hierarchical.py, so it composes with the 1-D rank
+    model used by make_train_step)."""
+    from ..parallel.hierarchical import _local_groups
+
+    ls = core.local_size()
+    cross_n = core.cross_size()
+    _check_cross_pow2(cross_n)
+    if cross_n == 1:
+        # Single node: the reference GPU variant degenerates to a plain
+        # local sum (its cross-node Adasum stage is empty).
+        return lax.psum(tensor, axis)
+    flat = tensor.reshape(-1)
+    pad = (-flat.shape[0]) % ls
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    local_groups = _local_groups()
+    shard = lax.psum_scatter(
+        flat, axis, scatter_dimension=0, tiled=True,
+        axis_index_groups=local_groups,
+    )
+    crank = lax.axis_index(axis) // ls
+    shard = _vhdd(
+        shard, axis, cross_n, crank,
+        perm_for_level=lambda level: [
+            (n * ls + r, (n ^ level) * ls + r)
+            for n in range(cross_n) for r in range(ls)
+        ],
+        dot_reduce=lambda v: lax.psum(
+            v, axis, axis_index_groups=local_groups
+        ),
+    )
+    out = lax.all_gather(
+        shard, axis, axis=0, tiled=True, axis_index_groups=local_groups
+    )
+    if pad:
+        out = out[:-pad]
+    return out.reshape(tensor.shape)
 
 
 def numpy_adasum_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -116,6 +245,21 @@ def numpy_adasum_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     ca = 1.0 if na2 == 0 else 1.0 - dot / (2.0 * na2)
     cb = 1.0 if nb2 == 0 else 1.0 - dot / (2.0 * nb2)
     return (ca * a.astype(np.float64) + cb * b.astype(np.float64)).astype(a.dtype)
+
+
+def numpy_hierarchical_adasum(tensors, local_size: int) -> np.ndarray:
+    """Oracle for the hierarchical variant: sum within each node, Adasum
+    across the node sums (the reference GPU variant's semantics —
+    reduce-scatter is a plain sum, VHDD dots span the full vector)."""
+    vals = [np.asarray(t, np.float64) for t in tensors]
+    assert len(vals) % local_size == 0
+    node_sums = [
+        np.sum(vals[i: i + local_size], axis=0)
+        for i in range(0, len(vals), local_size)
+    ]
+    if len(node_sums) == 1:
+        return node_sums[0].astype(np.asarray(tensors[0]).dtype)
+    return numpy_adasum(node_sums).astype(np.asarray(tensors[0]).dtype)
 
 
 def numpy_adasum(tensors) -> np.ndarray:

@@ -131,13 +131,16 @@ def allreduce(
     process_set: Optional[ProcessSet] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    hierarchical: bool = False,
 ):
     """Allreduce a per-rank tensor across all ranks.
 
     Mirrors ``hvd.allreduce`` (reference horovod/torch/mpi_ops.py:94-129 /
     horovod/tensorflow/mpi_ops.py): ``op`` is Average / Sum / Adasum /
     Min / Max; ``compression`` casts before the wire and back after
-    (reference horovod/torch/compression.py).
+    (reference horovod/torch/compression.py).  ``hierarchical`` selects the
+    two-level local/cross decomposition (the reference's
+    HOROVOD_HIERARCHICAL_ALLREDUCE knob, common.h:72).
     """
     axes = _axes()
     groups, group_size = _group_args(process_set)
@@ -145,13 +148,32 @@ def allreduce(
     if op == Adasum:
         from .adasum import adasum_allreduce
 
-        return adasum_allreduce(tensor, process_set=process_set)
+        compressed, ctx = compression.compress(tensor)
+        if prescale_factor != 1.0:
+            compressed = compressed * prescale_factor
+        out = adasum_allreduce(
+            compressed, process_set=process_set, hierarchical=hierarchical
+        )
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        return compression.decompress(out, ctx)
+
+    if hierarchical and op in (Min, Max):
+        raise ValueError("hierarchical allreduce supports Sum/Average/Adasum")
 
     compressed, ctx = compression.compress(tensor)
     if prescale_factor != 1.0:
         compressed = compressed * prescale_factor
 
-    if op in (Average, Sum):
+    if hierarchical and op in (Average, Sum) and len(axes) == 1:
+        if process_set is not None:
+            raise ValueError(
+                "hierarchical allreduce over a process subset is unsupported"
+            )
+        from ..parallel.hierarchical import hierarchical_allreduce
+
+        out = hierarchical_allreduce(compressed, op=op)
+    elif op in (Average, Sum):
         if len(axes) == 1:
             out = lax.psum(compressed, axes[0], axis_index_groups=groups)
         else:

@@ -89,18 +89,24 @@ def _compiler_params(interpret):
     return pltpu.CompilerParams(dimension_semantics=_DIM_SEMANTICS)
 
 
+def _fit_block(seq: int, cap: int) -> int:
+    """Largest divisor of ``seq`` that is <= ``cap``, preferring a
+    lane-aligned multiple of 8 (MXU tiling) — but only when alignment
+    doesn't collapse the block (e.g. seq 136: plain 68 beats aligned 8)."""
+    cap = min(cap, seq)
+    aligned = next(
+        (b for b in range(cap, 0, -1) if seq % b == 0 and b % 8 == 0), 0
+    )
+    plain = next((b for b in range(cap, 0, -1) if seq % b == 0), 1)
+    return aligned if aligned * 4 >= plain else plain
+
+
 def _check_blocks(sq, sk, block_q, block_k):
-    """Clamp block sizes to the seq lengths and require exact tiling — a
+    """Fit block sizes to the seq lengths: the grid must tile exactly (a
     non-dividing seq would silently truncate the grid and leave the tail
-    of the output uninitialized."""
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"block sizes ({block_q}, {block_k}) must evenly divide "
-            f"seq lengths ({sq}, {sk})"
-        )
-    return block_q, block_k
+    of the output uninitialized), so shrink each block to the largest
+    divisor of its seq length instead of erroring on shapes like 192/128."""
+    return _fit_block(sq, block_q), _fit_block(sk, block_k)
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,10 @@ def hierarchical_allreduce(tensor, *, op: str = Average):
             "hierarchical_allreduce runs on the flat mesh inside hvd.spmd"
         )
     axis = axes[0]
+    if op == core.Adasum:
+        from ..ops.adasum import adasum_allreduce
+
+        return adasum_allreduce(tensor, hierarchical=True)
     ls = core.local_size()
     if ls == 1 or core.cross_size() == 1:
         out = lax.psum(tensor, axis)

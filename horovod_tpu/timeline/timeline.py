@@ -136,6 +136,7 @@ class Timeline:
         self._end_step = env_util.get_int(env_util.HVD_TRACE_END_STEP, 1 << 62)
         self._mark_cycles = env_util.get_bool(env_util.HVD_TIMELINE_MARK_CYCLES)
         self._origin = time.perf_counter()
+        self._atexit_registered = False
 
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, directory: Optional[str] = None) -> None:
@@ -161,10 +162,13 @@ class Timeline:
                 log.debug("timeline → %s", path)
                 # finalize the JSON even when the user never calls
                 # shutdown() (reference closes via the writer thread at
-                # process teardown / end-step auto-close)
-                import atexit
+                # process teardown / end-step auto-close); registered once
+                # so init/shutdown cycles don't accumulate handlers
+                if not self._atexit_registered:
+                    import atexit
 
-                atexit.register(self.shutdown)
+                    atexit.register(self.shutdown)
+                    self._atexit_registered = True
 
     def shutdown(self) -> None:
         with self._lock:

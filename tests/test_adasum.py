@@ -7,7 +7,9 @@ import numpy as np
 import pytest
 
 import horovod_tpu as hvd
-from horovod_tpu.ops.adasum import numpy_adasum, numpy_adasum_pair
+from horovod_tpu.ops.adasum import (
+    numpy_adasum, numpy_adasum_pair, numpy_hierarchical_adasum,
+)
 
 
 def test_numpy_pair_orthogonal_sums():
@@ -59,6 +61,74 @@ def test_adasum_identical_inputs_is_identity(hvd_init, rng):
 
     out = hvd.get_per_rank(step(np.stack(xs)))
     np.testing.assert_allclose(out[0], v, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(64,), (8, 8), (13,)])
+def test_hierarchical_adasum_flat_mesh_matches_numpy(hvd_init, rng, shape):
+    """2 nodes x 4 local ranks: local sum reduce-scatter -> cross VHDD ->
+    local allgather (reference adasum_gpu_operations.cc semantics)."""
+    xs = [rng.normal(size=shape).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Adasum, hierarchical=True)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    expected = numpy_hierarchical_adasum(xs, local_size=4)
+    for o in out:
+        np.testing.assert_allclose(o, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_hierarchical_adasum_2d_mesh_matches_numpy(hvd_init, rng):
+    xs = [rng.normal(size=(24,)).astype(np.float32) for _ in range(8)]
+
+    from jax.sharding import PartitionSpec as P
+
+    @hvd.spmd(hierarchical=True,
+              in_specs=P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS),
+              out_specs=P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS))
+    def step(x):
+        return hvd.allreduce(x[0, 0], op=hvd.Adasum)[None, None]
+
+    stacked = np.stack(xs).reshape(2, 4, 24)
+    out = np.asarray(step(stacked)).reshape(8, 24)
+    expected = numpy_hierarchical_adasum(xs, local_size=4)
+    for o in out:
+        np.testing.assert_allclose(o, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_hierarchical_adasum_via_hierarchical_allreduce(hvd_init, rng):
+    """make_train_step's hierarchical branch routes op=Adasum here."""
+    from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+    xs = [rng.normal(size=(16,)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hierarchical_allreduce(x[0], op=hvd.Adasum)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    expected = numpy_hierarchical_adasum(xs, local_size=4)
+    for o in out:
+        np.testing.assert_allclose(o, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_process_set_adasum_matches_numpy(hvd_init, rng):
+    """Adasum over a 4-rank subset: members agree with the numpy oracle on
+    the subset; non-members pass through unchanged."""
+    ps = hvd.ProcessSet([1, 3, 5, 7])
+    xs = [rng.normal(size=(16,)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x[0], op=hvd.Adasum, process_set=ps)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    expected = numpy_adasum([xs[r] for r in ps.ranks])
+    for r in ps.ranks:
+        np.testing.assert_allclose(out[r], expected, rtol=1e-4, atol=1e-4)
+    for r in (0, 2, 4, 6):
+        np.testing.assert_allclose(out[r], xs[r], rtol=1e-5, atol=1e-6)
 
 
 def test_adasum_zero_rank_contributes_as_sum(hvd_init, rng):

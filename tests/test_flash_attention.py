@@ -59,6 +59,18 @@ def test_flash_fwd_matches_dense(qkv, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_flash_non_dividing_seq_fits_blocks(rng):
+    """seq 192 with the default 128 blocks used to raise; blocks now shrink
+    to the largest divisor (96) and results stay exact (ADVICE r1)."""
+    b, s, h, d = 1, 192, 2, 16
+    q, k, v = (rng.normal(size=(b, s, h, d)).astype(np.float32)
+               for _ in range(3))
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _dense(q, k, v, True),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_flash_offsets_match_dense(qkv):
     """Causal masking in global positions: a 32-row q shard starting at
     position 32 against the full kv sequence."""
