@@ -62,6 +62,8 @@ enum MsgType : uint8_t {
   kJoinMsg = 3,
   kResponseList = 4,
   kShutdown = 5,
+  kData = 6,        // worker → coordinator: payload for a named collective
+  kDataResult = 7,  // coordinator → worker: reduced/gathered payload
 };
 
 double NowSec() {
@@ -109,6 +111,57 @@ bool RecvMsg(int fd, uint8_t* type, std::string* payload) {
   *type = static_cast<uint8_t>(buf[0]);
   payload->assign(buf.data() + 1, len - 1);
   return true;
+}
+
+// --- host data plane helpers -----------------------------------------------
+// The coordinator-reduced CPU data plane: the TPU-era analog of the
+// reference's Gloo CPU ops (reference horovod/common/ops/gloo_operations.cc
+// GlooAllreduce/GlooAllgather/GlooBroadcast) — host-resident tensors (object
+// broadcast, torch CPU tensors, metrics) reduce over the controller's TCP
+// fabric without touching the XLA device plane.
+
+inline float Bf16ToF32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t F32ToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even, as hardware bf16 casts do
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+template <typename T>
+void SumInto(std::string* acc, const std::string& src) {
+  T* a = reinterpret_cast<T*>(acc->data());
+  const T* b = reinterpret_cast<const T*>(src.data());
+  size_t n = acc->size() / sizeof(T);
+  for (size_t i = 0; i < n; ++i) a[i] += b[i];
+}
+
+void SumIntoBf16(std::string* acc, const std::string& src) {
+  uint16_t* a = reinterpret_cast<uint16_t*>(acc->data());
+  const uint16_t* b = reinterpret_cast<const uint16_t*>(src.data());
+  size_t n = acc->size() / 2;
+  for (size_t i = 0; i < n; ++i)
+    a[i] = F32ToBf16(Bf16ToF32(a[i]) + Bf16ToF32(b[i]));
+}
+
+// dtype codes match horovod_tpu/runtime/controller.py _DTYPES.
+bool SumPayload(uint8_t dtype, std::string* acc, const std::string& src) {
+  if (acc->size() != src.size()) return false;
+  switch (dtype) {
+    case 0: SumInto<float>(acc, src); return true;
+    case 1: SumIntoBf16(acc, src); return true;
+    case 3: SumInto<double>(acc, src); return true;
+    case 4: SumInto<int32_t>(acc, src); return true;
+    case 5: SumInto<int64_t>(acc, src); return true;
+    default: return false;
+  }
 }
 
 std::string MetaKey(const Request& r) {
@@ -184,6 +237,15 @@ class ControllerServer {
     bool warned = false;
   };
 
+  struct PendingData {
+    uint8_t op = 0;
+    uint8_t dtype = 0;
+    int32_t root = 0;
+    std::vector<std::string> payloads;  // per rank
+    std::vector<bool> have;
+    int count = 0;
+  };
+
   void Loop() {
     while (!stopping_.load()) {
       std::vector<pollfd> fds;
@@ -238,10 +300,81 @@ class ControllerServer {
         std::memcpy(&rank, payload.data(), 4);
         joined_.insert(rank);
       }
+    } else if (type == kData) {
+      HandleData(payload);
     } else if (type == kShutdown) {
       stopping_.store(true);
     }
     return true;
+  }
+
+  // kData payload: [i32 rank][u8 op][u8 dtype][i32 root][u32 nlen][name][data]
+  void HandleData(const std::string& payload) {
+    if (payload.size() < 14) return;
+    const char* p = payload.data();
+    int32_t rank;
+    std::memcpy(&rank, p, 4);
+    uint8_t op = static_cast<uint8_t>(p[4]);
+    uint8_t dtype = static_cast<uint8_t>(p[5]);
+    int32_t root;
+    std::memcpy(&root, p + 6, 4);
+    uint32_t nlen;
+    std::memcpy(&nlen, p + 10, 4);
+    if (nlen > payload.size() - 14) return;  // guards 32-bit overflow too
+    std::string name(p + 14, nlen);
+    std::string data(p + 14 + nlen, payload.size() - 14 - nlen);
+    if (rank < 0 || rank >= nranks_) return;
+
+    auto& d = data_table_[name];
+    if (d.have.empty()) {
+      d.op = op;
+      d.dtype = dtype;
+      d.root = root;
+      d.have.assign(nranks_, false);
+      d.payloads.resize(nranks_);
+    }
+    if (!d.have[rank]) {
+      d.have[rank] = true;
+      d.payloads[rank] = std::move(data);
+      d.count += 1;
+    }
+    if (d.count >= nranks_) {
+      std::string result;
+      bool ok = ComputeDataResult(d, &result);
+      // kDataResult payload: [u8 ok][u32 nlen][name][data-or-error]
+      std::string out;
+      out.push_back(ok ? 1 : 0);
+      PutU32(&out, nlen);
+      out += name;
+      out += ok ? result : std::string("host collective failed: dtype ") +
+                               std::to_string(d.dtype) +
+                               " unsupported for allreduce or payload sizes "
+                               "mismatch across ranks";
+      for (auto& [fd, r] : clients_) SendMsg(fd, kDataResult, out);
+      data_table_.erase(name);
+    }
+  }
+
+  bool ComputeDataResult(PendingData& d, std::string* result) {
+    if (d.op == 0 || d.op == 4) {  // allreduce / adasum-on-host → sum
+      *result = std::move(d.payloads[0]);
+      for (int r = 1; r < nranks_; ++r)
+        if (!SumPayload(d.dtype, result, d.payloads[r])) return false;
+      return true;
+    }
+    if (d.op == 1) {  // allgather: [u32 nranks][u32 sizes...][blobs]
+      PutU32(result, static_cast<uint32_t>(nranks_));
+      for (int r = 0; r < nranks_; ++r)
+        PutU32(result, static_cast<uint32_t>(d.payloads[r].size()));
+      for (int r = 0; r < nranks_; ++r) *result += d.payloads[r];
+      return true;
+    }
+    if (d.op == 2) {  // broadcast
+      if (d.root < 0 || d.root >= nranks_) return false;
+      *result = std::move(d.payloads[d.root]);
+      return true;
+    }
+    return false;
   }
 
   void AddRequest(const Request& r) {
@@ -380,6 +513,7 @@ class ControllerServer {
   std::atomic<bool> stopping_{false};
   std::map<int, int32_t> clients_;  // fd → rank
   std::map<std::string, PendingTensor> table_;
+  std::map<std::string, PendingData> data_table_;
   std::unordered_map<std::string, std::string> cache_;
   std::set<int32_t> joined_;
   std::atomic<int64_t> cache_hits_{0};
@@ -439,6 +573,47 @@ class ControllerClient {
     return SendMsg(fd_, kJoinMsg, payload);
   }
 
+  bool SubmitData(const std::string& name, uint8_t op, uint8_t dtype,
+                  int32_t root, const void* buf, size_t nbytes) {
+    std::string payload;
+    payload.resize(10);
+    std::memcpy(payload.data(), &rank_, 4);
+    payload[4] = static_cast<char>(op);
+    payload[5] = static_cast<char>(dtype);
+    std::memcpy(payload.data() + 6, &root, 4);
+    PutU32(&payload, static_cast<uint32_t>(name.size()));
+    payload += name;
+    payload.append(static_cast<const char*>(buf), nbytes);
+    std::lock_guard<std::mutex> lk(wmu_);
+    return SendMsg(fd_, kData, payload);
+  }
+
+  // Block until the data result for `name` arrives.  Returns 0 = copied
+  // into out (out_len set), 1 = server-side error (message in *err),
+  // 2 = timeout, 3 = connection lost, 4 = out buffer too small (needed
+  // size in *out_len; result retained for a follow-up call).
+  int WaitData(const std::string& name, double timeout_ms, char* out,
+               size_t cap, size_t* out_len, std::string* err) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool got = cv_.wait_for(
+        lk, std::chrono::milliseconds(static_cast<int64_t>(timeout_ms)),
+        [&] { return data_results_.count(name) || dead_; });
+    if (!got) return 2;
+    auto it = data_results_.find(name);
+    if (it == data_results_.end()) return dead_ ? 3 : 2;
+    if (!it->second.first) {  // server error
+      if (err) *err = it->second.second;
+      data_results_.erase(it);
+      return 1;
+    }
+    const std::string& data = it->second.second;
+    *out_len = data.size();
+    if (!out || cap < data.size()) return 4;
+    std::memcpy(out, data.data(), data.size());
+    data_results_.erase(it);
+    return 0;
+  }
+
   // Block until `name` is negotiated.  Returns 0 = OK, 1 = error response
   // (message in *err), 2 = timeout, 3 = connection lost.
   int Wait(const std::string& name, double timeout_ms, std::string* err,
@@ -470,6 +645,21 @@ class ControllerClient {
       uint8_t type;
       std::string payload;
       if (!RecvMsg(fd_, &type, &payload)) break;
+      if (type == kDataResult) {
+        // [u8 ok][u32 nlen][name][data-or-error]
+        if (payload.size() < 5) continue;
+        bool ok = payload[0] != 0;
+        uint32_t nlen;
+        std::memcpy(&nlen, payload.data() + 1, 4);
+        if (nlen > payload.size() - 5) continue;  // guards 32-bit overflow
+        std::string name(payload.data() + 5, nlen);
+        std::string data(payload.data() + 5 + nlen,
+                         payload.size() - 5 - nlen);
+        std::lock_guard<std::mutex> lk(mu_);
+        data_results_[name] = {ok, std::move(data)};
+        cv_.notify_all();
+        continue;
+      }
       if (type != kResponseList) continue;
       ResponseList rl;
       if (!ResponseList::Parse(payload.data(), payload.size(), &rl)) continue;
@@ -504,6 +694,8 @@ class ControllerClient {
   // name → (error_message or "", fused group "a;b;c")
   std::unordered_map<std::string, std::pair<std::string, std::string>>
       results_;
+  // name → (ok, payload-or-error)
+  std::unordered_map<std::string, std::pair<bool, std::string>> data_results_;
   bool dead_ = false;
   std::atomic<bool> closing_{false};
 };
@@ -585,6 +777,29 @@ int hvd_client_wait(void* h, const char* name, double timeout_ms,
 
 int hvd_client_wait_join(void* h, double timeout_ms) {
   return static_cast<hvd::ControllerClient*>(h)->WaitJoin(timeout_ms);
+}
+
+int hvd_client_submit_data(void* h, const char* name, int op, int dtype,
+                           int root_rank, const void* buf,
+                           long long nbytes) {
+  return static_cast<hvd::ControllerClient*>(h)->SubmitData(
+             name, static_cast<uint8_t>(op), static_cast<uint8_t>(dtype),
+             root_rank, buf, static_cast<size_t>(nbytes))
+             ? 0
+             : -1;
+}
+
+int hvd_client_wait_data(void* h, const char* name, double timeout_ms,
+                         void* out, long long cap, long long* out_len,
+                         char* err_buf, int err_len) {
+  size_t n = 0;
+  std::string err;
+  int rc = static_cast<hvd::ControllerClient*>(h)->WaitData(
+      name, timeout_ms, static_cast<char*>(out),
+      cap > 0 ? static_cast<size_t>(cap) : 0, &n, &err);
+  if (out_len) *out_len = static_cast<long long>(n);
+  if (err_buf && err_len > 0) std::snprintf(err_buf, err_len, "%s", err.c_str());
+  return rc;
 }
 
 void hvd_client_close(void* h) {

@@ -137,15 +137,32 @@ def init(
     with _lock:
         if _state.initialized:
             return
-        if os.environ.get("HVD_COORDINATOR_ADDR") and jax.process_count() == 1:
+        if os.environ.get("HVD_COORDINATOR_ADDR"):
             # Multi-host bootstrap: the tpurun launcher sets these.  This is
             # the rendezvous step — the analog of GlooContext::Initialize's
             # HTTP KV-store handshake (reference gloo/gloo_context.cc:113-157).
-            jax.distributed.initialize(
-                coordinator_address=os.environ["HVD_COORDINATOR_ADDR"],
-                num_processes=int(os.environ.get("HVD_NUM_PROCESSES", "1")),
-                process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
-            )
+            # Must run before anything touches the XLA backend; if the user
+            # (or a passed `devices=` argument) already initialized it, fall
+            # back to env-based process identity — the eager planes still
+            # span the job through the native controller.
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=os.environ["HVD_COORDINATOR_ADDR"],
+                    num_processes=int(os.environ.get("HVD_NUM_PROCESSES", "1")),
+                    process_id=int(os.environ.get("HVD_PROCESS_ID", "0")),
+                )
+            except RuntimeError as e:
+                # Only tolerate "backend already initialized" — a genuine
+                # bootstrap failure (unreachable coordinator) must not
+                # silently shrink the job to per-host training.
+                msg = str(e)
+                if "must be called before" not in msg and \
+                        "already initialized" not in msg:
+                    raise
+                log.warning(
+                    "jax.distributed bootstrap unavailable (%s); using "
+                    "env-based process identity", e,
+                )
 
         devs = list(devices) if devices is not None else _pick_devices(platform)
         # Process-major ordering so each controller's devices are contiguous
@@ -175,6 +192,18 @@ def init(
             (CROSS_AXIS, LOCAL_AXIS),
         )
 
+        # Process identity: jax.distributed when it spans processes, else
+        # the HVD_* env set by the launcher (tpurun / function-mode run()) —
+        # the native-controller-only deployment, where the XLA plane stays
+        # per-process but the eager control/data planes span the job
+        # (reference gloo_context.cc:128-156 reads HOROVOD_RANK/SIZE the
+        # same way).
+        if jax.process_count() > 1:
+            process_index, process_count = jax.process_index(), jax.process_count()
+        else:
+            process_count = env_util.get_int(env_util.HVD_NUM_PROCESSES, 1)
+            process_index = env_util.get_int(env_util.HVD_PROCESS_ID, 0)
+
         _state = _GlobalState(
             initialized=True,
             devices=tuple(devs),
@@ -183,8 +212,8 @@ def init(
             size=size,
             local_size=local_size,
             cross_size=cross_size,
-            process_index=jax.process_index(),
-            process_count=jax.process_count(),
+            process_index=process_index,
+            process_count=process_count,
             platform=devs[0].platform,
             epoch=_state.epoch + 1,
         )
@@ -199,6 +228,12 @@ def init(
                 _state.process_index, _state.process_count
             )
         except Exception as e:  # noqa: BLE001
+            # A requested native controller that can't start (e.g. its port
+            # is taken on this host) means a multi-process job with no
+            # transport — fail loudly rather than deadlock later.
+            if env_util.get_str(env_util.HVD_CONTROLLER) == "native" \
+                    and _state.process_count > 1:
+                raise
             log.warning("eager controller setup failed: %s", e)
         # Env-driven timeline startup, as the reference core does when
         # HOROVOD_TIMELINE is set (reference operations.cc:392-400):

@@ -141,6 +141,14 @@ def broadcast_(tensors, root_rank: int = 0, *, name: Optional[str] = None):
 # ---------------------------------------------------------------------------
 # process-plane (multi-controller) object collectives
 # ---------------------------------------------------------------------------
+def _jax_spans_processes() -> bool:
+    """True when the XLA plane itself is multi-process (jax.distributed on a
+    real pod) — then multihost_utils is the transport.  Otherwise a
+    multi-process job must carry host objects over the native controller's
+    data plane (csrc/controller.cc HandleData)."""
+    return jax.process_count() > 1
+
+
 def broadcast_object(obj: Any, root_rank: int = 0, *, name: Optional[str] = None):
     """Serialize ``obj`` on the root process and broadcast it to all
     controller processes (reference horovod/torch/__init__.py:580-638
@@ -148,6 +156,17 @@ def broadcast_object(obj: Any, root_rank: int = 0, *, name: Optional[str] = None
     bcast).  Single-process: identity."""
     if core.process_size() == 1:
         return obj
+    if not _jax_spans_processes():
+        c = eager_controller.client()
+        if c is None:
+            raise RuntimeError(
+                "multi-process job without a transport: launch with the "
+                "native controller (tpurun --controller native) or "
+                "jax.distributed"
+            )
+        nm = name or eager_controller.next_name("broadcast_object")
+        payload = pickle.dumps(obj) if core.process_rank() == root_rank else b""
+        return pickle.loads(c.broadcast_data(nm, payload, root_rank=root_rank))
     from jax.experimental import multihost_utils
 
     if core.process_rank() == root_rank:
@@ -172,6 +191,17 @@ def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
     upstream allgather_object pattern).  Single-process: ``[obj]``."""
     if core.process_size() == 1:
         return [obj]
+    if not _jax_spans_processes():
+        c = eager_controller.client()
+        if c is None:
+            raise RuntimeError(
+                "multi-process job without a transport: launch with the "
+                "native controller (tpurun --controller native) or "
+                "jax.distributed"
+            )
+        nm = name or eager_controller.next_name("allgather_object")
+        blobs = c.allgather_data(nm, pickle.dumps(obj))
+        return [pickle.loads(b) for b in blobs]
     from jax.experimental import multihost_utils
 
     payload = np.frombuffer(pickle.dumps(obj), np.uint8)
@@ -186,3 +216,30 @@ def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
         pickle.loads(gathered[i, : int(sizes[i])].tobytes())
         for i in range(core.process_size())
     ]
+
+
+def process_allreduce(arr, *, op: str = Average,
+                      name: Optional[str] = None) -> np.ndarray:
+    """Reduce one numpy array per controller process (host plane).
+
+    The torch/TF bindings' cross-process reduction: over the native data
+    plane when available (true elementwise sum in C++, the Gloo-CPU-ops
+    analog), falling back to the pickle allgather on jax.distributed pods.
+    """
+    arr = np.asarray(arr)
+    if core.process_size() == 1:
+        return arr
+    c = eager_controller.client()
+    if c is not None:
+        nm = name or eager_controller.next_name("process_allreduce")
+        wire = arr if str(arr.dtype) in (
+            "float32", "float64", "int32", "int64", "bfloat16"
+        ) else arr.astype(np.float32)
+        out = c.allreduce_data(nm, wire)
+        if op == Average:
+            out = out / core.process_size()
+        return out.astype(arr.dtype) if out.dtype != arr.dtype else out
+    gathered = allgather_object(arr, name=name)
+    stacked = np.stack(gathered)
+    return stacked.mean(0).astype(arr.dtype) if op == Average \
+        else stacked.sum(0).astype(arr.dtype)

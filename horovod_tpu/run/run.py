@@ -66,6 +66,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--start-timeout", type=int, default=600)
     parser.add_argument("--ssh-port", type=int, dest="ssh_port")
     parser.add_argument("--disable-cache", action="store_true")
+    parser.add_argument("--controller", dest="controller",
+                        choices=["auto", "xla", "native"], default="auto",
+                        help="eager control plane: 'native' runs the C++ "
+                             "negotiation controller (multi-process jobs "
+                             "get it by default); 'xla' relies on the "
+                             "compiled schedule only")
+    parser.add_argument("--dry-run", action="store_true", dest="dry_run",
+                        help="print the worker launch plan (env + command "
+                             "per process) without spawning anything")
 
     group_params = parser.add_argument_group("tuneable parameter arguments")
     group_params.add_argument("--fusion-threshold-mb", type=float,
@@ -156,13 +165,23 @@ def _resolve_hosts(args) -> List[HostInfo]:
 
 
 def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
-                coordinator: str) -> List[Dict[str, str]]:
+                coordinator: str, *, controller: str = "auto",
+                controller_addr: Optional[str] = None) -> List[Dict[str, str]]:
     """Per-host worker env dicts (reference gloo_run.py:210-216 sets
     HOROVOD_RANK/SIZE/LOCAL_RANK/... per slot; here per host-process, with
-    the slot table embedded for the chips it owns)."""
+    the slot table embedded for the chips it owns).
+
+    ``controller``: the eager control plane.  'auto' = native for
+    multi-process jobs (the reference always stands up its controller,
+    operations.cc:596-640), xla for single-process.  The native controller
+    server runs inside process 0 (runtime/eager_controller.py); workers
+    dial ``controller_addr``.
+    """
     hosts: Dict[str, List[SlotInfo]] = {}
     for s in slots:
         hosts.setdefault(s.hostname, []).append(s)
+    if controller == "auto":
+        controller = "native" if len(hosts) > 1 else "xla"
     envs = []
     for pid, (hostname, host_slots) in enumerate(hosts.items()):
         first = host_slots[0]
@@ -176,9 +195,11 @@ def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
             env_util.HVD_CROSS_SIZE: str(first.cross_size),
             env_util.HVD_NUM_PROCESSES: str(len(hosts)),
             env_util.HVD_PROCESS_ID: str(pid),
-            env_util.HVD_CONTROLLER: "xla",
+            env_util.HVD_CONTROLLER: controller,
             env_util.HVD_CPU_OPERATIONS: "xla",
         })
+        if controller == "native" and controller_addr:
+            env["HVD_CONTROLLER_ADDR"] = controller_addr
         if len(hosts) > 1:
             env[env_util.HVD_COORDINATOR_ADDR] = coordinator
         envs.append(env)
@@ -224,7 +245,23 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
     hosts = sorted({s.hostname for s in slots},
                    key=[s.hostname for s in slots].index)
     coordinator = f"{socket.gethostname()}:{env_util.get_int('HVD_COORD_PORT', 0) or _free_port()}"
-    envs = worker_envs(slots, env, coordinator)
+    # Native controller server lives in process 0, which runs on the first
+    # host; local jobs dial loopback.
+    ctrl_host = "127.0.0.1" if hosts[0] in LOCAL_HOSTS else hosts[0]
+    controller_addr = f"{ctrl_host}:{_free_port()}"
+    envs = worker_envs(
+        slots, env, coordinator,
+        controller=getattr(args, "controller", "auto") or "auto",
+        controller_addr=controller_addr,
+    )
+
+    if getattr(args, "dry_run", False):
+        for pid, hostname in enumerate(hosts):
+            print(f"[dry-run] process {pid} on {hostname}:")
+            for k in sorted(set(envs[pid]) - set(env)):
+                print(f"  {k}={envs[pid][k]}")
+            print(f"  command: {' '.join(args.command)}")
+        return 0
 
     job = _Job()
 
@@ -339,9 +376,18 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     import cloudpickle
 
     kwargs = kwargs or {}
+    extra_env = dict(extra_env or {})
     secret = _secrets.token_bytes(16)
     server = RendezvousServer(secret=secret)
     port = server.start()
+    # Multi-process workers need an eager transport: default to the native
+    # controller on loopback (server lives in worker 0) unless the caller
+    # configured one.
+    if np > 1 and env_util.HVD_CONTROLLER not in extra_env \
+            and not os.environ.get("HVD_CONTROLLER_ADDR"):
+        extra_env.setdefault(env_util.HVD_CONTROLLER, "native")
+        extra_env.setdefault("HVD_CONTROLLER_ADDR",
+                             f"127.0.0.1:{_free_port()}")
     # cloudpickle so lambdas/closures ship (reference run/common/util/codec.py
     # uses base64-cloudpickle for the same purpose)
     server.put("job", "fn", cloudpickle.dumps((fn, args, kwargs)))
@@ -350,7 +396,7 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     try:
         for pid in range(np):
             env = dict(os.environ)
-            env.update(extra_env or {})
+            env.update(extra_env)
             env.update({
                 "HVD_RUN_KV_ADDR": "127.0.0.1",
                 "HVD_RUN_KV_PORT": str(port),
@@ -359,6 +405,8 @@ def run(fn, args=(), kwargs=None, np: int = 1,
                 "HVD_RUN_NP": str(np),
                 env_util.HVD_RANK: str(pid),
                 env_util.HVD_SIZE: str(np),
+                env_util.HVD_NUM_PROCESSES: str(np),
+                env_util.HVD_PROCESS_ID: str(pid),
             })
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "horovod_tpu.run.task_fn"], env=env,

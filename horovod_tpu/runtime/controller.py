@@ -129,6 +129,76 @@ class ControllerClient:
             raise TimeoutError(f"negotiation of {name!r} timed out")
         raise ConnectionError("controller connection lost")
 
+    def submit_data(self, name: str, payload: bytes, *,
+                    op: str = "allreduce", dtype="uint8",
+                    root_rank: int = 0) -> None:
+        """Send this rank's payload for the host data plane (the Gloo-CPU-ops
+        analog living in the coordinator, csrc/controller.cc HandleData)."""
+        rc = self._lib.hvd_client_submit_data(
+            self._h, name.encode(), REQUEST_TYPES[op], _dtype_code(dtype),
+            root_rank, payload, len(payload),
+        )
+        if rc != 0:
+            raise RuntimeError("controller submit_data failed (connection lost)")
+
+    def wait_data(self, name: str, timeout: float = 60.0) -> bytes:
+        """Block for the coordinator's reduced/gathered payload."""
+        n = ctypes.c_longlong(0)
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.hvd_client_wait_data(
+            self._h, name.encode(), timeout * 1000.0, None, 0,
+            ctypes.byref(n), err, len(err),
+        )
+        if rc == 4:  # result ready; fetch with a right-sized buffer
+            buf = ctypes.create_string_buffer(max(int(n.value), 1))
+            rc = self._lib.hvd_client_wait_data(
+                self._h, name.encode(), timeout * 1000.0, buf, n.value,
+                ctypes.byref(n), err, len(err),
+            )
+            if rc == 0:
+                return buf.raw[: int(n.value)]
+        if rc == 0:  # zero-length result
+            return b""
+        if rc == 1:
+            raise RuntimeError(err.value.decode())
+        if rc == 2:
+            raise TimeoutError(f"host collective {name!r} timed out")
+        raise ConnectionError("controller connection lost")
+
+    def allreduce_data(self, name: str, arr: "np.ndarray",
+                       timeout: float = 60.0) -> "np.ndarray":
+        """Sum ``arr`` elementwise across all ranks on the coordinator.
+        Caller divides for Average (the reference's divisor trick,
+        torch/mpi_ops.py:94-129)."""
+        arr = np.ascontiguousarray(arr)
+        dtype = str(arr.dtype)
+        if dtype not in ("float32", "float64", "int32", "int64", "bfloat16"):
+            raise TypeError(f"host allreduce unsupported for dtype {dtype}")
+        self.submit_data(name, arr.tobytes(), op="allreduce", dtype=dtype)
+        out = self.wait_data(name, timeout=timeout)
+        return np.frombuffer(out, arr.dtype).reshape(arr.shape).copy()
+
+    def allgather_data(self, name: str, payload: bytes,
+                       timeout: float = 60.0) -> List[bytes]:
+        """Gather each rank's variable-length payload; returns the list in
+        rank order (wire format: u32 count, u32 sizes, blobs)."""
+        self.submit_data(name, payload, op="allgather")
+        out = self.wait_data(name, timeout=timeout)
+        import struct
+
+        (count,) = struct.unpack_from("<I", out, 0)
+        sizes = struct.unpack_from(f"<{count}I", out, 4)
+        blobs, off = [], 4 + 4 * count
+        for s in sizes:
+            blobs.append(out[off: off + s])
+            off += s
+        return blobs
+
+    def broadcast_data(self, name: str, payload: bytes, root_rank: int = 0,
+                       timeout: float = 60.0) -> bytes:
+        self.submit_data(name, payload, op="broadcast", root_rank=root_rank)
+        return self.wait_data(name, timeout=timeout)
+
     def join(self) -> None:
         self._lib.hvd_client_join(self._h)
 

@@ -44,6 +44,10 @@ def setup_from_env(process_id: int, num_processes: int) -> None:
         return
     host, port_s = addr.rsplit(":", 1)
     port = int(port_s)
+    import socket
+
+    # the native client dials an IP (inet_pton); resolve hostnames here
+    host = socket.gethostbyname(host)
     from .controller import ControllerClient, ControllerServer
 
     if process_id == 0:
@@ -56,6 +60,26 @@ def setup_from_env(process_id: int, num_processes: int) -> None:
 
 def active() -> bool:
     return _client is not None
+
+
+def client():
+    """The process's ControllerClient (None when negotiation is inactive).
+    Exposes the host data plane: allreduce_data/allgather_data/
+    broadcast_data (csrc/controller.cc HandleData — the Gloo-CPU-ops
+    analog, reference horovod/common/ops/gloo_operations.cc)."""
+    return _client
+
+
+_seq = 0
+
+
+def next_name(prefix: str) -> str:
+    """Sequential default tensor names, identical across processes when ops
+    are issued in the same order (the reference's handle-derived default
+    names, torch/mpi_ops.py allreduce.noname.N)."""
+    global _seq
+    _seq += 1
+    return f"{prefix}.{_seq}"
 
 
 def negotiate(name: str, *, op: str, shape: Sequence[int], dtype,

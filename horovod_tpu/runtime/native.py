@@ -102,6 +102,17 @@ def load() -> ctypes.CDLL:
         ]
         lib.hvd_client_wait_join.restype = ctypes.c_int
         lib.hvd_client_wait_join.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.hvd_client_submit_data.restype = ctypes.c_int
+        lib.hvd_client_submit_data.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong,
+        ]
+        lib.hvd_client_wait_data.restype = ctypes.c_int
+        lib.hvd_client_wait_data.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p, ctypes.c_int,
+        ]
         lib.hvd_client_close.restype = None
         lib.hvd_client_close.argtypes = [ctypes.c_void_p]
 

@@ -19,6 +19,7 @@ torch-xla, which is out of scope for the runtime (SURVEY §7.3(4)).
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 from typing import Any, Dict, Optional
 
@@ -27,6 +28,7 @@ import numpy as np
 from .. import core, eager
 from ..core import Average, Sum, Adasum, Min, Max  # noqa: F401
 from ..ops.compression import Compression  # noqa: F401
+from ..runtime import eager_controller
 
 init = core.init
 shutdown = core.shutdown
@@ -44,39 +46,53 @@ nccl_built = core.nccl_built
 class HandleManager:
     """Async-op handle registry (reference torch/handle_manager.cc:
     AllocateHandle/MarkDone/PollHandle/WaitForCompletion + the outputs
-    map in torch/mpi_ops.py:72-75)."""
+    map in torch/mpi_ops.py:72-75).
+
+    Genuinely deferred: ``submit`` hands the collective to a background
+    thread (the analog of the reference's background communication thread +
+    GPU finalizer threads, operations.cc:333 / thread_pool.cc) so
+    reductions overlap the caller's compute; ``poll`` is the real
+    completion state and ``wait`` joins the future.  One thread per handle
+    — a bounded pool could deadlock across ranks when hook firing order
+    differs (every pooled worker blocked in wait_data on names the peer
+    hasn't submitted because its own submits are stuck in the queue).
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._next = 0
-        self._results: Dict[int, Any] = {}
-        self._done: Dict[int, bool] = {}
+        self._futures: Dict[int, concurrent.futures.Future] = {}
 
-    def allocate(self) -> int:
+    def submit(self, fn, *args) -> int:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def runner():
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
         with self._lock:
             h = self._next
             self._next += 1
-            self._done[h] = False
-            return h
-
-    def mark_done(self, handle: int, result: Any) -> None:
-        with self._lock:
-            self._results[handle] = result
-            self._done[handle] = True
+            self._futures[h] = fut
+        threading.Thread(target=runner, daemon=True,
+                         name=f"hvd-eager-{h}").start()
+        return h
 
     def poll(self, handle: int) -> bool:
         with self._lock:
-            return self._done.get(handle, False)
+            fut = self._futures.get(handle)
+        if fut is None:
+            raise ValueError(f"unknown handle {handle}")
+        return fut.done()
 
     def wait(self, handle: int) -> Any:
-        # JAX dispatch is async under the hood; by the time we store the
-        # result it is a future — materialize here (the "synchronize").
         with self._lock:
-            if handle not in self._done:
-                raise ValueError(f"unknown handle {handle}")
-            result = self._results.pop(handle)
-            del self._done[handle]
-        return result
+            fut = self._futures.pop(handle, None)
+        if fut is None:
+            raise ValueError(f"unknown handle {handle}")
+        return fut.result()
 
 
 _handles = HandleManager()
@@ -96,35 +112,32 @@ def _like(tensor, arr: np.ndarray):
     return arr
 
 
-def _eager_collective(fn, tensor, *fn_args, **fn_kw):
-    """Run a host-plane collective on one per-process tensor.  With a
-    single controller the process IS every rank's controller, so the
-    reduction is the identity family; multi-process goes through the
-    process-plane collectives (eager.py)."""
-    arr = _to_numpy(tensor)
-    return fn(arr, *fn_args, **fn_kw)
-
-
-def allreduce_async(tensor, average=None, name=None, op=None):
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    compression=Compression.none):
     """reference torch/mpi_ops.py:94-129 (op/average normalization and the
-    divisor trick: Average → Sum + divide)."""
+    divisor trick: Average → Sum + divide).  The reduction runs on the
+    handle pool: compression → cross-process sum over the native data
+    plane (or multihost_utils on a jax.distributed pod) → decompression."""
     op = _normalize_op(average, op)
-    h = _handles.allocate()
-
     arr = _to_numpy(tensor)
-    if core.process_size() == 1:
-        out = arr if op != Sum else arr * core.process_size()
-    else:
-        gathered = eager.allgather_object(arr)
-        stacked = np.stack(gathered)
-        out = stacked.mean(0) if op == Average else stacked.sum(0)
-    _handles.mark_done(h, _like(tensor, out))
-    return h
+    # Name allocated in program order on the caller thread so all
+    # processes agree even when pool workers race.
+    nm = name or eager_controller.next_name("allreduce.torch")
+
+    def work():
+        comp, ctx = compression.compress(arr)
+        out = eager.process_allreduce(np.asarray(comp), op=op, name=nm)
+        out = np.asarray(compression.decompress(out, ctx))
+        return _like(tensor, out)
+
+    return _handles.submit(work)
 
 
 def allreduce(tensor, average=None, name=None, op=None,
               compression=Compression.none):
-    return synchronize(allreduce_async(tensor, average, name, op))
+    return synchronize(
+        allreduce_async(tensor, average, name, op, compression)
+    )
 
 
 def allreduce_(tensor, average=None, name=None, op=None):
@@ -138,14 +151,20 @@ def allreduce_(tensor, average=None, name=None, op=None):
 
 
 def allgather_async(tensor, name=None):
-    h = _handles.allocate()
     arr = _to_numpy(tensor)
-    if core.process_size() == 1:
-        out = arr
-    else:
-        out = np.concatenate(eager.allgather_object(arr), axis=0)
-    _handles.mark_done(h, _like(tensor, out))
-    return h
+    nm = name or eager_controller.next_name("allgather.torch")
+
+    def work():
+        if core.process_size() == 1:
+            out = arr
+        else:
+            out = np.concatenate(
+                [np.asarray(g) for g in eager.allgather_object(arr, name=nm)],
+                axis=0,
+            )
+        return _like(tensor, out)
+
+    return _handles.submit(work)
 
 
 def allgather(tensor, name=None):
@@ -153,12 +172,15 @@ def allgather(tensor, name=None):
 
 
 def broadcast_async(tensor, root_rank, name=None):
-    h = _handles.allocate()
     arr = _to_numpy(tensor)
-    out = eager.broadcast_object(arr, root_rank=root_rank) \
-        if core.process_size() > 1 else arr
-    _handles.mark_done(h, _like(tensor, out))
-    return h
+    nm = name or eager_controller.next_name("broadcast.torch")
+
+    def work():
+        out = eager.broadcast_object(arr, root_rank=root_rank, name=nm) \
+            if core.process_size() > 1 else arr
+        return _like(tensor, np.asarray(out))
+
+    return _handles.submit(work)
 
 
 def broadcast(tensor, root_rank, name=None):
@@ -203,10 +225,11 @@ def _normalize_op(average, op):
 # optimizer + parameter sync
 # ---------------------------------------------------------------------------
 class _DistributedOptimizer:
-    """Wraps a torch.optim.Optimizer: allreduce each parameter gradient
-    before step() (reference torch/__init__.py:122-217; the per-parameter
-    backward hooks collapse to a pre-step sweep here because the host
-    collective is synchronous — overlap belongs to the compiled plane)."""
+    """Wraps a torch.optim.Optimizer: async-allreduce each parameter
+    gradient as it materializes during backward (grad-accumulator hooks,
+    reference torch/__init__.py:122-157), then join the handles in
+    ``synchronize()`` before step() — communication overlaps the rest of
+    the backward pass via the handle pool."""
 
     def __init__(self, optimizer, named_parameters=None,
                  compression=Compression.none,
@@ -216,6 +239,49 @@ class _DistributedOptimizer:
         self._op = op
         self.backward_passes_per_step = backward_passes_per_step
         self._counter = 0
+        self._param_names = {}
+        self._grad_accs = []         # keep accumulators alive (reference :150)
+        self._pending = {}           # param id -> (param, handle)
+        self._delay = {}             # param id -> remaining backward passes
+        if named_parameters is not None:
+            for n, p in named_parameters:
+                self._param_names[id(p)] = n
+        self._register_hooks()
+
+    def _name_of(self, p, fallback_idx: int) -> str:
+        return self._param_names.get(id(p), f"param.{fallback_idx}")
+
+    def _register_hooks(self) -> None:
+        idx = 0
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                i = idx
+                idx += 1
+                if not getattr(p, "requires_grad", False):
+                    continue
+                try:
+                    # the grad-accumulator node fires once p.grad is final
+                    # for this backward (reference torch/__init__.py:141-157)
+                    acc = p.expand_as(p).grad_fn.next_functions[0][0]
+                    acc.register_hook(self._make_hook(p, i))
+                    self._grad_accs.append(acc)
+                    self._delay[id(p)] = self.backward_passes_per_step
+                except (AttributeError, IndexError, RuntimeError, TypeError):
+                    pass  # non-autograd tensor: reduced in synchronize()
+
+    def _make_hook(self, p, idx: int):
+        def hook(*ignore):
+            self._delay[id(p)] -= 1
+            if self._delay[id(p)] > 0 or p.grad is None:
+                return
+            self._delay[id(p)] = self.backward_passes_per_step
+            self._pending[id(p)] = (p, allreduce_async(
+                p.grad, op=self._op,
+                name=f"allreduce.{self._name_of(p, idx)}",
+                compression=self._compression,
+            ))
+
+        return hook
 
     def __getattr__(self, item):
         return getattr(self._opt, item)
@@ -223,29 +289,38 @@ class _DistributedOptimizer:
     def zero_grad(self, *a, **kw):
         return self._opt.zero_grad(*a, **kw)
 
+    def _copy_into(self, g, red) -> None:
+        if hasattr(g, "copy_"):
+            import torch as th
+
+            g.copy_(th.from_numpy(
+                np.ascontiguousarray(np.asarray(red))).to(g.dtype))
+        else:
+            g[...] = red
+
     def synchronize(self) -> None:
-        """Allreduce all gradients now (reference torch/__init__.py:159-176
-        synchronize())."""
+        """Join outstanding gradient handles; reduce any gradient the hooks
+        missed (reference torch/__init__.py:159-176 synchronize())."""
+        idx = 0
         for group in self._opt.param_groups:
             for p in group["params"]:
-                if getattr(p, "grad", None) is not None:
-                    g = p.grad
-                    comp, ctx = self._compression.compress(_to_numpy(g))
-                    if core.process_size() > 1:
-                        gathered = eager.allgather_object(np.asarray(comp))
-                        stacked = np.stack(gathered)
-                        red = stacked.mean(0) if self._op == Average \
-                            else stacked.sum(0)
-                    else:
-                        red = np.asarray(comp)
-                    red = self._compression.decompress(red, ctx)
-                    if hasattr(g, "copy_"):
-                        import torch as th
-
-                        g.copy_(th.from_numpy(
-                            np.ascontiguousarray(red)).to(g.dtype))
-                    else:
-                        g[...] = red
+                i = idx
+                idx += 1
+                g = getattr(p, "grad", None)
+                if g is None:
+                    continue
+                if id(p) in self._pending:
+                    _, h = self._pending.pop(id(p))
+                    self._copy_into(g, _to_numpy(_handles.wait(h)))
+                else:
+                    # hookless tensor or manually-assigned grad (no backward
+                    # ran): same path as the async hook, joined immediately
+                    h = allreduce_async(
+                        g, op=self._op,
+                        name=f"allreduce.{self._name_of(p, i)}",
+                        compression=self._compression,
+                    )
+                    self._copy_into(g, _to_numpy(_handles.wait(h)))
 
     def step(self, closure=None):
         self._counter += 1

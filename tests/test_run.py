@@ -157,7 +157,24 @@ def test_worker_envs_per_host():
     assert e0["HVD_PROCESS_ID"] == "0" and e1["HVD_PROCESS_ID"] == "1"
     assert e0["HVD_COORDINATOR_ADDR"] == "coord:1234"
     assert e0["HVD_LOG_LEVEL"] == "info"
-    assert e0["HVD_CONTROLLER"] == "xla"
+    # multi-process jobs get the native eager controller by default
+    # (reference always stands its controller up, operations.cc:596-640)
+    assert e0["HVD_CONTROLLER"] == "native"
+
+
+def test_worker_envs_controller_selection():
+    slots = allocate_slots(parse_hosts("h1:4,h2:4"), 8)
+    envs = worker_envs(slots, {}, "coord:1", controller="native",
+                       controller_addr="h1:9999")
+    assert all(e["HVD_CONTROLLER"] == "native" for e in envs)
+    assert all(e["HVD_CONTROLLER_ADDR"] == "h1:9999" for e in envs)
+    envs = worker_envs(slots, {}, "coord:1", controller="xla")
+    assert all(e["HVD_CONTROLLER"] == "xla" for e in envs)
+    assert all("HVD_CONTROLLER_ADDR" not in e for e in envs)
+    # single host auto-selects xla
+    slots1 = allocate_slots(parse_hosts("localhost:8"), 8)
+    envs = worker_envs(slots1, {}, "coord:1")
+    assert envs[0]["HVD_CONTROLLER"] == "xla"
 
 
 def test_single_host_no_coordinator():

@@ -40,8 +40,14 @@ def test_allreduce_op_normalization(torch_init):
 
 
 def test_async_handle_poll_synchronize(torch_init):
+    import time
+
     t = torch.ones(4)
     h = hvd_torch.allreduce_async(t)
+    # genuinely deferred now: poll reports live completion state
+    deadline = time.time() + 10
+    while not hvd_torch.poll(h) and time.time() < deadline:
+        time.sleep(0.01)
     assert hvd_torch.poll(h)
     out = hvd_torch.synchronize(h)
     assert torch.allclose(out, t)
