@@ -151,12 +151,84 @@ void SumIntoBf16(std::string* acc, const std::string& src) {
     a[i] = F32ToBf16(Bf16ToF32(a[i]) + Bf16ToF32(b[i]));
 }
 
+// IEEE fp16 ↔ fp32 (the software path the reference keeps in half.cc:38-75;
+// no AVX needed at control-plane sizes).
+inline float Fp16ToF32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(mant & 0x400)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3FF;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (mant << 13);  // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t RneShift(uint32_t mant, uint32_t shift) {
+  // round-to-nearest-even right shift
+  uint32_t h = mant >> shift;
+  uint32_t low = mant & ((1u << shift) - 1);
+  uint32_t half_point = 1u << (shift - 1);
+  if (low > half_point || (low == half_point && (h & 1))) h += 1;
+  return static_cast<uint16_t>(h);
+}
+
+inline uint16_t F32ToFp16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  uint32_t absbits = bits & 0x7FFFFFFFu;
+  if (absbits >= 0x7F800000u) {  // inf / nan
+    uint16_t mant = (absbits & 0x7FFFFF) ? 0x200 : 0;
+    return static_cast<uint16_t>(sign | 0x7C00u | mant);
+  }
+  int32_t exp = static_cast<int32_t>(absbits >> 23) - 127 + 15;
+  uint32_t mant = absbits & 0x7FFFFF;
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00u);  // overflow
+  if (exp <= 0) {                                               // subnormal
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    return static_cast<uint16_t>(
+        sign | RneShift(mant | 0x800000u, static_cast<uint32_t>(14 - exp)));
+  }
+  // normal: mantissa rounding may carry into the exponent — addition makes
+  // the carry correct by construction (a full-mantissa round-up increments
+  // exp; exp 31 becomes inf with zero mantissa)
+  uint32_t h = (static_cast<uint32_t>(exp) << 10) +
+               (static_cast<uint32_t>(RneShift(mant | 0x800000u, 13)) - 0x400u);
+  return static_cast<uint16_t>(sign | h);
+}
+
+void SumIntoFp16(std::string* acc, const std::string& src) {
+  uint16_t* a = reinterpret_cast<uint16_t*>(acc->data());
+  const uint16_t* b = reinterpret_cast<const uint16_t*>(src.data());
+  size_t n = acc->size() / 2;
+  for (size_t i = 0; i < n; ++i)
+    a[i] = F32ToFp16(Fp16ToF32(a[i]) + Fp16ToF32(b[i]));
+}
+
 // dtype codes match horovod_tpu/runtime/controller.py _DTYPES.
 bool SumPayload(uint8_t dtype, std::string* acc, const std::string& src) {
   if (acc->size() != src.size()) return false;
   switch (dtype) {
     case 0: SumInto<float>(acc, src); return true;
     case 1: SumIntoBf16(acc, src); return true;
+    case 2: SumIntoFp16(acc, src); return true;
     case 3: SumInto<double>(acc, src); return true;
     case 4: SumInto<int32_t>(acc, src); return true;
     case 5: SumInto<int64_t>(acc, src); return true;
@@ -244,6 +316,8 @@ class ControllerServer {
     std::vector<std::string> payloads;  // per rank
     std::vector<bool> have;
     int count = 0;
+    bool error = false;
+    std::string error_message;
   };
 
   void Loop() {
@@ -332,6 +406,13 @@ class ControllerServer {
       d.root = root;
       d.have.assign(nranks_, false);
       d.payloads.resize(nranks_);
+    } else if (op != d.op || dtype != d.dtype || root != d.root) {
+      // cross-rank metadata agreement, like the negotiation plane
+      // (reference controller.cc:377-610 ConstructResponse validation)
+      d.error = true;
+      d.error_message = "Mismatched host-collective metadata for " + name +
+                        ": rank " + std::to_string(rank) +
+                        " disagrees on op/dtype/root";
     }
     if (!d.have[rank]) {
       d.have[rank] = true;
@@ -340,16 +421,22 @@ class ControllerServer {
     }
     if (d.count >= nranks_) {
       std::string result;
-      bool ok = ComputeDataResult(d, &result);
+      bool ok = !d.error && ComputeDataResult(d, &result);
       // kDataResult payload: [u8 ok][u32 nlen][name][data-or-error]
       std::string out;
       out.push_back(ok ? 1 : 0);
       PutU32(&out, nlen);
       out += name;
-      out += ok ? result : std::string("host collective failed: dtype ") +
-                               std::to_string(d.dtype) +
-                               " unsupported for allreduce or payload sizes "
-                               "mismatch across ranks";
+      if (ok) {
+        out += result;
+      } else if (d.error) {
+        out += d.error_message;
+      } else {
+        out += std::string("host collective failed: dtype ") +
+               std::to_string(d.dtype) +
+               " unsupported for allreduce or payload sizes mismatch "
+               "across ranks";
+      }
       for (auto& [fd, r] : clients_) SendMsg(fd, kDataResult, out);
       data_table_.erase(name);
     }

@@ -55,6 +55,12 @@ def parse_args(argv=None):
                         help="use two-level (ICI/DCN-style) allreduce")
     parser.add_argument("--platform", type=str, default=None,
                         help="jax platform override (tpu/cpu)")
+    parser.add_argument("--autotune", action="store_true", default=False,
+                        help="live-tune fusion threshold / hierarchical "
+                             "allreduce while benchmarking (reference "
+                             "horovodrun --autotune)")
+    parser.add_argument("--autotune-log-file", type=str, default=None,
+                        help="CSV trace of autotune samples")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"],
                         help="model compute dtype (params stay float32)")
@@ -98,6 +104,8 @@ def run(args) -> dict:
         else hvd.Compression.none,
         has_batch_stats=True,
         hierarchical=args.hierarchical,
+        autotune=args.autotune or None,
+        autotune_log_file=args.autotune_log_file,
     )
 
     state = init_train_state(
@@ -131,6 +139,12 @@ def run(args) -> dict:
         img_sec = args.batch_size * args.num_batches_per_iter * hvd.size() / dt
         log(f"Iter: Img/sec total: {img_sec:.1f}")
         img_secs.append(img_sec)
+
+    pm = getattr(step, "parameter_manager", None)
+    if pm is not None:
+        log(f"Autotune: frozen={pm.frozen} "
+            f"threshold={pm.current.fusion_threshold_bytes} "
+            f"hierarchical={pm.current.hierarchical_allreduce}")
 
     img_sec_mean = float(np.mean(img_secs))
     img_sec_conf = float(1.96 * np.std(img_secs))

@@ -156,8 +156,10 @@ def init(
                 # bootstrap failure (unreachable coordinator) must not
                 # silently shrink the job to per-host training.
                 msg = str(e)
-                if "must be called before" not in msg and \
-                        "already initialized" not in msg:
+                tolerable = ("must be called before" in msg
+                             or "already initialized" in msg
+                             or "only be called once" in msg)
+                if not tolerable:
                     raise
                 log.warning(
                     "jax.distributed bootstrap unavailable (%s); using "

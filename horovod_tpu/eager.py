@@ -233,7 +233,7 @@ def process_allreduce(arr, *, op: str = Average,
     if c is not None:
         nm = name or eager_controller.next_name("process_allreduce")
         wire = arr if str(arr.dtype) in (
-            "float32", "float64", "int32", "int64", "bfloat16"
+            "float32", "float64", "int32", "int64", "bfloat16", "float16"
         ) else arr.astype(np.float32)
         out = c.allreduce_data(nm, wire)
         if op == Average:

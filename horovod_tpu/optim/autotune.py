@@ -163,6 +163,7 @@ class ParameterManager:
         log_file: Optional[str] = None,
         on_update: Optional[Callable[[TunableParams], None]] = None,
         tune_hierarchical: bool = True,
+        initial: Optional[TunableParams] = None,
     ):
         self.enabled = enabled if enabled is not None else \
             env_util.get_bool(env_util.HVD_AUTOTUNE)
@@ -185,7 +186,7 @@ class ParameterManager:
             for i, cat in enumerate(self._categories)
         }
         self._cat_idx = 0
-        self.current = TunableParams()
+        self.current = initial if initial is not None else TunableParams()
         self._samples_seen = 0
         self._warmup_left = self.warmup_samples
         self._step_scores: List[float] = []

@@ -200,6 +200,9 @@ def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
         })
         if controller == "native" and controller_addr:
             env["HVD_CONTROLLER_ADDR"] = controller_addr
+            # the launcher hosts the server (port 0 bound locally — no
+            # remote-port race); workers are clients only
+            env["HVD_CONTROLLER_SERVER"] = "external"
         if len(hosts) > 1:
             env[env_util.HVD_COORDINATOR_ADDR] = coordinator
         envs.append(env)
@@ -245,14 +248,27 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
     hosts = sorted({s.hostname for s in slots},
                    key=[s.hostname for s in slots].index)
     coordinator = f"{socket.gethostname()}:{env_util.get_int('HVD_COORD_PORT', 0) or _free_port()}"
-    # Native controller server lives in process 0, which runs on the first
-    # host; local jobs dial loopback.
-    ctrl_host = "127.0.0.1" if hosts[0] in LOCAL_HOSTS else hosts[0]
-    controller_addr = f"{ctrl_host}:{_free_port()}"
+
+    controller = getattr(args, "controller", "auto") or "auto"
+    if controller == "auto":
+        controller = "native" if len(hosts) > 1 else "xla"
+    # The launcher hosts the native controller server (the reference hosts
+    # its rendezvous on the launcher the same way, gloo_run.py:262-288):
+    # bind port 0 locally, point workers at this machine.
+    ctrl_server = None
+    controller_addr = None
+    if controller == "native" and not getattr(args, "dry_run", False):
+        from ..runtime.controller import ControllerServer
+
+        ctrl_server = ControllerServer(len(hosts), port=0)
+        ctrl_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
+            else socket.gethostname()
+        controller_addr = f"{ctrl_host}:{ctrl_server.port}"
+    elif controller == "native":
+        controller_addr = "<launcher>:<bound-at-launch>"
     envs = worker_envs(
         slots, env, coordinator,
-        controller=getattr(args, "controller", "auto") or "auto",
-        controller_addr=controller_addr,
+        controller=controller, controller_addr=controller_addr,
     )
 
     if getattr(args, "dry_run", False):
@@ -315,6 +331,13 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
     finally:
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGTERM, old_term)
+        if ctrl_server is not None:
+            log.info(
+                "controller: %d cycles, %d cache hits, %d stall warnings",
+                ctrl_server.cycles, ctrl_server.cache_hits,
+                ctrl_server.stall_warnings,
+            )
+            ctrl_server.stop()
 
 
 def _pump_output(proc: subprocess.Popen, pid: int,
@@ -380,14 +403,21 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     secret = _secrets.token_bytes(16)
     server = RendezvousServer(secret=secret)
     port = server.start()
-    # Multi-process workers need an eager transport: default to the native
-    # controller on loopback (server lives in worker 0) unless the caller
-    # configured one.
-    if np > 1 and env_util.HVD_CONTROLLER not in extra_env \
+    # Multi-process workers need an eager transport: default to a
+    # parent-hosted native controller on loopback (bound to port 0 — no
+    # races) unless the caller or environment configured the controller.
+    ctrl_server = None
+    user_controller = extra_env.get(
+        env_util.HVD_CONTROLLER, os.environ.get(env_util.HVD_CONTROLLER)
+    )
+    if np > 1 and user_controller is None \
             and not os.environ.get("HVD_CONTROLLER_ADDR"):
-        extra_env.setdefault(env_util.HVD_CONTROLLER, "native")
-        extra_env.setdefault("HVD_CONTROLLER_ADDR",
-                             f"127.0.0.1:{_free_port()}")
+        from ..runtime.controller import ControllerServer
+
+        ctrl_server = ControllerServer(np, port=0)
+        extra_env[env_util.HVD_CONTROLLER] = "native"
+        extra_env["HVD_CONTROLLER_ADDR"] = f"127.0.0.1:{ctrl_server.port}"
+        extra_env["HVD_CONTROLLER_SERVER"] = "external"
     # cloudpickle so lambdas/closures ship (reference run/common/util/codec.py
     # uses base64-cloudpickle for the same purpose)
     server.put("job", "fn", cloudpickle.dumps((fn, args, kwargs)))
@@ -430,6 +460,8 @@ def run(fn, args=(), kwargs=None, np: int = 1,
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        if ctrl_server is not None:
+            ctrl_server.stop()
         server.stop()
 
 

@@ -172,7 +172,8 @@ class ControllerClient:
         torch/mpi_ops.py:94-129)."""
         arr = np.ascontiguousarray(arr)
         dtype = str(arr.dtype)
-        if dtype not in ("float32", "float64", "int32", "int64", "bfloat16"):
+        if dtype not in ("float32", "float64", "int32", "int64",
+                         "bfloat16", "float16"):
             raise TypeError(f"host allreduce unsupported for dtype {dtype}")
         self.submit_data(name, arr.tobytes(), op="allreduce", dtype=dtype)
         out = self.wait_data(name, timeout=timeout)

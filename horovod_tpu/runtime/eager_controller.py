@@ -50,7 +50,11 @@ def setup_from_env(process_id: int, num_processes: int) -> None:
     host = socket.gethostbyname(host)
     from .controller import ControllerClient, ControllerServer
 
-    if process_id == 0:
+    # The launcher (tpurun / function-mode run()) hosts the server itself
+    # and marks it external — it binds port 0 there, so no remote-host port
+    # race.  Only self-assembled jobs start the server in process 0.
+    if process_id == 0 and \
+            env_util.get_str("HVD_CONTROLLER_SERVER") != "external":
         _server = ControllerServer(num_processes, port=port)
     _client = ControllerClient(host, port, process_id)
     atexit.register(shutdown)

@@ -119,7 +119,10 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     handle pool: compression → cross-process sum over the native data
     plane (or multihost_utils on a jax.distributed pod) → decompression."""
     op = _normalize_op(average, op)
-    arr = _to_numpy(tensor)
+    # Snapshot at submit time: _to_numpy aliases the live tensor, and the
+    # background thread must not observe later mutations (grad
+    # accumulation, zero_grad) racing the wire serialization.
+    arr = np.array(_to_numpy(tensor), copy=True)
     # Name allocated in program order on the caller thread so all
     # processes agree even when pool workers race.
     nm = name or eager_controller.next_name("allreduce.torch")

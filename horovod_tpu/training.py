@@ -41,6 +41,8 @@ def make_train_step(
     threshold_bytes: Optional[int] = None,
     donate: bool = True,
     hierarchical: bool = False,
+    autotune: Optional[bool] = None,
+    autotune_log_file: Optional[str] = None,
 ):
     """Returns ``step(state, batch, labels) -> (state, loss)`` compiled SPMD
     over the global mesh.
@@ -50,59 +52,95 @@ def make_train_step(
     * gradients are bucket-fused and allreduced with ``op``/``compression``;
       the loss is also averaged across ranks for reporting (matching
       MetricAverageCallback semantics, reference _keras/callbacks.py:46-60).
+    * ``autotune`` (default: the HVD_AUTOTUNE env, reference run.py:490-521
+      --autotune) drives a live ParameterManager: it scores each step as
+      bytes/sec, moves the fusion-threshold / hierarchical knobs, and
+      re-jits the step when they change — the compiled-world analog of the
+      reference's "new parameters take effect next cycle"
+      (parameter_manager.cc Update/Tune).  The returned function exposes
+      the manager as ``step.parameter_manager``.
     """
     from .ops import collectives
     from .parallel.hierarchical import hierarchical_allreduce
 
-    def per_rank_step(state: TrainState, x, y):
-        def compute_loss(params):
-            variables = {"params": params, **state.model_state}
-            if has_batch_stats:
-                logits, updates = apply_fn(
-                    variables, x, train=True, mutable=["batch_stats"]
+    def _build(threshold_b, hier):
+        def per_rank_step(state: TrainState, x, y):
+            def compute_loss(params):
+                variables = {"params": params, **state.model_state}
+                if has_batch_stats:
+                    logits, updates = apply_fn(
+                        variables, x, train=True, mutable=["batch_stats"]
+                    )
+                    return loss_fn(logits, y), updates
+                logits = apply_fn(variables, x)
+                return loss_fn(logits, y), {}
+
+            (loss, new_model_state), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(state.params)
+
+            if hier:
+                grads = jax.tree_util.tree_map(
+                    lambda g: hierarchical_allreduce(g, op=op), grads
                 )
-                return loss_fn(logits, y), updates
-            logits = apply_fn(variables, x)
-            return loss_fn(logits, y), {}
+            else:
+                grads = allreduce_pytree(
+                    grads, op=op, compression=compression,
+                    threshold_bytes=threshold_b,
+                )
+            loss = collectives.allreduce(loss, op=Average)
 
-        (loss, new_model_state), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
-
-        if hierarchical:
-            grads = jax.tree_util.tree_map(
-                lambda g: hierarchical_allreduce(g, op=op), grads
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
             )
-        else:
-            grads = allreduce_pytree(
-                grads, op=op, compression=compression,
-                threshold_bytes=threshold_bytes,
+            import optax
+
+            params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(params, opt_state, new_model_state, state.step + 1),
+                loss,
             )
-        loss = collectives.allreduce(loss, op=Average)
 
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
+        # params/opt_state replicated; batch sharded across ranks on dim 0.
+        state_spec = TrainState(
+            params=P(), opt_state=P(), model_state=P(), step=P()
         )
-        import optax
-
-        params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(params, opt_state, new_model_state, state.step + 1),
-            loss,
+        return spmd(
+            per_rank_step,
+            in_specs=(state_spec, P(core.AXIS), P(core.AXIS)),
+            out_specs=(state_spec, P()),
+            donate_argnums=(0,) if donate else (),
         )
 
-    # params/opt_state replicated; batch sharded across ranks on dim 0.
-    state_spec = TrainState(params=P(), opt_state=P(), model_state=P(), step=P())
-    compiled = spmd(
-        per_rank_step,
-        in_specs=(state_spec, P(core.AXIS), P(core.AXIS)),
-        out_specs=(state_spec, P()),
-        donate_argnums=(0,) if donate else (),
-    )
+    from .utils import env as env_util
+
+    if autotune is None:
+        autotune = env_util.get_bool(env_util.HVD_AUTOTUNE)
+
+    pm = None
+    box = {}
+    if autotune:
+        from .optim.autotune import ParameterManager, TunableParams
+
+        initial = TunableParams(
+            fusion_threshold_bytes=threshold_bytes
+            or env_util.fusion_threshold_bytes(),
+            hierarchical_allreduce=hierarchical,
+        )
+        pm = ParameterManager(
+            enabled=True, log_file=autotune_log_file, initial=initial,
+        )
+        pm.on_update = lambda p: box.update(
+            fn=_build(p.fusion_threshold_bytes, p.hierarchical_allreduce)
+        )
+        box["fn"] = _build(initial.fusion_threshold_bytes,
+                           initial.hierarchical_allreduce)
+    else:
+        box["fn"] = _build(threshold_bytes, hierarchical)
 
     from .timeline.timeline import timeline
 
-    def step_with_timeline(state, x, y):
+    def _invoke(state, x, y):
         # Host-side step record: advances the trace window (reference
         # BYTEPS_TRACE_START/END_STEP semantics) and emits a STEP dispatch
         # span.  On the compiled path collective timing lives inside XLA;
@@ -118,10 +156,50 @@ def make_train_step(
             timeline.record_step(owner="train_step")
             timeline.mark_cycle_start()
             with timeline.span("train_step", "STEP"):
-                return compiled(state, x, y)
-        return compiled(state, x, y)
+                return box["fn"](state, x, y)
+        return box["fn"](state, x, y)
 
-    return step_with_timeline
+    if pm is None:
+        return _invoke
+
+    import time as _time
+
+    def step_autotuned(state, x, y):
+        if pm.frozen:
+            return _invoke(state, x, y)
+        if "grad_bytes" not in box:
+            import math
+
+            # per-step allreduce volume = the gradient pytree's bytes
+            box["grad_bytes"] = float(sum(
+                math.prod(l.shape) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(state.params)
+            ))
+        t0 = _time.perf_counter()
+        state, loss = _invoke(state, x, y)
+        # honest timing while tuning: force the step chain to complete
+        # (block_until_ready can return early on tunneled platforms)
+        jax.device_get(loss)
+        dt = _time.perf_counter() - t0
+        if core.process_size() > 1:
+            # Synchronize the measurement instead of the decision: every
+            # process scores the same averaged step time, and the
+            # deterministic tuner (fixed seed) then moves every process's
+            # knobs identically — the analog of the reference's
+            # SynchronizeParameters broadcast (controller.cc:33-47).
+            import numpy as _np
+
+            from . import eager
+
+            dt = float(eager.process_allreduce(
+                _np.asarray([dt], _np.float64), op=Average,
+                name="autotune.step_time",
+            )[0])
+        pm.record_step(box["grad_bytes"], dt)
+        return state, loss
+
+    step_autotuned.parameter_manager = pm
+    return step_autotuned
 
 
 def init_train_state(model, optimizer, sample_input, *, rngs=None,

@@ -86,3 +86,53 @@ def test_autotune_log_file(tmp_path):
     text = (tmp_path / "at.csv").read_text()
     assert text.startswith("timestamp,fusion_threshold,hierarchical,score")
     assert len(text.strip().splitlines()) >= 2
+
+
+def test_autotune_drives_train_step(hvd_init, monkeypatch, tmp_path, rng):
+    """make_train_step(autotune=True) scores steps, re-jits on knob moves,
+    and freezes — the reference's live in-loop tuning + cross-rank sync
+    (parameter_manager.cc, controller.cc:33-47 SynchronizeParameters)."""
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.mlp import MLP
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    monkeypatch.setenv("HVD_AUTOTUNE_WARMUP_SAMPLES", "0")
+    monkeypatch.setenv("HVD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+    monkeypatch.setenv("HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "3")
+
+    model = MLP(features=(16, 4))
+    opt = optax.sgd(0.05)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    log_file = tmp_path / "autotune.csv"
+    step = make_train_step(
+        apply_fn=model.apply, loss_fn=loss_fn, optimizer=opt,
+        autotune=True, autotune_log_file=str(log_file), donate=False,
+    )
+    pm = step.parameter_manager
+    assert pm is not None and not pm.frozen
+
+    state = init_train_state(model, opt, jnp.zeros((2, 8)))
+    x = shard_batch(rng.normal(size=(16, 8)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 4, size=(16,)).astype(np.int32))
+
+    thresholds = set()
+    for _ in range(40):
+        thresholds.add(pm.current.fusion_threshold_bytes)
+        state, loss = step(state, x, y)
+        if pm.frozen:
+            break
+    assert pm.frozen, "autotune must converge and freeze"
+    assert len(thresholds) > 1, "tuning must actually move the knob (re-jit)"
+    assert np.isfinite(float(np.asarray(loss)))
+    text = log_file.read_text()
+    assert text.startswith("timestamp,fusion_threshold,hierarchical,score")
