@@ -64,6 +64,8 @@ enum MsgType : uint8_t {
   kShutdown = 5,
   kData = 6,        // worker → coordinator: payload for a named collective
   kDataResult = 7,  // coordinator → worker: reduced/gathered payload
+  kStatsReq = 8,    // worker → coordinator: query coordinator counters
+  kStatsResult = 9, // coordinator → worker: [i64 cycles][i64 hits][i64 stalls]
 };
 
 double NowSec() {
@@ -376,6 +378,17 @@ class ControllerServer {
       }
     } else if (type == kData) {
       HandleData(payload);
+    } else if (type == kStatsReq) {
+      // counters over the wire, so any rank can observe coordinator health
+      // (the reference logs these rank-0-side only, controller.cc:164-193;
+      // here the launcher hosts the server, so workers must ask)
+      std::string out(24, '\0');
+      int64_t cyc = cycles_.load(), hits = cache_hits_.load(),
+              stalls = stall_warnings_.load();
+      std::memcpy(out.data(), &cyc, 8);
+      std::memcpy(out.data() + 8, &hits, 8);
+      std::memcpy(out.data() + 16, &stalls, 8);
+      SendMsg(fd, kStatsResult, out);
     } else if (type == kShutdown) {
       stopping_.store(true);
     }
@@ -726,6 +739,30 @@ class ControllerClient {
     return Wait("join", timeout_ms, &err, &group);
   }
 
+  // Ask the coordinator for its counters.  Returns 0 = OK, 2 = timeout,
+  // 3 = connection lost.
+  int QueryStats(double timeout_ms, int64_t* cycles, int64_t* hits,
+                 int64_t* stalls) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stats_ready_ = false;
+    }
+    {
+      std::lock_guard<std::mutex> lk(wmu_);
+      if (!SendMsg(fd_, kStatsReq, std::string())) return 3;
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    bool got = cv_.wait_for(
+        lk, std::chrono::milliseconds(static_cast<int64_t>(timeout_ms)),
+        [&] { return stats_ready_ || dead_; });
+    if (!got) return 2;
+    if (!stats_ready_) return dead_ ? 3 : 2;
+    *cycles = stats_[0];
+    *hits = stats_[1];
+    *stalls = stats_[2];
+    return 0;
+  }
+
  private:
   void ReadLoop() {
     for (;;) {
@@ -744,6 +781,16 @@ class ControllerClient {
                          payload.size() - 5 - nlen);
         std::lock_guard<std::mutex> lk(mu_);
         data_results_[name] = {ok, std::move(data)};
+        cv_.notify_all();
+        continue;
+      }
+      if (type == kStatsResult) {
+        if (payload.size() < 24) continue;
+        std::lock_guard<std::mutex> lk(mu_);
+        std::memcpy(&stats_[0], payload.data(), 8);
+        std::memcpy(&stats_[1], payload.data() + 8, 8);
+        std::memcpy(&stats_[2], payload.data() + 16, 8);
+        stats_ready_ = true;
         cv_.notify_all();
         continue;
       }
@@ -783,6 +830,8 @@ class ControllerClient {
       results_;
   // name → (ok, payload-or-error)
   std::unordered_map<std::string, std::pair<bool, std::string>> data_results_;
+  int64_t stats_[3] = {0, 0, 0};
+  bool stats_ready_ = false;
   bool dead_ = false;
   std::atomic<bool> closing_{false};
 };
@@ -886,6 +935,17 @@ int hvd_client_wait_data(void* h, const char* name, double timeout_ms,
       cap > 0 ? static_cast<size_t>(cap) : 0, &n, &err);
   if (out_len) *out_len = static_cast<long long>(n);
   if (err_buf && err_len > 0) std::snprintf(err_buf, err_len, "%s", err.c_str());
+  return rc;
+}
+
+int hvd_client_stats(void* h, double timeout_ms, long long* cycles,
+                     long long* hits, long long* stalls) {
+  int64_t c = 0, ch = 0, s = 0;
+  int rc = static_cast<hvd::ControllerClient*>(h)->QueryStats(timeout_ms, &c,
+                                                              &ch, &s);
+  if (cycles) *cycles = c;
+  if (hits) *hits = ch;
+  if (stalls) *stalls = s;
   return rc;
 }
 

@@ -200,6 +200,28 @@ class ControllerClient:
         self.submit_data(name, payload, op="broadcast", root_rank=root_rank)
         return self.wait_data(name, timeout=timeout)
 
+    def stats(self, timeout: float = 10.0) -> dict:
+        """Query the coordinator's counters over the wire — lets any rank
+        observe negotiation health when the server lives in the launcher
+        (the reference surfaces these rank-0-side only,
+        controller.cc:164-193)."""
+        cycles = ctypes.c_longlong(0)
+        hits = ctypes.c_longlong(0)
+        stalls = ctypes.c_longlong(0)
+        rc = self._lib.hvd_client_stats(
+            self._h, timeout * 1000.0,
+            ctypes.byref(cycles), ctypes.byref(hits), ctypes.byref(stalls),
+        )
+        if rc == 2:
+            raise TimeoutError("controller stats query timed out")
+        if rc != 0:
+            raise ConnectionError("controller connection lost")
+        return {
+            "cycles": int(cycles.value),
+            "cache_hits": int(hits.value),
+            "stall_warnings": int(stalls.value),
+        }
+
     def join(self) -> None:
         self._lib.hvd_client_join(self._h)
 

@@ -105,13 +105,17 @@ def join(timeout: float = 60.0) -> None:
 
 
 def server_stats() -> Optional[dict]:
-    if _server is None:
-        return None
-    return {
-        "cache_hits": _server.cache_hits,
-        "cycles": _server.cycles,
-        "stall_warnings": _server.stall_warnings,
-    }
+    """Coordinator counters: read locally when this process hosts the
+    server, otherwise queried over the wire (launcher-hosted server)."""
+    if _server is not None:
+        return {
+            "cache_hits": _server.cache_hits,
+            "cycles": _server.cycles,
+            "stall_warnings": _server.stall_warnings,
+        }
+    if _client is not None:
+        return _client.stats()
+    return None
 
 
 def shutdown() -> None:

@@ -113,6 +113,13 @@ def load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_longlong,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.hvd_client_stats.restype = ctypes.c_int
+        lib.hvd_client_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
         lib.hvd_client_close.restype = None
         lib.hvd_client_close.argtypes = [ctypes.c_void_p]
 

@@ -95,12 +95,13 @@ def test_two_process_collectives_and_stats():
         assert res["allreduce_sum"] == [3.0, 3.0, 3.0]
         assert res["bcast_obj"] == {"from": 1, "data": [1, 1, 1]}
         assert res["gathered"] == ["proc-0", "proc-1"]
-    # process 0 hosts the controller server; its stats must show activity
-    stats = results[0]["stats"]
-    assert stats is not None
-    assert stats["cycles"] > 0
-    assert stats["cache_hits"] >= 1
-    assert results[1]["stats"] is None
+    # the launcher hosts the controller server; every rank can query its
+    # counters over the wire and must see activity
+    for res in results:
+        stats = res["stats"]
+        assert stats is not None
+        assert stats["cycles"] > 0
+        assert stats["cache_hits"] >= 1
 
 
 def _worker_mismatch():
