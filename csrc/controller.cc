@@ -740,23 +740,27 @@ class ControllerClient {
   }
 
   // Ask the coordinator for its counters.  Returns 0 = OK, 2 = timeout,
-  // 3 = connection lost.
+  // 3 = connection lost.  Callers are serialized, and replies are counted
+  // (FIFO on the single TCP stream, one reply per request) so a late reply
+  // to a previously timed-out query can never satisfy a newer one with a
+  // stale snapshot.
   int QueryStats(double timeout_ms, int64_t* cycles, int64_t* hits,
                  int64_t* stalls) {
+    std::lock_guard<std::mutex> call_lk(stats_call_mu_);
+    uint64_t want;
     {
       std::lock_guard<std::mutex> lk(mu_);
-      stats_ready_ = false;
+      want = ++stats_sent_;  // our reply is the want-th kStatsResult
     }
     {
       std::lock_guard<std::mutex> lk(wmu_);
       if (!SendMsg(fd_, kStatsReq, std::string())) return 3;
     }
     std::unique_lock<std::mutex> lk(mu_);
-    bool got = cv_.wait_for(
+    cv_.wait_for(
         lk, std::chrono::milliseconds(static_cast<int64_t>(timeout_ms)),
-        [&] { return stats_ready_ || dead_; });
-    if (!got) return 2;
-    if (!stats_ready_) return dead_ ? 3 : 2;
+        [&] { return stats_recv_ >= want || dead_; });
+    if (stats_recv_ < want) return dead_ ? 3 : 2;
     *cycles = stats_[0];
     *hits = stats_[1];
     *stalls = stats_[2];
@@ -790,7 +794,7 @@ class ControllerClient {
         std::memcpy(&stats_[0], payload.data(), 8);
         std::memcpy(&stats_[1], payload.data() + 8, 8);
         std::memcpy(&stats_[2], payload.data() + 16, 8);
-        stats_ready_ = true;
+        ++stats_recv_;
         cv_.notify_all();
         continue;
       }
@@ -831,7 +835,9 @@ class ControllerClient {
   // name → (ok, payload-or-error)
   std::unordered_map<std::string, std::pair<bool, std::string>> data_results_;
   int64_t stats_[3] = {0, 0, 0};
-  bool stats_ready_ = false;
+  std::mutex stats_call_mu_;   // serializes QueryStats callers
+  uint64_t stats_sent_ = 0;    // kStatsReq sent (guarded by mu_)
+  uint64_t stats_recv_ = 0;    // kStatsResult received (guarded by mu_)
   bool dead_ = false;
   std::atomic<bool> closing_{false};
 };

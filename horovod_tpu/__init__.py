@@ -78,6 +78,11 @@ from .ops import (  # noqa: F401
     Compression,
 )
 from .ops.collectives import ProcessSet  # noqa: F401
+from .ops.sparse import (  # noqa: F401
+    IndexedSlices,
+    allreduce_indexed_slices,
+    embedding_grad_as_slices,
+)
 from .eager import (  # noqa: F401
     allreduce_ as eager_allreduce,
     allgather_ as eager_allgather,

@@ -1,2 +1,2 @@
-from .store import Store, LocalStore  # noqa: F401
+from .store import Store, LocalStore, FsspecStore  # noqa: F401
 from .estimator import Estimator, EstimatorModel  # noqa: F401

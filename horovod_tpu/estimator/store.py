@@ -36,10 +36,22 @@ class Store:
     def write(self, path: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def save_obj(self, path: str, obj: Any) -> None:
+        self.write(path, pickle.dumps(obj))
+
+    def load_obj(self, path: str) -> Any:
+        return pickle.loads(self.read(path))
+
     @staticmethod
     def create(prefix_path: str) -> "Store":
-        """Factory (reference Store.create dispatches on URL scheme)."""
-        return LocalStore(prefix_path)
+        """Factory dispatching on URL scheme (reference Store.create,
+        spark/common/store.py: local paths -> LocalStore, remote URLs ->
+        HDFSStore; here remote URLs -> FsspecStore, with gs:// as the
+        TPU-era primary remote instead of hdfs://)."""
+        scheme = prefix_path.split("://", 1)[0] if "://" in prefix_path else ""
+        if scheme in ("", "file"):
+            return LocalStore(prefix_path.removeprefix("file://"))
+        return FsspecStore(prefix_path)
 
 
 class LocalStore(Store):
@@ -73,8 +85,53 @@ class LocalStore(Store):
         with open(path, "wb") as f:
             f.write(data)
 
-    def save_obj(self, path: str, obj: Any) -> None:
-        self.write(path, pickle.dumps(obj))
 
-    def load_obj(self, path: str) -> Any:
-        return pickle.loads(self.read(path))
+class FsspecStore(Store):
+    """Remote store over any fsspec filesystem: ``gs://``, ``s3://``,
+    ``hdfs://``, ``memory://``, ... (reference HDFSStore,
+    spark/common/store.py — pyarrow hdfs client there, fsspec here; GCS is
+    the natural remote for TPU VMs).
+
+    Paths handed out and accepted are full URLs; directories are created
+    lazily on write (object stores have no real directories)."""
+
+    def __init__(self, prefix_url: str):
+        import fsspec
+
+        self.prefix = prefix_url.rstrip("/")
+        self.fs, _ = fsspec.core.url_to_fs(self.prefix)
+
+    def _url(self, path: str) -> str:
+        """fs-native path for a full URL (handles schemes with a netloc,
+        e.g. hdfs://namenode:8020/data, which a bare scheme-strip would
+        mangle)."""
+        return self.fs._strip_protocol(path)
+
+    def _sub(self, run_id: str, name: str) -> str:
+        return f"{self.prefix}/{run_id}/{name}"
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return self._sub(run_id, "train_data")
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._sub(run_id, "checkpoints")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._sub(run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(self._url(path))
+
+    def read(self, path: str) -> bytes:
+        with self.fs.open(self._url(path), "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        p = self._url(path)
+        parent = p.rsplit("/", 1)[0]
+        try:
+            self.fs.makedirs(parent, exist_ok=True)
+        except Exception:  # object stores may not support mkdir
+            pass
+        with self.fs.open(p, "wb") as f:
+            f.write(data)

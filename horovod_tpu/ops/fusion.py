@@ -125,11 +125,39 @@ def allreduce_pytree(
     compression=Compression.none,
     process_set=None,
     threshold_bytes: Optional[int] = None,
+    sparse_as_dense: bool = False,
 ):
-    """Fused allreduce over every array leaf of a pytree (gradients)."""
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    reduced = fused_allreduce(
-        leaves, op=op, compression=compression, process_set=process_set,
-        threshold_bytes=threshold_bytes,
+    """Fused allreduce over every array leaf of a pytree (gradients).
+
+    ``IndexedSlices`` leaves take the sparse allgather path (reference
+    tensorflow/__init__.py:75-90) unless ``sparse_as_dense`` (reference
+    DistributedOptimizer option) densifies them first."""
+    from .sparse import (
+        allreduce_indexed_slices, is_indexed_slices, to_dense,
     )
-    return jax.tree_util.tree_unflatten(treedef, reduced)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=is_indexed_slices
+    )
+    dense_idx = []
+    dense_leaves = []
+    out: list = [None] * len(leaves)
+    for i, leaf in enumerate(leaves):
+        if is_indexed_slices(leaf):
+            if sparse_as_dense:
+                dense_idx.append(i)
+                dense_leaves.append(to_dense(leaf))
+            else:
+                out[i] = allreduce_indexed_slices(
+                    leaf, op=op, process_set=process_set
+                )
+        else:
+            dense_idx.append(i)
+            dense_leaves.append(leaf)
+    reduced = fused_allreduce(
+        dense_leaves, op=op, compression=compression,
+        process_set=process_set, threshold_bytes=threshold_bytes,
+    )
+    for i, r in zip(dense_idx, reduced):
+        out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,137 @@
+"""Sparse (IndexedSlices) gradients: allgather-based reduction.
+
+The reference converts sparse gradients to an allgather of (values,
+indices) instead of a dense allreduce (horovod/tensorflow/__init__.py:75-90:
+``if isinstance(tensor, tf.IndexedSlices): return tf.IndexedSlices(
+allgather(values) / horovod_size, allgather(indices))``) — embedding-heavy
+models only ship the touched rows.  TPU-native version:
+
+* :class:`IndexedSlices` — a pytree (values ``[k, ...]``, indices ``[k]``,
+  static ``dense_shape``), the JAX carrier for embedding-style gradients.
+* :func:`allreduce_indexed_slices` — ``lax.all_gather(tiled)`` of values and
+  indices over the mesh axis; Average divides values by the group size.
+  Duplicate indices are legal — consumers scatter-**add**.
+* :func:`to_dense` — scatter-add into the dense shape (XLA lowers to an
+  efficient sorted scatter on TPU).
+* :func:`embedding_grad_as_slices` — sparse gradient of a table used only
+  through ``table[ids]``, taken w.r.t. the gathered rows (TF produces
+  IndexedSlices from ``tf.gather`` automatically; JAX cotangents must
+  structurally match their primal, so the sparsity is recovered at the
+  lookup boundary instead).
+
+``fusion.allreduce_pytree`` routes IndexedSlices leaves here, and
+``DistributedOptimizer(sparse_as_dense=True)`` forces the dense path
+(reference DistributedOptimizer's ``sparse_as_dense`` option,
+tensorflow/__init__.py:267-319).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import core
+from ..core import Average, Sum
+
+
+@jax.tree_util.register_pytree_node_class
+class IndexedSlices:
+    """Sparse rows of a dense tensor: ``dense[indices[i]] += values[i]``.
+
+    ``values``: ``[k, *dense_shape[1:]]``; ``indices``: ``[k]`` int32;
+    ``dense_shape``: static tuple (aux data — jit-stable).
+    """
+
+    def __init__(self, values, indices, dense_shape: Sequence[int]):
+        self.values = values
+        self.indices = indices
+        self.dense_shape = tuple(int(d) for d in dense_shape)
+
+    def tree_flatten(self):
+        return (self.values, self.indices), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, dense_shape, children):
+        values, indices = children
+        return cls(values, indices, dense_shape)
+
+    def __repr__(self):
+        return (f"IndexedSlices(values={self.values!r}, "
+                f"indices={self.indices!r}, dense_shape={self.dense_shape})")
+
+
+def is_indexed_slices(x: Any) -> bool:
+    return isinstance(x, IndexedSlices)
+
+
+def to_dense(s: IndexedSlices):
+    """Scatter-add the slices into their dense shape."""
+    dense = jnp.zeros(s.dense_shape, jnp.result_type(s.values))
+    return dense.at[s.indices].add(s.values)
+
+
+def allreduce_indexed_slices(
+    s: IndexedSlices,
+    *,
+    op: str = Average,
+    process_set=None,
+) -> IndexedSlices:
+    """Cross-rank reduction of sparse rows by allgathering (values, indices)
+    (reference tensorflow/__init__.py:75-90).  Must run inside an SPMD
+    region.  The result holds every rank's rows concatenated — duplicates
+    are resolved by the consumer's scatter-add, matching TF IndexedSlices
+    semantics."""
+    from .collectives import allgather
+
+    if core._spmd_axes() is None:
+        raise RuntimeError(
+            "allreduce_indexed_slices must run inside an SPMD region"
+        )
+    size = process_set.size() if process_set is not None else core.size()
+
+    # collectives.allgather owns the group handling (incl. the uneven-
+    # process-set psum-embed fallback XLA's all_gather can't lower)
+    values = allgather(s.values, process_set=process_set)
+    indices = allgather(s.indices, process_set=process_set)
+    if op == Average:
+        values = values / size
+    elif op != Sum:
+        raise ValueError(f"unsupported op for sparse allreduce: {op}")
+    return IndexedSlices(values, indices, s.dense_shape)
+
+
+# ---------------------------------------------------------------------------
+# sparse-gradient producer
+# ---------------------------------------------------------------------------
+def embedding_grad_as_slices(loss_of_rows, table, ids, *args, **kwargs):
+    """Sparse gradient of an embedding table used only through ``table[ids]``.
+
+    TF produces IndexedSlices from ``tf.gather`` automatically; JAX
+    cotangents must structurally match their primal, so the sparse gradient
+    is taken w.r.t. the *gathered rows* instead — exact whenever the table
+    enters the loss only via this lookup (the embedding-layer contract)::
+
+        loss, slices = embedding_grad_as_slices(
+            lambda rows: loss_fn(rows, batch), table, ids)
+        grads = {"embedding": slices, ...}        # flows through
+        hvd.DistributedOptimizer(...)             # the sparse allgather path
+
+    Returns ``(loss, IndexedSlices)`` with one row per lookup (duplicate
+    ids stay duplicated; scatter-add resolves them, as in TF).
+    """
+    rows = jnp.take(table, ids, axis=0)
+    loss, g_rows = jax.value_and_grad(loss_of_rows)(rows, *args, **kwargs)
+    flat_ids = ids.reshape(-1)
+    flat_g = g_rows.reshape((flat_ids.shape[0],) + tuple(table.shape[1:]))
+    return loss, IndexedSlices(flat_g, flat_ids, table.shape)
+
+
+def densify_tree(tree):
+    """Convert every IndexedSlices leaf to its dense tensor (what optax
+    update rules consume)."""
+    return jax.tree_util.tree_map(
+        lambda x: to_dense(x) if is_indexed_slices(x) else x,
+        tree, is_leaf=is_indexed_slices,
+    )

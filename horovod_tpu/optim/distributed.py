@@ -49,6 +49,7 @@ def DistributedOptimizer(
     backward_passes_per_step: int = 1,
     process_set: Optional[collectives.ProcessSet] = None,
     threshold_bytes: Optional[int] = None,
+    sparse_as_dense: bool = False,
 ):
     """Wrap an ``optax.GradientTransformation`` so updates see
     globally-reduced gradients.
@@ -66,17 +67,27 @@ def DistributedOptimizer(
     if n < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
+    from ..ops.sparse import densify_tree
+
     def reduce_grads(grads):
         if op == Adasum:
+            # Adasum has no sparse form (reference: sparse tensors are not
+            # routed to Adasum either) — densify first.
+            grads = densify_tree(grads)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
             reduced = [
                 collectives.allreduce(g, op=Adasum) for g in leaves
             ]
             return jax.tree_util.tree_unflatten(treedef, reduced)
-        return allreduce_pytree(
+        reduced = allreduce_pytree(
             grads, op=op, compression=compression,
             process_set=process_set, threshold_bytes=threshold_bytes,
+            sparse_as_dense=sparse_as_dense,
         )
+        # optax update rules consume dense arrays; the communication was
+        # sparse, the application is a scatter-add (TF applies IndexedSlices
+        # natively — optax has no sparse update, so densify post-reduce).
+        return densify_tree(reduced)
 
     if n == 1:
         def init_fn(params):
@@ -96,6 +107,9 @@ def DistributedOptimizer(
         )
 
     def update_fn(grads, state, params=None, **extra):
+        # accumulation buffers are dense (zeros_like(params)); sparse grads
+        # scatter-add into them
+        grads = densify_tree(grads)
         accum = jax.tree_util.tree_map(lambda a, g: a + g, state.accum, grads)
         count = state.counter + 1
         sync = count >= n

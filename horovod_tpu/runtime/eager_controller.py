@@ -114,7 +114,12 @@ def server_stats() -> Optional[dict]:
             "stall_warnings": _server.stall_warnings,
         }
     if _client is not None:
-        return _client.stats()
+        try:
+            return _client.stats()
+        except (TimeoutError, ConnectionError, OSError):
+            # no-raise contract: a wedged or shut-down coordinator reads
+            # as "no stats available", same as not having one
+            return None
     return None
 
 

@@ -1,0 +1,122 @@
+"""One-off perf experiment harness for the ResNet-50 benchmark step.
+
+Times variants of the train step on the real chip to find the bottleneck
+(VERDICT round 1 item 5). Not part of the test suite.
+
+Usage: python scripts/perf_experiments.py [variant ...]
+Variants: baseline nofuse b512 fwdonly nograd
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import MODELS
+from horovod_tpu.training import init_train_state, make_train_step, shard_batch
+
+
+def timeit(fn, *args, n=10, warmup=3, sync=None):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out if sync is None else sync(out))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _sync(out if sync is None else sync(out))
+    return (time.perf_counter() - t0) / n
+
+
+def timeit_step(step, state, x, y, n=10, warmup=3):
+    # threads the (donated) state through like the real training loop
+    for _ in range(warmup):
+        state, loss = step(state, x, y)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, loss = step(state, x, y)
+    _sync(loss)
+    return (time.perf_counter() - t0) / n
+
+
+def _sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[-1]
+    np.asarray(jax.device_get(leaf.sum() if leaf.ndim else leaf))
+
+
+def build(batch=256, model_name="ResNet50", fuse=True):
+    model = MODELS[model_name](num_classes=1000, dtype=jnp.bfloat16)
+    opt = optax.sgd(0.01, momentum=0.9)
+    rng = np.random.default_rng(42)
+    data = rng.uniform(size=(batch, 224, 224, 3)).astype(np.float32)
+    target = rng.integers(0, 1000, size=(batch,)).astype(np.int32)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    step = make_train_step(
+        apply_fn=model.apply, loss_fn=loss_fn, optimizer=opt,
+        has_batch_stats=True,
+        threshold_bytes=None if fuse else 1,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 224, 224, 3)),
+                             has_batch_stats=True)
+    return step, state, shard_batch(data), shard_batch(target), batch
+
+
+def report(tag, dt, batch):
+    print(f"{tag}: {dt*1000:.1f} ms/step  {batch/dt:.1f} img/s", flush=True)
+
+
+def main(variants):
+    hvd.init()
+    if "baseline" in variants:
+        step, state, x, y, b = build()
+        report("baseline b256", timeit_step(step, state, x, y), b)
+    if "nofuse" in variants:
+        step, state, x, y, b = build(fuse=False)
+        report("nofuse  b256", timeit_step(step, state, x, y), b)
+    if "b512" in variants:
+        step, state, x, y, b = build(batch=512)
+        report("baseline b512", timeit_step(step, state, x, y), b)
+    if "fwdonly" in variants:
+        model = MODELS["ResNet50"](num_classes=1000, dtype=jnp.bfloat16)
+        opt = optax.sgd(0.01, momentum=0.9)
+        state = init_train_state(model, opt, jnp.zeros((2, 224, 224, 3)),
+                                 has_batch_stats=True)
+        rng = np.random.default_rng(42)
+        x = shard_batch(rng.uniform(size=(256, 224, 224, 3)).astype(np.float32))
+
+        @jax.jit
+        def fwd(params, model_state, x):
+            variables = {"params": params, **model_state}
+            logits, _ = model.apply(variables, x, train=True,
+                                    mutable=["batch_stats"])
+            return logits.sum()
+
+        report("fwd-only b256",
+               timeit(fwd, state.params, state.model_state, x), 256)
+    if "flops" in variants:
+        step, state, x, y, b = build()
+        # cost analysis of the jitted step for MFU accounting
+        import horovod_tpu.training as T
+        inner = step  # _invoke closure; grab the spmd-compiled fn via trace
+        lowered = jax.jit(lambda s, a, c: inner(s, a, c)).lower(state, x, y)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        print("flops/step:", cost.get("flops"), " flops/img:",
+              cost.get("flops", 0) / b, flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["baseline", "nofuse", "fwdonly", "b512", "flops"])

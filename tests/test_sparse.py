@@ -1,0 +1,176 @@
+"""Sparse (IndexedSlices) gradient path — modeled on the reference's
+IndexedSlices→allgather conversion (reference
+horovod/tensorflow/__init__.py:75-90) and its grad-flow tests
+(test_tensorflow.py sparse-gradient cases)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.sparse import (
+    IndexedSlices, densify_tree, embedding_grad_as_slices, to_dense,
+)
+
+SIZE = 8
+VOCAB = 16
+DIM = 4
+
+
+def _rank_slices(rng, r):
+    k = 3
+    ids = rng.integers(0, VOCAB, size=(k,)).astype(np.int32)
+    vals = rng.normal(size=(k, DIM)).astype(np.float32)
+    return vals, ids
+
+
+def _dense_oracle(per_rank, op):
+    dense = np.zeros((SIZE, VOCAB, DIM), np.float64)
+    for r, (vals, ids) in enumerate(per_rank):
+        for v, i in zip(vals, ids):
+            dense[r, i] += v
+    out = dense.sum(axis=0)
+    if op == hvd.Average:
+        out /= SIZE
+    return out
+
+
+@pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+def test_sparse_allreduce_matches_dense(hvd_init, rng, op):
+    per_rank = [_rank_slices(rng, r) for r in range(SIZE)]
+    vals = np.stack([v for v, _ in per_rank])
+    ids = np.stack([i for _, i in per_rank])
+
+    @hvd.spmd
+    def step(vals, ids):
+        s = IndexedSlices(vals[0], ids[0], (VOCAB, DIM))
+        red = hvd.allreduce_indexed_slices(s, op=op)
+        return to_dense(red)[None]
+
+    out = hvd.get_per_rank(step(vals, ids))
+    expected = _dense_oracle(per_rank, op)
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o, np.float64), expected,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_allreduce_duplicate_ids(hvd_init, rng):
+    """Duplicate ids within one rank must scatter-add, not overwrite."""
+    vals = np.tile(
+        np.asarray([[1.0, 2.0, 3.0, 4.0]], np.float32), (SIZE, 2, 1)
+    )
+    ids = np.zeros((SIZE, 2), np.int32)  # every row hits id 0
+
+    @hvd.spmd
+    def step(vals, ids):
+        s = IndexedSlices(vals[0], ids[0], (VOCAB, DIM))
+        red = hvd.allreduce_indexed_slices(s, op=hvd.Sum)
+        return to_dense(red)[None]
+
+    out = np.asarray(hvd.get_per_rank(step(vals, ids))[0])
+    np.testing.assert_allclose(
+        out[0], np.asarray([1, 2, 3, 4.0]) * 2 * SIZE, rtol=1e-6
+    )
+    np.testing.assert_allclose(out[1:], 0.0)
+
+
+def test_sparse_allreduce_uneven_process_set(hvd_init, rng):
+    """Sparse allgather over an uneven ProcessSet rides the dense
+    allgather's psum-embed fallback (XLA all_gather needs equal groups)."""
+    per_rank = [_rank_slices(rng, r) for r in range(SIZE)]
+    vals = np.stack([v for v, _ in per_rank])
+    ids = np.stack([i for _, i in per_rank])
+    pset = hvd.ProcessSet([0, 1, 2])
+
+    @hvd.spmd
+    def step(vals, ids):
+        s = IndexedSlices(vals[0], ids[0], (VOCAB, DIM))
+        red = hvd.allreduce_indexed_slices(s, op=hvd.Sum, process_set=pset)
+        return to_dense(red)[None]
+
+    out = hvd.get_per_rank(step(vals, ids))
+    dense = np.zeros((VOCAB, DIM), np.float64)
+    for r in [0, 1, 2]:
+        v, i = per_rank[r]
+        for vv, ii in zip(v, i):
+            dense[ii] += vv
+    for r in [0, 1, 2]:
+        np.testing.assert_allclose(np.asarray(out[r], np.float64), dense,
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("sparse_as_dense", [False, True])
+def test_distributed_optimizer_sparse_grads(hvd_init, rng, sparse_as_dense):
+    """A mixed dense+sparse gradient pytree through DistributedOptimizer
+    equals the dense-everything result (reference DistributedOptimizer
+    sparse_as_dense flag, tensorflow/__init__.py:267-319)."""
+    table0 = rng.normal(size=(VOCAB, DIM)).astype(np.float32)
+    w0 = rng.normal(size=(DIM,)).astype(np.float32)
+    per_rank = [_rank_slices(rng, r) for r in range(SIZE)]
+    vals = np.stack([v for v, _ in per_rank])
+    ids = np.stack([i for _, i in per_rank])
+    dense_w_grads = rng.normal(size=(SIZE, DIM)).astype(np.float32)
+
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                   sparse_as_dense=sparse_as_dense)
+
+    @hvd.spmd
+    def step(vals, ids, gw):
+        params = {"table": jnp.asarray(table0), "w": jnp.asarray(w0)}
+        grads = {
+            "table": IndexedSlices(vals[0], ids[0], (VOCAB, DIM)),
+            "w": gw[0],
+        }
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        return params["table"][None], params["w"][None]
+
+    out_t, out_w = step(vals, ids, dense_w_grads)
+    expected_table = table0 - _dense_oracle(per_rank, hvd.Average)
+    expected_w = w0 - dense_w_grads.mean(axis=0)
+    for o in hvd.get_per_rank(out_t):
+        np.testing.assert_allclose(np.asarray(o, np.float64),
+                                   expected_table, rtol=1e-4, atol=1e-5)
+    for o in hvd.get_per_rank(out_w):
+        np.testing.assert_allclose(np.asarray(o, np.float64),
+                                   expected_w, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_grad_as_slices_exact(hvd_init, rng):
+    """The sparse gradient equals jax.grad's dense gradient scattered."""
+    table = rng.normal(size=(VOCAB, DIM)).astype(np.float32)
+    ids = np.asarray([1, 3, 3, 7], np.int32)
+    target = rng.normal(size=(4, DIM)).astype(np.float32)
+
+    def loss_of_rows(rows):
+        return jnp.sum((rows - target) ** 2)
+
+    def loss_of_table(t):
+        return loss_of_rows(jnp.take(t, ids, axis=0))
+
+    loss, slices = embedding_grad_as_slices(
+        loss_of_rows, jnp.asarray(table), jnp.asarray(ids)
+    )
+    dense = to_dense(slices)
+    expected = jax.grad(loss_of_table)(jnp.asarray(table))
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        float(loss), float(loss_of_table(jnp.asarray(table))), rtol=1e-6
+    )
+
+
+def test_densify_tree_mixed(rng):
+    tree = {
+        "a": np.ones((2, 2), np.float32),
+        "b": IndexedSlices(np.ones((1, DIM), np.float32),
+                           np.asarray([2], np.int32), (VOCAB, DIM)),
+    }
+    out = densify_tree(tree)
+    assert out["a"].shape == (2, 2)
+    assert out["b"].shape == (VOCAB, DIM)
+    np.testing.assert_allclose(np.asarray(out["b"][2]), 1.0)
