@@ -47,6 +47,9 @@ def parse_args(argv=None):
                         help="number of warm-up batches not benchmarked")
     parser.add_argument("--num-batches-per-iter", type=int, default=10,
                         help="number of batches per benchmark iteration")
+    parser.add_argument("--num-in-graph-steps", type=int, default=1,
+                        help="optimizer steps compiled into one program "
+                             "(lax.scan); amortizes host dispatch")
     parser.add_argument("--num-iters", type=int, default=10,
                         help="number of benchmark iterations")
     parser.add_argument("--adasum", action="store_true", default=False,
@@ -106,6 +109,7 @@ def run(args) -> dict:
         hierarchical=args.hierarchical,
         autotune=args.autotune or None,
         autotune_log_file=args.autotune_log_file,
+        in_graph_steps=args.num_in_graph_steps,
     )
 
     state = init_train_state(
@@ -129,6 +133,8 @@ def run(args) -> dict:
     float(np.asarray(jax.device_get(loss)))
 
     log("Running benchmark...")
+    imgs_per_call = (args.batch_size * hvd.size()
+                     * max(args.num_in_graph_steps, 1))
     img_secs = []
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
@@ -136,7 +142,7 @@ def run(args) -> dict:
             state, loss = step(state, x, y)
         float(np.asarray(jax.device_get(loss)))
         dt = time.perf_counter() - t0
-        img_sec = args.batch_size * args.num_batches_per_iter * hvd.size() / dt
+        img_sec = imgs_per_call * args.num_batches_per_iter / dt
         log(f"Iter: Img/sec total: {img_sec:.1f}")
         img_secs.append(img_sec)
 

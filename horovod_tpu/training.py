@@ -43,6 +43,7 @@ def make_train_step(
     hierarchical: bool = False,
     autotune: Optional[bool] = None,
     autotune_log_file: Optional[str] = None,
+    in_graph_steps: int = 1,
 ):
     """Returns ``step(state, batch, labels) -> (state, loss)`` compiled SPMD
     over the global mesh.
@@ -59,6 +60,12 @@ def make_train_step(
       reference's "new parameters take effect next cycle"
       (parameter_manager.cc Update/Tune).  The returned function exposes
       the manager as ``step.parameter_manager``.
+    * ``in_graph_steps > 1`` compiles a ``lax.scan`` of that many
+      optimizer steps over the SAME batch into one program, so host
+      dispatch is amortized away (the synthetic-benchmark mode: the
+      reference's timed inner loop also re-feeds one synthetic batch,
+      examples/tensorflow2_synthetic_benchmark.py:72-97; measured +6%
+      on the v5e, docs/PERF.md).  Real data pipelines keep the default 1.
     """
     from .ops import collectives
     from .parallel.hierarchical import hierarchical_allreduce
@@ -101,12 +108,23 @@ def make_train_step(
                 loss,
             )
 
+        if in_graph_steps > 1:
+            def per_rank_entry(state: TrainState, x, y):
+                def body(s, _):
+                    return per_rank_step(s, x, y)
+                state, losses = jax.lax.scan(
+                    body, state, None, length=in_graph_steps
+                )
+                return state, losses[-1]
+        else:
+            per_rank_entry = per_rank_step
+
         # params/opt_state replicated; batch sharded across ranks on dim 0.
         state_spec = TrainState(
             params=P(), opt_state=P(), model_state=P(), step=P()
         )
         return spmd(
-            per_rank_step,
+            per_rank_entry,
             in_specs=(state_spec, P(core.AXIS), P(core.AXIS)),
             out_specs=(state_spec, P()),
             donate_argnums=(0,) if donate else (),
@@ -170,11 +188,12 @@ def make_train_step(
         if "grad_bytes" not in box:
             import math
 
-            # per-step allreduce volume = the gradient pytree's bytes
+            # per-call allreduce volume = the gradient pytree's bytes,
+            # once per scanned in-graph step
             box["grad_bytes"] = float(sum(
                 math.prod(l.shape) * l.dtype.itemsize
                 for l in jax.tree_util.tree_leaves(state.params)
-            ))
+            )) * max(in_graph_steps, 1)
         t0 = _time.perf_counter()
         state, loss = _invoke(state, x, y)
         # honest timing while tuning: force the step chain to complete

@@ -126,3 +126,37 @@ def test_bert_tiny_forward(hvd_init, rng):
     out = model.apply(variables, ids)
     assert out.shape == (2, 32, 128)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_in_graph_steps_matches_sequential(hvd_init, rng):
+    """K scanned in-graph steps on one batch == K sequential step() calls
+    (the synthetic-benchmark mode, docs/PERF.md)."""
+    x, y = _make_problem(rng)
+    model = MLP(features=(32, 10))
+    opt = optax.sgd(0.1)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    mk = dict(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=opt, donate=False,
+    )
+    step1 = make_train_step(**mk)
+    step4 = make_train_step(**mk, in_graph_steps=4)
+    state_a = init_train_state(model, opt, jnp.zeros((2, 16)))
+    state_b = init_train_state(model, opt, jnp.zeros((2, 16)))
+    xs, ys = shard_batch(x), shard_batch(y)
+
+    for _ in range(4):
+        state_a, loss_a = step1(state_a, xs, ys)
+    state_b, loss_b = step4(state_b, xs, ys)
+
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for pa, pb in zip(jax.tree_util.tree_leaves(state_a.params),
+                      jax.tree_util.tree_leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-6)
+    assert int(state_b.step) == 4
