@@ -1,0 +1,268 @@
+"""horovod_tpu.tensorflow: the TensorFlow-flavored API surface.
+
+Mirror of horovod/tensorflow (reference horovod/tensorflow/__init__.py +
+mpi_ops.py): ``allreduce`` (dense + IndexedSlices→allgather),
+``allgather``, ``broadcast``, ``broadcast_variables``,
+``DistributedOptimizer``, ``DistributedGradientTape``, ``Compression``.
+
+Architecture: the reference routes TF tensors through custom AsyncOpKernels
+(tensorflow/mpi_ops.cc) into the background-thread/NCCL stack; here TF
+eager tensors bridge to the XLA/native data plane via numpy interchange and
+the eager SPMD programs (horovod_tpu/eager.py) — same transport as the
+torch binding.  TF-on-TPU compiled compute is the JAX path in this
+framework (core.py/spmd.py); this module serves TF-ecosystem code (Keras
+models, tf.data pipelines) running its math on the host while gradients
+ride the framework's collectives.
+
+Import is lazy-gated: ``import horovod_tpu.tensorflow`` raises ImportError
+only if tensorflow itself is unavailable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+import tensorflow as tf  # gate: module import fails cleanly without TF
+
+from .. import core, eager
+from ..core import Average, Sum, Adasum, Min, Max  # noqa: F401
+from ..runtime import eager_controller
+
+init = core.init
+shutdown = core.shutdown
+rank = core.rank
+local_rank = core.local_rank
+size = core.size
+local_size = core.local_size
+cross_rank = core.cross_rank
+cross_size = core.cross_size
+is_initialized = core.is_initialized
+mpi_enabled = core.mpi_enabled
+nccl_built = core.nccl_built
+
+
+class Compression:
+    """Gradient compression for the wire (reference
+    tensorflow/compression.py: NoneCompressor / FP16Compressor).  fp16
+    stays fp16 here — the host-side eager plane has no MXU preference;
+    the compiled JAX path's Compression maps fp16→bf16 instead."""
+
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            if t.dtype in (tf.float32, tf.float64):
+                return tf.cast(t, tf.float16), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return tf.cast(t, ctx) if ctx is not None else t
+
+
+def _np(tensor) -> np.ndarray:
+    return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
+
+
+def _allreduce_np(arr: np.ndarray, op, nm: str) -> np.ndarray:
+    out = eager.process_allreduce(np.asarray(arr), op=op, name=nm)
+    # the wire path may at-least-1d scalars; an allreduce preserves shape
+    return np.ascontiguousarray(np.asarray(out)).reshape(np.shape(arr))
+
+
+def _allgather_np(arr: np.ndarray, nm: str) -> np.ndarray:
+    if core.process_size() == 1:
+        return np.asarray(arr)
+    return np.concatenate(
+        [np.asarray(g) for g in eager.allgather_object(arr, name=nm)],
+        axis=0,
+    )
+
+
+def _broadcast_np(arr: np.ndarray, root_rank: int, nm: str) -> np.ndarray:
+    if core.process_size() == 1:
+        return np.asarray(arr)
+    return np.asarray(
+        eager.broadcast_object(arr, root_rank=root_rank, name=nm)
+    )
+
+
+def _run(np_fn, tensor, out_shape):
+    """Execute the numpy-side collective: directly in eager mode, through
+    ``tf.py_function`` under a ``tf.function`` trace (the reference's
+    AsyncOpKernels are graph ops natively; py_function is the eager
+    plane's graph adapter — Keras compiles train_step)."""
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(np_fn(_np(tensor)))
+    out = tf.py_function(
+        func=lambda t: tf.convert_to_tensor(np_fn(t.numpy())),
+        inp=[tensor], Tout=tensor.dtype,
+    )
+    out.set_shape(out_shape)
+    return out
+
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=Compression.none, op=None, name: Optional[str] = None):
+    """Dense tensors: cross-process reduction over the data plane.
+    ``tf.IndexedSlices``: allgather of (values, indices) instead
+    (reference tensorflow/__init__.py:75-90).  Works eagerly and inside
+    ``tf.function`` (Keras train steps)."""
+    op = _normalize_op(average, op)
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values,
+                           name=None if name is None else f"{name}.values")
+        indices = allgather(tensor.indices,
+                            name=None if name is None else f"{name}.indices")
+        if op == Average:
+            # the allgather ran over processes (the eager transport's
+            # participants), so that is the averaging denominator
+            values = values / core.process_size()
+        elif op != Sum:
+            raise ValueError(f"unsupported op for IndexedSlices: {op}")
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    if op not in (Average, Sum):
+        # the process-plane transport only implements Sum (+ divide);
+        # loud error beats a silent sum (Min/Max/Adasum live on the
+        # compiled JAX path, horovod_tpu.allreduce)
+        raise NotImplementedError(
+            f"op {op!r} is not supported by the TF binding's transport; "
+            "use op=Sum or op=Average"
+        )
+    comp, ctx = compression.compress(tensor)
+    nm = name or eager_controller.next_name("allreduce.tf")
+    out = _run(lambda a: _allreduce_np(a, op, nm), comp, comp.shape)
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate every process's tensor along dim 0 (reference
+    HorovodAllgatherOp; varying first dimensions allowed)."""
+    nm = name or eager_controller.next_name("allgather.tf")
+    out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    return _run(lambda a: _allgather_np(a, nm), tensor, out_shape)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    nm = name or eager_controller.next_name("broadcast.tf")
+    return _run(lambda a: _broadcast_np(a, root_rank, nm), tensor,
+                tensor.shape)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    return eager.broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign root's values into every process's variables (reference
+    tensorflow/__init__.py broadcast_variables / the TF1
+    BroadcastGlobalVariablesHook body)."""
+    for var in variables:
+        var.assign(broadcast(var, root_rank))
+
+
+def _normalize_op(average, op):
+    if average is not None and op is not None:
+        raise ValueError("cannot specify both average and op")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+# ---------------------------------------------------------------------------
+# gradient aggregation
+# ---------------------------------------------------------------------------
+def _allreduce_grads(grads, *, op, compression, sparse_as_dense):
+    out = []
+    for g in grads:
+        if g is None:
+            out.append(None)
+            continue
+        if isinstance(g, tf.IndexedSlices) and sparse_as_dense:
+            g = tf.convert_to_tensor(g)
+        out.append(allreduce(g, op=op, compression=compression))
+    return out
+
+
+class DistributedGradientTape:
+    """Wrap tf.GradientTape so .gradient() returns globally-reduced
+    gradients (reference tensorflow/__init__.py:483-539)."""
+
+    def __init__(self, gradtape, device_dense="", device_sparse="",
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=Average):
+        self._tape = gradtape
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._op = op
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return _allreduce_grads(
+            grads, op=self._op, compression=self._compression,
+            sparse_as_dense=self._sparse_as_dense,
+        )
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=Average,
+                         backward_passes_per_step: int = 1):
+    """A dynamically-created subclass of the given Keras optimizer whose
+    ``apply_gradients`` sees globally-reduced gradients — the reference's
+    own construction (horovod/keras/__init__.py create_distributed_optimizer
+    builds ``type(cls.__name__, (cls,), dict(...))``), which keeps Keras's
+    isinstance checks satisfied.  Returns a fresh optimizer built from the
+    wrapped one's config (state resets, as in the reference)."""
+    if backward_passes_per_step != 1:
+        raise NotImplementedError(
+            "backward_passes_per_step > 1: accumulate in the training "
+            "loop (the TF2 idiom) or use the JAX hvd.DistributedOptimizer"
+        )
+    if getattr(optimizer.__class__, "_hvd_distributed", False):
+        raise ValueError(
+            "optimizer is already distributed "
+            "(DistributedOptimizer applied twice)"
+        )
+    base = optimizer.__class__
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        gv = list(grads_and_vars)
+        grads = _allreduce_grads(
+            [g for g, _ in gv], op=op, compression=compression,
+            sparse_as_dense=sparse_as_dense,
+        )
+        return base.apply_gradients(
+            self, list(zip(grads, [v for _, v in gv])), *args, **kwargs
+        )
+
+    cls = type(base.__name__, (base,), {
+        "apply_gradients": apply_gradients,
+        "_hvd_distributed": True,
+    })
+    return cls.from_config(optimizer.get_config())

@@ -1,0 +1,11 @@
+"""horovod_tpu.tensorflow.keras: Keras-flavored entry points (reference
+horovod/tensorflow/keras/__init__.py — DistributedOptimizer +
+callbacks)."""
+
+from .. import (  # noqa: F401
+    init, shutdown, rank, local_rank, size, local_size, cross_rank,
+    cross_size, is_initialized, allreduce, allgather, broadcast,
+    broadcast_object, broadcast_variables, Compression,
+    DistributedOptimizer,
+)
+from . import callbacks  # noqa: F401

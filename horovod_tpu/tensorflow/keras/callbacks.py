@@ -1,0 +1,131 @@
+"""Keras callbacks over the TF binding (reference
+horovod/_keras/callbacks.py: BroadcastGlobalVariablesCallbackImpl,
+MetricAverageCallbackImpl, LearningRateWarmupCallbackImpl)."""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+from .. import allreduce, broadcast_variables, rank
+from ... import core
+from ...core import Average
+
+
+def _world() -> int:
+    """The TF binding's data parallelism is per-process (its transport
+    reduces over processes), so processes — not devices — are the world
+    size for metric guards and LR scaling."""
+    return max(core.process_size(), 1)
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast all model/optimizer variables from ``root_rank`` on the
+    first batch (reference _keras/callbacks.py:21-45) — the
+    checkpoint/resume idiom: rank 0 restores, everyone else receives."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        broadcast_variables(self.model.variables, self.root_rank)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and getattr(opt, "variables", None) is not None:
+            vars = opt.variables() if callable(opt.variables) else opt.variables
+            broadcast_variables(vars, self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch metrics over all processes before they reach other
+    callbacks/logs (reference _keras/callbacks.py:48-77)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or _world() == 1:
+            return
+        for k, v in list(logs.items()):
+            logs[k] = float(np.asarray(allreduce(
+                tf.constant(float(v)), op=Average,
+                name=f"metric.{epoch}.{k}",
+            )))
+
+
+class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
+    """Linear LR warmup from lr/size to lr over ``warmup_epochs``
+    (reference _keras/callbacks.py:79-135: large-batch training warms up
+    the size-scaled learning rate)."""
+
+    def __init__(self, warmup_epochs: int = 5, momentum_correction=True,
+                 steps_per_epoch=None, verbose: int = 0):
+        super().__init__()
+        self.warmup_epochs = warmup_epochs
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._initial_lr = None
+        self._epoch = 0
+        self._prev_lr = None
+
+    def _lr_var(self):
+        opt = self.model.optimizer
+        lr = getattr(opt, "learning_rate", None)
+        return lr if lr is not None else getattr(opt, "lr")
+
+    @staticmethod
+    def _get(var):
+        # Keras 3 LR is a keras Variable (.numpy()); Keras 2 went through
+        # backend.get_value
+        return float(np.asarray(
+            var.numpy() if hasattr(var, "numpy")
+            else tf.keras.backend.get_value(var)
+        ))
+
+    @staticmethod
+    def _set(var, value):
+        if hasattr(var, "assign"):
+            var.assign(value)
+        else:
+            tf.keras.backend.set_value(var, value)
+
+    def on_train_begin(self, logs=None):
+        self._initial_lr = self._get(self._lr_var())
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_batch_begin(self, batch, logs=None):
+        if self._epoch >= self.warmup_epochs:
+            return
+        steps = self.steps_per_epoch or (self.params or {}).get("steps") or 1
+        progress = (self._epoch * steps + batch) / (
+            self.warmup_epochs * steps
+        )
+        w = _world()
+        factor = 1.0 / w + (1.0 - 1.0 / w) * progress
+        new_lr = self._initial_lr * factor
+        self._apply_lr(new_lr)
+        if self.verbose and rank() == 0 and batch == 0:
+            print(f"LearningRateWarmup: epoch {self._epoch} "
+                  f"lr={self._initial_lr * factor:.6f}")
+
+    def _apply_lr(self, new_lr: float) -> None:
+        """Set the LR; with momentum correction, rescale SGD momentum
+        accumulators by new_lr/old_lr so the effective velocity tracks
+        the changing LR (reference _keras/callbacks.py
+        LearningRateScheduleCallbackImpl momentum restoration)."""
+        if self.momentum_correction and self._prev_lr not in (None, 0.0):
+            moms = getattr(self.model.optimizer, "momentums", None)
+            if moms:
+                ratio = new_lr / self._prev_lr
+                for m in moms:
+                    m.assign(m * ratio)
+        self._set(self._lr_var(), new_lr)
+        self._prev_lr = new_lr
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1:
+            self._apply_lr(self._initial_lr)

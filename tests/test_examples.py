@@ -85,3 +85,12 @@ def test_dense_benchmark(mesh8):
                         "--num-batches-per-iter", "2", "--num-iters", "1"]))
     assert np.isfinite(r["final_loss"])
     assert r["grad_gbytes_sec"] > 0
+
+
+def test_tf2_keras_mnist_example(mesh8):
+    pytest.importorskip("tensorflow")
+    from examples.tf2_keras_mnist import main
+
+    loss = main(["--epochs", "1", "--batch-size", "64"])
+    assert np.isfinite(loss)
+    assert loss < 2.3   # below chance-level cross-entropy

@@ -208,3 +208,52 @@ def test_tpurun_native_controller_end_to_end(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "RESULT 0 [0.5, 0.5]" in proc.stdout
     assert "RESULT 1 [0.5, 0.5]" in proc.stdout
+
+
+def _worker_tensorflow():
+    """TF binding across 2 real processes: dense allreduce, IndexedSlices
+    allgather path, broadcast_variables (reference runs test_tensorflow.py
+    under mpirun -np 2)."""
+    import jax
+    import horovod_tpu as hvd
+
+    hvd.init(devices=jax.devices("cpu"))
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd_tf
+
+    r = hvd.process_rank()
+    out = {"rank": r}
+
+    red = hvd_tf.allreduce(tf.constant([float(r + 1)] * 3), op=hvd_tf.Sum)
+    out["allreduce"] = [float(v) for v in red.numpy()]
+
+    s = tf.IndexedSlices(
+        values=tf.constant([[float(r + 1)] * 2]),
+        indices=tf.constant([r]),
+        dense_shape=tf.constant([4, 2]),
+    )
+    sr = hvd_tf.allreduce(s, op=hvd_tf.Sum)
+    out["sparse_indices"] = sorted(int(i) for i in sr.indices.numpy())
+    out["sparse_values"] = sorted(float(v[0]) for v in sr.values.numpy())
+
+    v = tf.Variable([float(r) * 10.0, float(r) * 10.0])
+    hvd_tf.broadcast_variables([v], root_rank=1)
+    out["bcast_var"] = [float(x) for x in v.numpy()]
+    return out
+
+
+def test_two_process_tensorflow_binding():
+    import os
+
+    pytest.importorskip("tensorflow")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    results = run(_worker_tensorflow, np=2, extra_env={
+        "PYTHONPATH": tests_dir + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    for r, res in enumerate(results):
+        assert res["rank"] == r
+        assert res["allreduce"] == [3.0, 3.0, 3.0]
+        assert res["sparse_indices"] == [0, 1]
+        assert res["sparse_values"] == [1.0, 2.0]
+        assert res["bcast_var"] == [10.0, 10.0]

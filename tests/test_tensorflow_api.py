@@ -1,0 +1,171 @@
+"""TF binding surface — modeled on reference test/test_tensorflow.py
+(per-op correctness, IndexedSlices sparse path, DistributedGradientTape
+grad flow, optimizer wrapping) and test_tensorflow2_keras.py (callbacks).
+
+Single-process semantics here (allreduce = identity-average, allgather =
+identity) — the cross-process path shares its transport with the torch
+binding, which tests/test_multiprocess.py exercises for real."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    import jax
+
+    hvd_tf.init(devices=jax.devices("cpu")[:8])
+    yield
+
+
+def test_rank_size():
+    assert hvd_tf.size() >= 1
+    assert 0 <= hvd_tf.rank() < hvd_tf.size()
+    assert not hvd_tf.mpi_enabled()
+
+
+@pytest.mark.parametrize("dtype", [tf.float32, tf.float64, tf.int32])
+def test_allreduce_dense(dtype):
+    x = tf.cast(tf.reshape(tf.range(12), (3, 4)), dtype)
+    out = hvd_tf.allreduce(x, op=hvd_tf.Sum)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    assert out.dtype == dtype
+
+
+def test_allreduce_average_default():
+    x = tf.constant([2.0, 4.0])
+    out = hvd_tf.allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), [2.0, 4.0])
+
+
+def test_allreduce_fp16_compression():
+    x = tf.constant([1.5, -2.25, 3.0])
+    out = hvd_tf.allreduce(x, compression=hvd_tf.Compression.fp16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(np.asarray(out), [1.5, -2.25, 3.0])
+
+
+def test_allreduce_indexed_slices():
+    """Sparse path: values/indices allgathered, Average divides values
+    (reference tensorflow/__init__.py:75-90)."""
+    s = tf.IndexedSlices(
+        values=tf.constant([[1.0, 2.0], [3.0, 4.0]]),
+        indices=tf.constant([0, 2]),
+        dense_shape=tf.constant([4, 2]),
+    )
+    out = hvd_tf.allreduce(s, op=hvd_tf.Average)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(np.asarray(out.values), [[1, 2], [3, 4.0]])
+    np.testing.assert_array_equal(np.asarray(out.indices), [0, 2])
+
+
+def test_allgather_broadcast_identity():
+    x = tf.constant([[1, 2], [3, 4]])
+    np.testing.assert_array_equal(np.asarray(hvd_tf.allgather(x)),
+                                  np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(hvd_tf.broadcast(x, 0)),
+                                  np.asarray(x))
+
+
+def test_broadcast_variables():
+    v = tf.Variable([1.0, 2.0])
+    hvd_tf.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(np.asarray(v), [1.0, 2.0])
+
+
+def test_distributed_gradient_tape_dense():
+    x = tf.Variable(3.0)
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        y = x * x
+    (g,) = tape.gradient(y, [x])
+    np.testing.assert_allclose(float(g), 6.0)
+
+
+def test_distributed_gradient_tape_sparse():
+    """Embedding grads come back as IndexedSlices and stay sparse
+    (reference test_tensorflow.py sparse grad-flow tests)."""
+    table = tf.Variable(tf.ones((5, 3)))
+    ids = tf.constant([1, 3])
+    with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+        rows = tf.gather(table, ids)
+        loss = tf.reduce_sum(rows)
+    (g,) = tape.gradient(loss, [table])
+    assert isinstance(g, tf.IndexedSlices)
+    np.testing.assert_allclose(np.asarray(g.values), np.ones((2, 3)))
+
+    with hvd_tf.DistributedGradientTape(
+        tf.GradientTape(), sparse_as_dense=True
+    ) as tape2:
+        loss = tf.reduce_sum(tf.gather(table, ids))
+    (gd,) = tape2.gradient(loss, [table])
+    assert not isinstance(gd, tf.IndexedSlices)
+    expected = np.zeros((5, 3))
+    expected[[1, 3]] = 1.0
+    np.testing.assert_allclose(np.asarray(gd), expected)
+
+
+def test_distributed_optimizer_applies_reduced_grads():
+    v = tf.Variable([1.0, 1.0])
+    opt = hvd_tf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5)
+    )
+    opt.apply_gradients([(tf.constant([2.0, 4.0]), v)])
+    np.testing.assert_allclose(np.asarray(v), [0.0, -1.0])
+
+
+def test_keras_model_fit_with_callbacks(tmp_path):
+    """End-to-end Keras fit with the wrapped optimizer and callbacks
+    (reference test_tensorflow2_keras.py::test_train_model)."""
+    from horovod_tpu.tensorflow import keras as hvd_keras
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(32,)).astype(np.int32)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+        tf.keras.layers.Dense(2),
+    ])
+    opt = hvd_keras.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.05)
+    )
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+    hist = model.fit(
+        x, y, batch_size=8, epochs=2, verbose=0,
+        callbacks=[
+            hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd_keras.callbacks.MetricAverageCallback(),
+            hvd_keras.callbacks.LearningRateWarmupCallback(
+                warmup_epochs=1, steps_per_epoch=4
+            ),
+        ],
+    )
+    assert len(hist.history["loss"]) == 2
+    assert np.isfinite(hist.history["loss"][-1])
+
+
+def test_allreduce_scalar_keeps_shape():
+    out = hvd_tf.allreduce(tf.constant(2.0), op=hvd_tf.Sum)
+    assert out.shape == ()
+    assert float(out) == 2.0
+
+
+def test_allreduce_unsupported_op_raises():
+    with pytest.raises(NotImplementedError):
+        hvd_tf.allreduce(tf.constant([1.0]), op=hvd_tf.Min)
+
+
+def test_distributed_optimizer_double_wrap_raises():
+    opt = hvd_tf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5)
+    )
+    with pytest.raises(ValueError):
+        hvd_tf.DistributedOptimizer(opt)
