@@ -243,3 +243,38 @@ def process_allreduce(arr, *, op: str = Average,
     stacked = np.stack(gathered)
     return stacked.mean(0).astype(arr.dtype) if op == Average \
         else stacked.sum(0).astype(arr.dtype)
+
+
+def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
+    """Concatenate one numpy array per controller process along dim 0 —
+    the shared transport bridge behind the torch/TF/MXNet bindings'
+    allgather (varying first dimensions allowed; single-process:
+    identity)."""
+    arr = np.asarray(arr)
+    if core.process_size() == 1:
+        return arr
+    return np.concatenate(
+        [np.asarray(g) for g in allgather_object(arr, name=name)], axis=0
+    )
+
+
+def process_broadcast(arr, root_rank: int = 0, *,
+                      name: Optional[str] = None) -> np.ndarray:
+    """Root process's numpy array on every process (single-process:
+    identity) — the bindings' shared broadcast bridge."""
+    arr = np.asarray(arr)
+    if core.process_size() == 1:
+        return arr
+    return np.asarray(broadcast_object(arr, root_rank=root_rank, name=name))
+
+
+def normalize_op(average, op):
+    """The reference's handle_average_backwards_compatibility
+    (torch/mpi_ops.py): exactly one of average/op; default Average."""
+    if average is not None and op is not None:
+        raise ValueError("cannot specify both average and op")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average

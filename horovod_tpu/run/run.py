@@ -58,6 +58,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="host names and slot counts, e.g. h1:8,h2:8")
     parser.add_argument("--hostfile", dest="hostfile",
                         help="hostfile with lines 'host slots=N'")
+    parser.add_argument("--tpu", action="store_true", dest="tpu",
+                        help="resolve hosts from TPU pod metadata "
+                             "(HVD_TPU_HOSTS / TPU_WORKER_HOSTNAMES / "
+                             "GCE metadata) instead of -H")
     parser.add_argument("--output-filename", dest="output_filename",
                         help="per-rank stdout/stderr capture directory")
     parser.add_argument("--verbose", action="store_true")
@@ -159,6 +163,19 @@ def _resolve_hosts(args) -> List[HostInfo]:
         return parse_hostfile(args.hostfile)
     if args.hosts:
         return parse_hosts(args.hosts)
+    if getattr(args, "tpu", False):
+        # pod-slice host resolution from TPU metadata/env (SURVEY §7.1's
+        # replacement for the reference's ssh/NIC probing,
+        # reference run/run.py:62-115,198-268)
+        from .discovery import discover_tpu_hosts
+
+        found = discover_tpu_hosts()
+        if found:
+            return found
+        raise RuntimeError(
+            "--tpu: no pod hosts discoverable (HVD_TPU_HOSTS / "
+            "TPU_WORKER_HOSTNAMES / metadata server all empty)"
+        )
     # default: all local slots on this machine
     np = args.np or 1
     return [HostInfo("localhost", np)]

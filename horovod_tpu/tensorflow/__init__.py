@@ -80,21 +80,6 @@ def _allreduce_np(arr: np.ndarray, op, nm: str) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(out)).reshape(np.shape(arr))
 
 
-def _allgather_np(arr: np.ndarray, nm: str) -> np.ndarray:
-    if core.process_size() == 1:
-        return np.asarray(arr)
-    return np.concatenate(
-        [np.asarray(g) for g in eager.allgather_object(arr, name=nm)],
-        axis=0,
-    )
-
-
-def _broadcast_np(arr: np.ndarray, root_rank: int, nm: str) -> np.ndarray:
-    if core.process_size() == 1:
-        return np.asarray(arr)
-    return np.asarray(
-        eager.broadcast_object(arr, root_rank=root_rank, name=nm)
-    )
 
 
 def _run(np_fn, tensor, out_shape):
@@ -152,13 +137,14 @@ def allgather(tensor, name: Optional[str] = None):
     HorovodAllgatherOp; varying first dimensions allowed)."""
     nm = name or eager_controller.next_name("allgather.tf")
     out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
-    return _run(lambda a: _allgather_np(a, nm), tensor, out_shape)
+    return _run(lambda a: eager.process_allgather(a, name=nm), tensor,
+                out_shape)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
     nm = name or eager_controller.next_name("broadcast.tf")
-    return _run(lambda a: _broadcast_np(a, root_rank, nm), tensor,
-                tensor.shape)
+    return _run(lambda a: eager.process_broadcast(a, root_rank, name=nm),
+                tensor, tensor.shape)
 
 
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
@@ -173,14 +159,7 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         var.assign(broadcast(var, root_rank))
 
 
-def _normalize_op(average, op):
-    if average is not None and op is not None:
-        raise ValueError("cannot specify both average and op")
-    if op is not None:
-        return op
-    if average is False:
-        return Sum
-    return Average
+_normalize_op = eager.normalize_op
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,154 @@
+"""Collective-traffic report for a compiled SPMD step.
+
+The reference's second headline metric is allreduce *scaling efficiency*
+(90% for ResNet-101 on 512 GPUs, reference README.rst:75-77,
+docs/benchmarks.rst:12-13), measured on a real cluster.  This repo's
+bench host has one chip, so the stand-in is analytical: compile the train
+step on a virtual mesh, read the collective instructions out of the
+optimized HLO, and model the communication:compute ratio — the quantity
+scaling efficiency is made of.
+
+Usage::
+
+    from horovod_tpu.timeline.comm_report import collective_report
+    report = collective_report(step, state, x, y)   # step = hvd.spmd(...)
+    # {'collectives': {'all-reduce': {'count': 3, 'bytes': ...}, ...},
+    #  'flops_per_step': ..., 'scaling_model': {8: 0.97, 64: 0.93, ...}}
+
+``scripts/comm_report.py`` runs it for the headline ResNet-50 step.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+# HLO collective opcodes and whether their wire volume scales with the
+# ring: all-reduce moves 2(n-1)/n of the buffer per link; all-gather and
+# reduce-scatter (n-1)/n; collective-permute and all-to-all move the
+# full shard once.
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+}
+
+# instruction result: one or more "dtype[d0,d1]{layout}" entries
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^)]*\)|[^=(]+?)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(-start)?\(",
+    re.M,
+)
+
+
+def _shape_bytes(shapes: str, *, payload_only: bool = False) -> int:
+    """Bytes of an HLO result-shape string.  ``payload_only``: the shape
+    is an async ``-start`` tuple ``(operand, result, ctx...)`` whose
+    operand/result buffers are the same payload — count it once (the
+    largest entry), not the whole tuple."""
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(shapes):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * _DTYPE_BYTES[dtype])
+    if not sizes:
+        return 0
+    return max(sizes) if payload_only else sum(sizes)
+
+
+def hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Count collective instructions and their payload bytes in optimized
+    HLO text (``-done`` halves of async pairs are skipped; ``-start``
+    tuple shapes count their payload once)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for m in _INSTR_RE.finditer(hlo_text):
+        shapes, op, is_start = m.group(1), m.group(2), bool(m.group(3))
+        d = out.setdefault(op, {"count": 0, "bytes": 0})
+        d["count"] += 1
+        d["bytes"] += _shape_bytes(
+            shapes, payload_only=is_start and shapes.startswith("(")
+        )
+    return out
+
+
+def _link_volume(op: str, nbytes: int, n: int) -> float:
+    """Bytes crossing the busiest ICI link for one ring execution."""
+    if n <= 1:
+        return 0.0
+    if op == "all-reduce":
+        return 2.0 * (n - 1) / n * nbytes
+    if op in ("all-gather", "reduce-scatter"):
+        return (n - 1) / n * nbytes
+    return float(nbytes)  # permute / all-to-all: one shard hop
+
+
+def collective_report(
+    step_fn,
+    *args,
+    peak_flops: float = 197e12,
+    ici_bytes_per_sec: float = 186e9,   # v5e: ~186 GB/s per ICI direction
+    sizes=(8, 16, 32, 64),
+    measured_step_seconds: Optional[float] = None,
+    **kwargs,
+) -> Dict[str, Any]:
+    """Compile ``step_fn`` (a jitted/spmd-wrapped callable) on the current
+    mesh and report its collective traffic plus a roofline scaling model.
+
+    The model: per-step compute time = measured single-chip step time when
+    given (the honest base — pass the bench number), else flops/peak;
+    per-step comm time at world size n = Σ link_volume(op, bytes, n)/
+    ici_bw; efficiency(n) = t_compute / (t_compute + t_comm(n)) — the
+    no-overlap bound (XLA overlaps some collectives, so the real curve
+    sits between this and 1.0; the reference's 90%-at-512,
+    README.rst:75-77, is the same quantity measured)."""
+    import jax
+
+    lowered = step_fn.lower(*args, **kwargs) if hasattr(step_fn, "lower") \
+        else jax.jit(step_fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    cols = hlo_collectives(txt)
+
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    flops = float((cost or {}).get("flops", 0.0))
+
+    t_compute = measured_step_seconds if measured_step_seconds \
+        else (flops / peak_flops if flops else None)
+    scaling = {}
+    for n in sizes:
+        t_comm = sum(
+            _link_volume(op, d["bytes"], n) for op, d in cols.items()
+        ) / ici_bytes_per_sec
+        scaling[n] = (
+            round(t_compute / (t_compute + t_comm), 4)
+            if t_compute else None
+        )
+    return {
+        "collectives": cols,
+        "total_collective_bytes": sum(d["bytes"] for d in cols.values()),
+        "flops_per_step": flops,
+        "assumptions": {
+            "peak_flops": peak_flops,
+            "ici_bytes_per_sec": ici_bytes_per_sec,
+            "t_compute_seconds": t_compute,
+            "t_compute_source": "measured" if measured_step_seconds
+            else "flops/peak",
+            "model": "efficiency = t_compute / (t_compute + t_comm), "
+                     "ring collectives, no overlap",
+        },
+        "scaling_model": scaling,
+    }

@@ -158,14 +158,7 @@ def allgather_async(tensor, name=None):
     nm = name or eager_controller.next_name("allgather.torch")
 
     def work():
-        if core.process_size() == 1:
-            out = arr
-        else:
-            out = np.concatenate(
-                [np.asarray(g) for g in eager.allgather_object(arr, name=nm)],
-                axis=0,
-            )
-        return _like(tensor, out)
+        return _like(tensor, eager.process_allgather(arr, name=nm))
 
     return _handles.submit(work)
 
@@ -179,9 +172,7 @@ def broadcast_async(tensor, root_rank, name=None):
     nm = name or eager_controller.next_name("broadcast.torch")
 
     def work():
-        out = eager.broadcast_object(arr, root_rank=root_rank, name=nm) \
-            if core.process_size() > 1 else arr
-        return _like(tensor, np.asarray(out))
+        return _like(tensor, eager.process_broadcast(arr, root_rank, name=nm))
 
     return _handles.submit(work)
 
@@ -213,15 +204,7 @@ def join() -> int:
     return _join()
 
 
-def _normalize_op(average, op):
-    """reference mpi_ops.py handle_average_backwards_compatibility."""
-    if average is not None and op is not None:
-        raise ValueError("cannot specify both average and op")
-    if op is not None:
-        return op
-    if average is False:
-        return Sum
-    return Average
+_normalize_op = eager.normalize_op
 
 
 # ---------------------------------------------------------------------------

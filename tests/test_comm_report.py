@@ -1,0 +1,60 @@
+"""Collective-traffic report — the scaling-efficiency stand-in
+(reference docs/benchmarks.rst:12-13 headline metric, modeled
+analytically on the virtual mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models.mlp import MLP
+from horovod_tpu.timeline.comm_report import (
+    collective_report, hlo_collectives,
+)
+from horovod_tpu.training import init_train_state, make_train_step, shard_batch
+
+
+def test_hlo_parser_counts_and_bytes():
+    txt = """
+  %ar = f32[1024,8]{1,0} all-reduce(%x), replica_groups={}
+  %ag.1 = bf16[64]{0} all-gather(%y), dimensions={0}
+  %done = f32[4]{0} all-reduce-done(%h)
+"""
+    cols = hlo_collectives(txt)
+    assert cols["all-reduce"] == {"count": 1, "bytes": 1024 * 8 * 4}
+    assert cols["all-gather"] == {"count": 1, "bytes": 64 * 2}
+
+
+def test_report_finds_gradient_allreduce(hvd_init, rng):
+    model = MLP(features=(32, 10))
+    opt = optax.sgd(0.1)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    step = make_train_step(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=opt, donate=False,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 16)))
+    x = shard_batch(rng.normal(size=(64, 16)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 10, size=(64,)).astype(np.int32))
+
+    report = collective_report(lambda s, a, b: step(s, a, b), state, x, y)
+    assert "all-reduce" in report["collectives"]
+    param_bytes = 4 * sum(
+        l.size for l in jax.tree_util.tree_leaves(state.params)
+    )
+    # fused gradient allreduce + scalar loss allreduce; XLA may fold both
+    # into one instruction or keep two — bytes must cover the gradients
+    total = report["total_collective_bytes"]
+    assert param_bytes <= total <= param_bytes + 1024
+    assert report["scaling_model"][8] is not None
+    assert 0 < report["scaling_model"][64] <= 1
+    # more chips -> monotonically no-better efficiency in the ring model
+    effs = [report["scaling_model"][n] for n in (8, 16, 32, 64)]
+    assert all(a >= b for a, b in zip(effs, effs[1:]))

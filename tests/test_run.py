@@ -262,3 +262,46 @@ def test_function_mode_run():
 
     results = tpurun.run(fn, args=(10, 20), np=2)
     assert results == [30, 31]
+
+
+def test_tpu_host_discovery_env_override(monkeypatch):
+    """--tpu resolves hosts from HVD_TPU_HOSTS / TPU_WORKER_HOSTNAMES
+    (SURVEY §7.1's replacement for the reference's ssh/NIC probing)."""
+    from horovod_tpu.run.discovery import discover_tpu_hosts
+
+    monkeypatch.setenv("HVD_TPU_HOSTS", "podhost-0:4,podhost-1:4")
+    hosts = discover_tpu_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("podhost-0", 4), ("podhost-1", 4)]
+
+    monkeypatch.delenv("HVD_TPU_HOSTS")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2")
+    hosts = discover_tpu_hosts(default_slots=8)
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("w0", 8), ("w1", 8), ("w2", 8)]
+
+
+def test_tpu_host_discovery_metadata(monkeypatch):
+    from horovod_tpu.run import discovery
+
+    monkeypatch.delenv("HVD_TPU_HOSTS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    # real worker-network-endpoints entries carry the worker IP in the
+    # last :-field (jax cloud_tpu_cluster parses worker.split(':')[2])
+    monkeypatch.setattr(
+        discovery, "_metadata_endpoints",
+        lambda timeout=2.0: "0:worker-0:10.0.0.2,1:worker-1:10.0.0.3",
+    )
+    hosts = discovery.discover_tpu_hosts(default_slots=4)
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("10.0.0.2", 4), ("10.0.0.3", 4)]
+
+
+def test_tpu_flag_resolves_hosts(monkeypatch):
+    from horovod_tpu.run.run import _resolve_hosts, parse_args
+
+    monkeypatch.setenv("HVD_TPU_HOSTS", "pod-a:8,pod-b:8")
+    args = parse_args(["--tpu", "python", "train.py"])
+    hosts = _resolve_hosts(args)
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("pod-a", 8), ("pod-b", 8)]
