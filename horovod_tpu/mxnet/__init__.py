@@ -88,11 +88,24 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
         items = sorted(params.items())
     else:
         raise ValueError("invalid params of type: %s" % type(params))
+    from ..utils.logging import get_logger
+
+    log = get_logger(__name__)
     for name, p in items:
-        try:
-            tensor = p.data() if hasattr(p, "data") else p
-        except Exception:  # noqa: BLE001 — uninitialized gluon param
-            continue
+        if hasattr(p, "data"):
+            try:
+                tensor = p.data()
+            except mx.gluon.parameter.DeferredInitializationError:
+                # shape-deferred param: skipping silently would leave each
+                # rank on its own init — tell the user to run a forward
+                # pass (or initialize) before broadcasting
+                log.warning(
+                    "broadcast_parameters: %s is deferred-initialized and "
+                    "was NOT broadcast; run a forward pass first", name,
+                )
+                continue
+        else:
+            tensor = p
         broadcast_(tensor, root_rank, name=f"parameter.{name}")
 
 

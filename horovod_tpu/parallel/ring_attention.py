@@ -37,12 +37,20 @@ from .. import core
 from ..ops import flash_attention as fa
 
 
-def _axis():
+def _axis(axis=None):
+    """Resolve the sequence-parallel mesh axis.  ``axis`` explicit wins —
+    that is how SP composes with DP on a 2-D (dp, sp) mesh: shard the
+    batch over dp, the sequence over sp, and pass ``axis="sp"`` here.
+    Default: the framework's single SPMD rank axis."""
+    if axis is not None:
+        return axis
     axes = core._spmd_axes()
     if axes is None:
         raise RuntimeError("ring attention must run inside an SPMD region")
     if len(axes) != 1:
-        raise NotImplementedError("ring attention over hierarchical mesh")
+        raise NotImplementedError(
+            "pass axis= to pick the sequence axis of a multi-axis mesh"
+        )
     return axes[0]
 
 
@@ -80,37 +88,41 @@ def ring_attention(q, k, v, *, causal: bool = False,
                    impl: str = "xla",
                    block_q: int = fa.DEFAULT_BLOCK_Q,
                    block_k: int = fa.DEFAULT_BLOCK_K,
-                   interpret: Optional[bool] = None):
+                   interpret: Optional[bool] = None,
+                   axis: Optional[str] = None):
     """Attention over a sequence sharded across ranks.
 
     Args:
       q, k, v: per-rank shards ``[batch, seq_local, heads, head_dim]``;
-        global sequence = ``seq_local * size()``, shard r owns positions
-        ``[r*seq_local, (r+1)*seq_local)``.
+        global sequence = ``seq_local * axis_size``, shard r owns
+        positions ``[r*seq_local, (r+1)*seq_local)``.
       causal: apply causal masking in *global* positions.
       scale: logit scale; default ``1/sqrt(head_dim)``.
       impl: ``"xla"`` (lax einsums, XLA fuses) or ``"pallas"`` (flash
         kernels on the MXU per hop, custom VJP rotating gradients around
         the ring; see :mod:`horovod_tpu.ops.flash_attention`).
+      axis: sequence mesh axis; default = the global rank axis.  Pass
+        the sp axis name to compose with data parallelism on a 2-D
+        (dp, sp) mesh.
 
     Returns the attention output for the local q shard, same shape/dtype
     as ``q``.
     """
     if impl == "pallas":
-        axis = _axis()
+        axis = _axis(axis)
         if scale is None:
             scale = 1.0 / float(np.sqrt(q.shape[-1]))
         fn = _ring_pallas_fn(
-            axis, core.size(), bool(causal), float(scale), int(block_q),
-            int(block_k), fa._resolve_interpret(interpret),
+            axis, lax.axis_size(axis), bool(causal), float(scale),
+            int(block_q), int(block_k), fa._resolve_interpret(interpret),
         )
         out = fn(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                  jnp.swapaxes(v, 1, 2))
         return jnp.swapaxes(out, 1, 2)
     if impl != "xla":
         raise ValueError(f"unknown impl {impl!r} (want 'xla' or 'pallas')")
-    axis = _axis()
-    n = core.size()
+    axis = _axis(axis)
+    n = lax.axis_size(axis)
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     seq_local = q.shape[1]
     my = lax.axis_index(axis)
@@ -244,17 +256,19 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                       impl: str = "xla",
                       block_q: int = fa.DEFAULT_BLOCK_Q,
                       block_k: int = fa.DEFAULT_BLOCK_K,
-                      interpret: Optional[bool] = None):
+                      interpret: Optional[bool] = None,
+                      axis: Optional[str] = None):
     """All-to-all ("Ulysses") sequence parallelism.
 
     Per-rank inputs ``[batch, seq_local, heads, head_dim]`` with
-    ``heads % size() == 0``: one all_to_all reshards to
-    ``[batch, seq_global, heads/size, head_dim]``, full attention runs
-    locally on the head subset, and a second all_to_all restores sequence
-    sharding.
+    ``heads % axis_size == 0``: one all_to_all reshards to
+    ``[batch, seq_global, heads/axis_size, head_dim]``, full attention
+    runs locally on the head subset, and a second all_to_all restores
+    sequence sharding.  ``axis``: as in :func:`ring_attention` — pass the
+    sp axis of a (dp, sp) mesh to compose with data parallelism.
     """
-    axis = _axis()
-    n = core.size()
+    axis = _axis(axis)
+    n = lax.axis_size(axis)
     b, s_local, h, d = q.shape
     if h % n:
         raise ValueError(f"heads {h} not divisible by ranks {n}")

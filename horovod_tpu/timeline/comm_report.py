@@ -41,8 +41,11 @@ _DTYPE_BYTES = {
 
 # instruction result: one or more "dtype[d0,d1]{layout}" entries
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# shape group allows one level of tuple nesting: multi-operand async
+# starts have shapes like ((f32[...], f32[...]), (f32[...], f32[...]), ...)
 _INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^)]*\)|[^=(]+?)\s+"
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+    r"(\((?:[^()]|\([^()]*\))*\)|[^=(]+?)\s+"
     r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
     r"(-start)?\(",
     re.M,
@@ -51,10 +54,10 @@ _INSTR_RE = re.compile(
 
 def _shape_bytes(shapes: str, *, payload_only: bool = False) -> int:
     """Bytes of an HLO result-shape string.  ``payload_only``: the shape
-    is an async ``-start`` tuple ``(operand, result, ctx...)`` whose
-    operand/result buffers are the same payload — count it once (the
-    largest entry), not the whole tuple."""
-    sizes = []
+    is an async ``-start`` tuple that carries the payload twice —
+    ``(operand, result, ctx...)`` or ``((ops...), (results...), ...)`` —
+    so count half of the array bytes (context scalars are u32s, noise)."""
+    total = 0
     for dtype, dims in _SHAPE_RE.findall(shapes):
         if dtype not in _DTYPE_BYTES:
             continue
@@ -62,10 +65,8 @@ def _shape_bytes(shapes: str, *, payload_only: bool = False) -> int:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        sizes.append(n * _DTYPE_BYTES[dtype])
-    if not sizes:
-        return 0
-    return max(sizes) if payload_only else sum(sizes)
+        total += n * _DTYPE_BYTES[dtype]
+    return total // 2 if payload_only else total
 
 
 def hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:

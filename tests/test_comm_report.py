@@ -58,3 +58,16 @@ def test_report_finds_gradient_allreduce(hvd_init, rng):
     # more chips -> monotonically no-better efficiency in the ring model
     effs = [report["scaling_model"][n] for n in (8, 16, 32, 64)]
     assert all(a >= b for a, b in zip(effs, effs[1:]))
+
+
+def test_hlo_parser_async_start_forms():
+    """Async -start shapes carry the payload twice; -done is skipped;
+    multi-operand nested-tuple starts must parse (real-TPU HLO form)."""
+    txt = """
+  %cps = (f32[1024]{0}, f32[1024]{0}, u32[], u32[]) collective-permute-start(%x), ...
+  %ars = ((f32[100]{0}, f32[50]{0}), (f32[100]{0}, f32[50]{0})) all-reduce-start(%a, %b), ...
+  %ard = (f32[100]{0}, f32[50]{0}) all-reduce-done(%ars)
+"""
+    cols = hlo_collectives(txt)
+    assert cols["collective-permute"]["bytes"] == 1024 * 4 + 4  # +ctx/2
+    assert cols["all-reduce"] == {"count": 1, "bytes": 150 * 4}
