@@ -24,8 +24,50 @@ from typing import Any, Callable, Sequence
 
 import jax.numpy as jnp
 from flax import linen as nn
+from jax import lax
 
 ModuleDef = Any
+
+
+class SpaceToDepthConvInit(nn.Module):
+    """The ResNet stem (7x7 stride-2 conv) computed as a 4x4 stride-1
+    conv on space-to-depth-transformed input — mathematically identical
+    output, but the MXU sees 12 input channels instead of 3 and no
+    stride (the MLPerf TPU ResNet trick).  Holds the SAME (7,7,Cin,F)
+    kernel parameter as the plain conv, so checkpoints interchange;
+    the 4x4x(4Cin) kernel is derived in-graph (tiny, XLA folds it)."""
+
+    features: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"space_to_depth stem needs even spatial dims, got "
+                f"{(h, w)}; use stem='conv' for odd input sizes"
+            )
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (7, 7, c, self.features), self.param_dtype,
+        ).astype(self.dtype)
+        x = x.astype(self.dtype)
+        # space-to-depth(2): y[p,q,(a,b,ch)] = x[2p+a, 2q+b, ch]
+        y = x.reshape(b, h // 2, 2, w // 2, 2, c) \
+             .transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        # out(i,j) = sum_{u,v} x[u,v] K[u-2i+3, v-2j+3]; with u=2p+a the
+        # kernel index is 2(p-i)+a+3 = 2P+a-1 for P=p-i+2 in [0,4) — pad
+        # one leading zero row/col so it becomes K8[2P+a, 2Q+b]
+        k8 = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        kp = k8.reshape(4, 2, 4, 2, c, self.features) \
+               .transpose(0, 2, 1, 3, 4, 5) \
+               .reshape(4, 4, 4 * c, self.features)
+        return lax.conv_general_dilated(
+            y, kp, (1, 1), [(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
 
 
 class BottleneckBlock(nn.Module):
@@ -84,6 +126,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    stem: str = "conv"  # "conv" | "space_to_depth" (same params/output)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -96,8 +139,14 @@ class ResNet(nn.Module):
             epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype,
         )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), strides=(2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = SpaceToDepthConvInit(
+                features=self.num_filters, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="conv_init",
+            )(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -303,7 +303,15 @@ def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
 
     Mirrors ``hvd.broadcast`` (reference horovod/common/ops/
     mpi_operations.cc MPIBroadcast / nccl_operations.cc NCCLBroadcast).
-    Implemented as a masked psum — one collective, no gather blow-up.
+    Implemented as a masked psum — XLA has no one-to-all HLO, and of the
+    expressible schedules this is the deliberate choice: a ring psum
+    moves 2(n-1)/n x bytes over ICI (~2x a textbook broadcast's
+    (n-1)/n) in ONE collective, vs n x bytes for all_gather-and-index or
+    (n-1) serial latency hops for a ppermute pipeline.  On ICI the 2x is
+    noise (broadcast traffic is start-up parameter sync, docs/PERF.md
+    measures the gradient allreduce at 102 MB vs ~1 ms); across DCN
+    prefer the host-plane ``eager.process_broadcast``, which sends the
+    payload once.
     """
     axes = _axes()
     groups, _ = _group_args(process_set)

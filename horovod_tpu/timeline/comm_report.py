@@ -52,13 +52,9 @@ _INSTR_RE = re.compile(
 )
 
 
-def _shape_bytes(shapes: str, *, payload_only: bool = False) -> int:
-    """Bytes of an HLO result-shape string.  ``payload_only``: the shape
-    is an async ``-start`` tuple that carries the payload twice —
-    ``(operand, result, ctx...)`` or ``((ops...), (results...), ...)`` —
-    so count half of the array bytes (context scalars are u32s, noise)."""
+def _array_bytes(s: str) -> int:
     total = 0
-    for dtype, dims in _SHAPE_RE.findall(shapes):
+    for dtype, dims in _SHAPE_RE.findall(s):
         if dtype not in _DTYPE_BYTES:
             continue
         n = 1
@@ -66,7 +62,39 @@ def _shape_bytes(shapes: str, *, payload_only: bool = False) -> int:
             if d:
                 n *= int(d)
         total += n * _DTYPE_BYTES[dtype]
-    return total // 2 if payload_only else total
+    return total
+
+
+def _split_top_level(tup: str):
+    """Top-level elements of an HLO tuple-shape string '(a, (b, c), d)'."""
+    inner = tup.strip()
+    if inner.startswith("(") and inner.endswith(")"):
+        inner = inner[1:-1]
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(inner):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(inner[start:i])
+            start = i + 1
+    parts.append(inner[start:])
+    return parts
+
+
+def _shape_bytes(shapes: str, *, payload_only: bool = False) -> int:
+    """Bytes of an HLO result-shape string.  ``payload_only``: the shape
+    is an async ``-start`` tuple carrying operands AND results —
+    ``(operand, result, ctx...)`` or ``((ops...), (results...), ctx)``.
+    The payload is the largest top-level element (operand == result for
+    all-reduce/permute; the result for all-gather; the operand for
+    reduce-scatter — in every case the max, and context scalars lose)."""
+    if not payload_only:
+        return _array_bytes(shapes)
+    return max(
+        (_array_bytes(p) for p in _split_top_level(shapes)), default=0
+    )
 
 
 def hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:

@@ -69,5 +69,17 @@ def test_hlo_parser_async_start_forms():
   %ard = (f32[100]{0}, f32[50]{0}) all-reduce-done(%ars)
 """
     cols = hlo_collectives(txt)
-    assert cols["collective-permute"]["bytes"] == 1024 * 4 + 4  # +ctx/2
+    assert cols["collective-permute"]["bytes"] == 1024 * 4
     assert cols["all-reduce"] == {"count": 1, "bytes": 150 * 4}
+
+
+def test_hlo_parser_asymmetric_async_start():
+    """all-gather-start carries (small operand, big result): the payload
+    is the result, not half the tuple."""
+    txt = """
+  %ag = (f32[128]{0}, f32[1024]{0}) all-gather-start(%x), dimensions={0}
+  %rs = (f32[1024]{0}, f32[128]{0}) reduce-scatter-start(%y), ...
+"""
+    cols = hlo_collectives(txt)
+    assert cols["all-gather"]["bytes"] == 1024 * 4
+    assert cols["reduce-scatter"]["bytes"] == 1024 * 4

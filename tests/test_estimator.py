@@ -119,3 +119,15 @@ def test_estimator_with_callbacks(hvd_init, rng, tmp_path):
     model = est.fit(x, y)
     assert bcast.broadcast_done
     assert "loss" in model.history[0]
+
+
+def test_spark_module_import_gate():
+    """horovod_tpu.spark requires pyspark; the gate must be a clean
+    ImportError (reference horovod.spark does the same)."""
+    try:
+        import pyspark  # noqa: F401
+        pytest.skip("pyspark installed; gate test not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError):
+        import horovod_tpu.spark  # noqa: F401

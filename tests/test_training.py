@@ -160,3 +160,22 @@ def test_in_graph_steps_matches_sequential(hvd_init, rng):
         np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
                                    rtol=1e-5, atol=1e-6)
     assert int(state_b.step) == 4
+
+
+def test_space_to_depth_stem_equivalent(rng):
+    """The s2d stem (MLPerf TPU trick) shares the (7,7,C,F) kernel param
+    and produces the plain conv stem's exact output."""
+    import jax
+
+    from horovod_tpu.models.resnet import ResNet18
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        m1 = ResNet18(num_classes=10, dtype=jnp.float32)
+        m2 = ResNet18(num_classes=10, dtype=jnp.float32,
+                      stem="space_to_depth")
+        x = jnp.asarray(rng.normal(size=(2, 64, 64, 3)).astype(np.float32))
+        v = m1.init(jax.random.PRNGKey(0), x, train=False)
+        o1 = m1.apply(v, x, train=False)
+        o2 = m2.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-4, atol=1e-4)
