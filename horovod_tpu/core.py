@@ -179,8 +179,19 @@ def init(
         if size == 0:
             raise RuntimeError("no devices available for horovod_tpu.init()")
 
+        # identity must come from the backend the mesh devices live on —
+        # jax.process_count()/process_index() default to the default
+        # backend, which can be a single-process accelerator plugin while
+        # the (e.g. CPU) mesh backend spans a jax.distributed job
+        mesh_platform = devs[0].platform
+        try:
+            jax_nproc = jax.process_count(mesh_platform)
+            jax_pidx = jax.process_index(mesh_platform)
+        except Exception:  # noqa: BLE001 — backend without process info
+            jax_nproc, jax_pidx = jax.process_count(), jax.process_index()
+
         if local_size is None:
-            mine = [d for d in devs if d.process_index == jax.process_index()]
+            mine = [d for d in devs if d.process_index == jax_pidx]
             local_size = len(mine) if mine else size
         if size % local_size != 0:
             raise ValueError(
@@ -200,8 +211,8 @@ def init(
         # per-process but the eager control/data planes span the job
         # (reference gloo_context.cc:128-156 reads HOROVOD_RANK/SIZE the
         # same way).
-        if jax.process_count() > 1:
-            process_index, process_count = jax.process_index(), jax.process_count()
+        if jax_nproc > 1:
+            process_index, process_count = jax_pidx, jax_nproc
         else:
             process_count = env_util.get_int(env_util.HVD_NUM_PROCESSES, 1)
             process_index = env_util.get_int(env_util.HVD_PROCESS_ID, 0)

@@ -145,8 +145,82 @@ def _jax_spans_processes() -> bool:
     """True when the XLA plane itself is multi-process (jax.distributed on a
     real pod) — then multihost_utils is the transport.  Otherwise a
     multi-process job must carry host objects over the native controller's
-    data plane (csrc/controller.cc HandleData)."""
-    return jax.process_count() > 1
+    data plane (csrc/controller.cc HandleData).
+
+    Queried on the MESH devices' backend: the default backend can be a
+    single-process accelerator plugin while the CPU mesh backend spans the
+    jax.distributed job (or vice versa)."""
+    try:
+        platform = core.mesh().devices.flat[0].platform
+        return jax.process_count(platform) > 1
+    except Exception:  # noqa: BLE001 — not initialized / exotic backend
+        return jax.process_count() > 1
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=8)
+def _process_mesh_for(job_mesh):
+    from jax.sharding import Mesh
+
+    firsts, seen = [], set()
+    for d in job_mesh.devices.flat:
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            firsts.append(d)
+    firsts.sort(key=lambda d: d.process_index)
+    return Mesh(np.array(firsts, dtype=object), ("proc",))
+
+
+def _process_mesh():
+    """A 1-D mesh with ONE device per controller process, drawn from the
+    job mesh — the carrier for host-object collectives on the XLA plane.
+    (multihost_utils builds its mesh from ``jax.devices()``, the default
+    backend, which on mixed-backend hosts may not be the spanning one;
+    the job mesh always is.)"""
+    return _process_mesh_for(core.mesh())
+
+
+@_functools.lru_cache(maxsize=64)
+def _replicate_fn(pmesh):
+    return jax.jit(lambda x: x, out_shardings=NamedSharding(pmesh, P()))
+
+
+@_functools.lru_cache(maxsize=64)
+def _sum_rows_fn(pmesh):
+    return jax.jit(lambda x: jnp.sum(x, axis=0, dtype=x.dtype),
+                   out_shardings=NamedSharding(pmesh, P()))
+
+
+def _mesh_rows_array(row: np.ndarray):
+    """The per-process ``row`` assembled as an ``[nproc, ...]`` global
+    array sharded one-row-per-process over the job mesh's backend.
+    Assembled from single-device shards: the higher-level constructors
+    consult the default backend's process count, which may not be the
+    mesh's."""
+    pmesh = _process_mesh()
+    sharding = NamedSharding(pmesh, P("proc"))
+    mine = [d for d in pmesh.devices.flat
+            if d.process_index == core.process_rank()]
+    shards = [jax.device_put(row[None], d) for d in mine]
+    return pmesh, jax.make_array_from_single_device_arrays(
+        (pmesh.size,) + row.shape, sharding, shards
+    )
+
+
+def _mesh_allgather_rows(row: np.ndarray) -> np.ndarray:
+    """Gather one equal-shape numpy row per process into an
+    ``[nproc, ...]`` array, replicated to every process."""
+    pmesh, garr = _mesh_rows_array(row)
+    return np.asarray(_replicate_fn(pmesh)(garr).addressable_data(0))
+
+
+def _mesh_sum_rows(row: np.ndarray) -> np.ndarray:
+    """Elementwise sum of one row per process, replicated — O(payload)
+    wire/memory (an allreduce), unlike the O(nproc x payload) gather."""
+    pmesh, garr = _mesh_rows_array(row)
+    return np.asarray(_sum_rows_fn(pmesh)(garr).addressable_data(0))
 
 
 def broadcast_object(obj: Any, root_rank: int = 0, *, name: Optional[str] = None):
@@ -167,23 +241,17 @@ def broadcast_object(obj: Any, root_rank: int = 0, *, name: Optional[str] = None
         nm = name or eager_controller.next_name("broadcast_object")
         payload = pickle.dumps(obj) if core.process_rank() == root_rank else b""
         return pickle.loads(c.broadcast_data(nm, payload, root_rank=root_rank))
-    from jax.experimental import multihost_utils
-
-    if core.process_rank() == root_rank:
-        payload = pickle.dumps(obj)
-    else:
-        payload = b""
+    payload = pickle.dumps(obj) if core.process_rank() == root_rank else b""
     # Two-phase: length first, then fixed-size payload — same shape as the
-    # reference's sz tensor broadcast followed by the byte tensor.
-    n = np.asarray([len(payload)], np.int64)
-    n = multihost_utils.broadcast_one_to_all(n, is_source=core.process_rank() == root_rank)
-    buf = np.zeros(int(n[0]), np.uint8)
+    # reference's sz tensor broadcast followed by the byte tensor.  Both
+    # phases are masked psums (non-root contributes zeros): O(payload)
+    # wire/memory per process, vs O(nproc x payload) for a gather.
+    n = int(_mesh_sum_rows(np.asarray([len(payload)], np.int64))[0])
+    buf = np.zeros(n, np.uint8)
     if core.process_rank() == root_rank:
         buf[:] = np.frombuffer(payload, np.uint8)
-    buf = multihost_utils.broadcast_one_to_all(
-        buf, is_source=core.process_rank() == root_rank
-    )
-    return pickle.loads(buf.tobytes())
+    out = _mesh_sum_rows(buf)  # single contributor: exact even in uint8
+    return pickle.loads(out.tobytes())
 
 
 def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
@@ -202,16 +270,14 @@ def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
         nm = name or eager_controller.next_name("allgather_object")
         blobs = c.allgather_data(nm, pickle.dumps(obj))
         return [pickle.loads(b) for b in blobs]
-    from jax.experimental import multihost_utils
-
     payload = np.frombuffer(pickle.dumps(obj), np.uint8)
-    sizes = multihost_utils.process_allgather(
+    sizes = _mesh_allgather_rows(
         np.asarray([payload.size], np.int64)
     ).reshape(-1)
     maxlen = int(sizes.max())
     padded = np.zeros(maxlen, np.uint8)
     padded[: payload.size] = payload
-    gathered = multihost_utils.process_allgather(padded)
+    gathered = _mesh_allgather_rows(padded)
     return [
         pickle.loads(gathered[i, : int(sizes[i])].tobytes())
         for i in range(core.process_size())

@@ -144,9 +144,14 @@ class ResNet(nn.Module):
                 features=self.num_filters, dtype=self.dtype,
                 param_dtype=self.param_dtype, name="conv_init",
             )(x)
-        else:
+        elif self.stem == "conv":
             x = conv(self.num_filters, (7, 7), strides=(2, 2),
                      padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        else:
+            raise ValueError(
+                f"unknown stem {self.stem!r} (want 'conv' or "
+                "'space_to_depth')"
+            )
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -66,15 +66,17 @@ def _array_bytes(s: str) -> int:
 
 
 def _split_top_level(tup: str):
-    """Top-level elements of an HLO tuple-shape string '(a, (b, c), d)'."""
+    """Top-level elements of an HLO tuple-shape string
+    '(f32[128,256]{1,0}, (b, c), d)' — commas inside (), [] and {} (dims
+    and layouts) do not split."""
     inner = tup.strip()
     if inner.startswith("(") and inner.endswith(")"):
         inner = inner[1:-1]
     parts, depth, start = [], 0, 0
     for i, ch in enumerate(inner):
-        if ch == "(":
+        if ch in "([{":
             depth += 1
-        elif ch == ")":
+        elif ch in ")]}":
             depth -= 1
         elif ch == "," and depth == 0:
             parts.append(inner[start:i])

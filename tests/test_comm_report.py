@@ -83,3 +83,12 @@ def test_hlo_parser_asymmetric_async_start():
     cols = hlo_collectives(txt)
     assert cols["all-gather"]["bytes"] == 1024 * 4
     assert cols["reduce-scatter"]["bytes"] == 1024 * 4
+
+
+def test_hlo_parser_multidim_async_start():
+    """Commas inside [dims] and {layout} must not split tuple elements."""
+    txt = """
+  %cps = (f32[128,256]{1,0}, f32[128,256]{1,0}, u32[], u32[]) collective-permute-start(%x), ...
+"""
+    cols = hlo_collectives(txt)
+    assert cols["collective-permute"]["bytes"] == 128 * 256 * 4

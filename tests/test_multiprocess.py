@@ -257,3 +257,97 @@ def test_two_process_tensorflow_binding():
         assert res["sparse_indices"] == [0, 1]
         assert res["sparse_values"] == [1.0, 2.0]
         assert res["bcast_var"] == [10.0, 10.0]
+
+
+def _worker_jax_distributed():
+    """The jax.distributed transport (a real pod's XLA plane): hvd.init
+    bootstraps from HVD_COORDINATOR_ADDR, host-object collectives ride
+    the mesh backend, and a COMPILED psum crosses process boundaries."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init(platform="cpu")
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu import core, eager
+
+    r = hvd.process_rank()
+    out = {"rank": r, "ps": hvd.process_size(), "size": hvd.size(),
+           "jax_pc": jax.process_count("cpu")}
+
+    out["bcast"] = eager.broadcast_object({"root": r}, root_rank=1)
+    out["gathered"] = eager.allgather_object(f"p{r}" * (r + 1))
+    out["sum"] = float(eager.process_allreduce(
+        np.asarray([float(r + 1)]), op=hvd.Sum)[0])
+
+    # compiled SPMD allreduce across the process-spanning mesh
+    mesh = core.mesh()
+    sharding = NamedSharding(mesh, P(hvd.AXIS))
+    mine = [d for d in mesh.devices.flat if d.process_index == r]
+    dev_index = {id(d): i for i, d in enumerate(mesh.devices.flat)}
+    shards = [
+        jax.device_put(np.full((1, 2), float(dev_index[id(d)] + 1),
+                               np.float32), d)
+        for d in mine
+    ]
+    garr = jax.make_array_from_single_device_arrays(
+        (hvd.size(), 2), sharding, shards)
+
+    @hvd.spmd
+    def f(x):
+        return hvd.allreduce(x[0], op=hvd.Sum)[None]
+
+    res = f(garr)
+    out["compiled_sum"] = float(
+        np.asarray(res.addressable_data(0)).reshape(-1)[0]
+    )
+    return out
+
+
+def test_two_process_jax_distributed_plane():
+    """Spawns 2 processes that form a jax.distributed job on the CPU
+    backend (2 devices each -> a 4-device mesh spanning processes) — the
+    multihost branch of every eager collective plus a compiled
+    cross-process psum (reference: every op test under mpirun -np 2)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker_src = (
+        "import sys, json; sys.path.insert(0, %r)\n"
+        "from tests.test_multiprocess import _worker_jax_distributed\n"
+        "print('RESULT ' + json.dumps(_worker_jax_distributed()))\n"
+    ) % repo
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update({
+            "HVD_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            "HVD_NUM_PROCESSES": "2",
+            "HVD_PROCESS_ID": str(i),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker_src], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-2000:]
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][0]
+        results.append(json.loads(line[len("RESULT "):]))
+    for r, res in enumerate(results):
+        assert res["rank"] == r
+        assert res["ps"] == 2 and res["jax_pc"] == 2
+        assert res["size"] == 4
+        assert res["bcast"] == {"root": 1}
+        assert res["gathered"] == ["p0", "p1p1"]
+        assert res["sum"] == 3.0
+        assert res["compiled_sum"] == 1.0 + 2 + 3 + 4
