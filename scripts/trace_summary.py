@@ -1,0 +1,112 @@
+"""Trace analysis over per-rank communication timelines.
+
+The fork's raison d'être is per-rank trace capture for dPRO-style replay
+(reference timeline.cc per-rank ``<dir>/<local_rank>/comm.json``,
+recorder.py DAG/shape dumps).  This is the first-pass analyzer those
+traces feed: per-tensor negotiation vs execution time, per-op totals,
+cross-rank skew — the numbers a comm-bottleneck hunt starts from.
+
+Run:  python scripts/trace_summary.py <timeline_dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+
+
+def load_rank_events(path: str):
+    """comm.json may be live (no closing bracket) — parse leniently."""
+    with open(path) as f:
+        txt = f.read().strip()
+    if txt.endswith(","):
+        txt = txt[:-1]
+    if not txt.endswith("]"):
+        txt += "]"
+    return json.loads(txt)
+
+
+def summarize(timeline_dir: str) -> dict:
+    ranks = {}
+    for entry in sorted(os.listdir(timeline_dir)):
+        f = os.path.join(timeline_dir, entry, "comm.json")
+        if os.path.isfile(f):
+            ranks[entry] = load_rank_events(f)
+    if not ranks:
+        raise FileNotFoundError(
+            f"no <rank>/comm.json under {timeline_dir}"
+        )
+
+    per_rank = {}
+    for rank, events in ranks.items():
+        ops = collections.defaultdict(
+            lambda: {"count": 0, "total_us": 0.0, "negotiate_us": 0.0}
+        )
+        open_spans = {}
+        for ev in events:
+            name, ph = ev.get("name", ""), ev.get("ph")
+            key = (name, ev.get("tid"))
+            if ph == "B":
+                open_spans[key] = ev["ts"]
+            elif ph == "E" and key in open_spans:
+                dur = ev["ts"] - open_spans.pop(key)
+                if name.startswith("NEGOTIATE_"):
+                    op = name[len("NEGOTIATE_"):]
+                    ops[op]["negotiate_us"] += dur
+                    ops[op]["count"] += 1
+            elif ph == "X":
+                # per-rank readiness markers are digit-named micro events
+                # inside NEGOTIATE (timeline.negotiate_rank_ready) — not ops
+                if name.isdigit() or name == "CYCLE_START":
+                    continue
+                d = ops[name]
+                d["total_us"] += ev.get("dur", 0.0)
+                if not name.startswith("NEGOTIATE_"):
+                    d["exec_count"] = d.get("exec_count", 0) + 1
+        per_rank[rank] = {op: dict(v) for op, v in ops.items()}
+
+    # cross-rank skew: same op's total time, max/min across ranks
+    all_ops = sorted({op for r in per_rank.values() for op in r})
+    skew = {}
+    for op in all_ops:
+        totals = [r.get(op, {}).get("total_us", 0.0)
+                  for r in per_rank.values()]
+        if any(totals):
+            skew[op] = {
+                "min_us": min(totals), "max_us": max(totals),
+                "skew": (max(totals) / min(totals)
+                         if min(totals) > 0 else None),
+            }
+    return {"ranks": per_rank, "cross_rank_skew": skew}
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("timeline_dir")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+    s = summarize(args.timeline_dir)
+    if args.json:
+        print(json.dumps(s, indent=2))
+        return s
+    for rank, ops in s["ranks"].items():
+        print(f"rank {rank}:")
+        for op, v in sorted(ops.items()):
+            neg = v.get("negotiate_us", 0.0)
+            tot = v.get("total_us", 0.0)
+            n = v.get("exec_count", 0) or v.get("count", 0)
+            overhead = f"  negotiate {neg:9.1f} us" if neg else ""
+            print(f"  {op:<22} n={n:<4} exec {tot:10.1f} us{overhead}")
+    if s["cross_rank_skew"]:
+        print("cross-rank skew (exec total, max/min):")
+        for op, v in s["cross_rank_skew"].items():
+            sk = f"{v['skew']:.2f}x" if v["skew"] else "n/a"
+            print(f"  {op:<22} {sk}")
+    return s
+
+
+if __name__ == "__main__":
+    main()

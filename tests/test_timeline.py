@@ -72,3 +72,37 @@ def test_eager_ops_emit_timeline(hvd_init, tmp_path, rng):
     tl.shutdown()
     events = _read(tmp_path / "0" / "comm.json")
     assert any(e.get("cat") == "allreduce.loss" for e in events)
+
+
+def test_trace_summary_tool(tmp_path, hvd_init):
+    """scripts/trace_summary.py digests per-rank comm.json into per-op
+    totals + negotiation overhead (the dPRO-style first-pass analysis the
+    fork's traces exist for)."""
+    import importlib.util as _ilu
+
+    from horovod_tpu import eager
+    from horovod_tpu.timeline.timeline import timeline
+
+    d = str(tmp_path / "tl")
+    timeline.initialize(d)
+    for _ in range(2):
+        eager.allreduce_([np.ones(4, np.float32)] * hvd.size(), name="g1")
+        eager.broadcast_([np.ones(2, np.float32)] * hvd.size(), name="p0")
+    timeline.shutdown()
+
+    spec = _ilu.spec_from_file_location(
+        "trace_summary",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "trace_summary.py"),
+    )
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    s = mod.summarize(d)
+    rank0 = s["ranks"]["0"]
+    assert not any(op.isdigit() for op in rank0)  # no readiness noise
+    assert rank0["ALLREDUCE"]["exec_count"] == 2
+    assert rank0["ALLREDUCE"]["count"] == 2
+    assert rank0["ALLREDUCE"]["total_us"] > 0
+    assert rank0["ALLREDUCE"]["negotiate_us"] > 0
+    assert rank0["BROADCAST"]["count"] == 2
+    assert "ALLREDUCE" in s["cross_rank_skew"]
