@@ -1,0 +1,139 @@
+"""PyTorch synthetic benchmark through the torch binding.
+
+Mirror of the reference harness (reference
+examples/pytorch_synthetic_benchmark.py: hvd.init → model → wrap
+optimizer in hvd.DistributedOptimizer with named_parameters +
+compression → broadcast parameters/optimizer state → timed iters).
+torch is CPU-only on this image and torchvision is absent, so the model
+is a self-contained convnet (``--model resnet18ish`` is a reduced
+basic-block stack); gradients cross processes on the framework's host
+data plane — launch with ``tpurun -np 2`` for the real multi-process
+path.
+
+Run:  python examples/pytorch_synthetic_benchmark.py --num-iters 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="horovod_tpu PyTorch Synthetic Benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("--model", type=str, default="smallconv",
+                        choices=["smallconv", "resnet18ish"])
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--fp16-allreduce", action="store_true",
+                        default=False)
+    parser.add_argument("--num-warmup-batches", type=int, default=2)
+    parser.add_argument("--num-batches-per-iter", type=int, default=3)
+    parser.add_argument("--num-iters", type=int, default=3)
+    return parser.parse_args(argv)
+
+
+def _make_model(name: str, num_classes: int):
+    import torch.nn as nn
+
+    if name == "smallconv":
+        return nn.Sequential(
+            nn.Conv2d(3, 16, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Conv2d(16, 32, 3, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+            nn.Linear(32, num_classes),
+        )
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(cout)
+            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(cout)
+            self.proj = (nn.Conv2d(cin, cout, 1, stride, bias=False)
+                         if (stride != 1 or cin != cout) else nn.Identity())
+            self.relu = nn.ReLU()
+
+        def forward(self, x):
+            y = self.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            return self.relu(y + self.proj(x))
+
+    return nn.Sequential(
+        nn.Conv2d(3, 32, 3, padding=1, bias=False), nn.BatchNorm2d(32),
+        nn.ReLU(),
+        Block(32, 32), Block(32, 64, 2), Block(64, 128, 2),
+        nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+        nn.Linear(128, num_classes),
+    )
+
+
+def run(args) -> dict:
+    import torch
+    import torch.nn.functional as F
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = _make_model(args.model, args.num_classes)
+    opt = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size(),
+                          momentum=0.9)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters(),
+        compression=hvd.Compression.fp16 if args.fp16_allreduce
+        else hvd.Compression.none,
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, args.num_classes, (args.batch_size,))
+
+    def benchmark_step():
+        opt.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+        return float(loss)
+
+    from horovod_tpu import core
+
+    def log(s):
+        if core.process_rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {args.model}  batch {args.batch_size}  "
+        f"procs {core.process_size()}")
+    for _ in range(args.num_warmup_batches):
+        loss = benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            loss = benchmark_step()
+        dt = time.perf_counter() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        log(f"Iter: img/sec per proc: {img_sec:.1f}")
+        img_secs.append(img_sec)
+
+    mean = float(np.mean(img_secs))
+    log(f"Img/sec per proc: {mean:.1f}")
+    return {"img_sec_per_proc": mean, "final_loss": loss}
+
+
+if __name__ == "__main__":
+    run(parse_args())

@@ -4,6 +4,8 @@ MetricAverageCallbackImpl, LearningRateWarmupCallbackImpl)."""
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import tensorflow as tf
 
@@ -54,21 +56,15 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
             )))
 
 
-class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
-    """Linear LR warmup from lr/size to lr over ``warmup_epochs``
-    (reference _keras/callbacks.py:79-135: large-batch training warms up
-    the size-scaled learning rate)."""
+class _LRAdjuster:
+    """Shared LR plumbing for the warmup/schedule callbacks: resolve the
+    optimizer's LR variable across Keras versions, assign it, and (when
+    enabled) rescale SGD momentum accumulators by new_lr/old_lr so the
+    effective velocity tracks the changing LR (reference
+    _keras/callbacks.py momentum restoration)."""
 
-    def __init__(self, warmup_epochs: int = 5, momentum_correction=True,
-                 steps_per_epoch=None, verbose: int = 0):
-        super().__init__()
-        self.warmup_epochs = warmup_epochs
-        self.momentum_correction = momentum_correction
-        self.steps_per_epoch = steps_per_epoch
-        self.verbose = verbose
-        self._initial_lr = None
-        self._epoch = 0
-        self._prev_lr = None
+    momentum_correction = True
+    _prev_lr = None
 
     def _lr_var(self):
         opt = self.model.optimizer
@@ -91,6 +87,75 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
         else:
             tf.keras.backend.set_value(var, value)
 
+    def _apply_lr(self, new_lr: float) -> None:
+        if self.momentum_correction and self._prev_lr not in (None, 0.0):
+            moms = getattr(self.model.optimizer, "momentums", None)
+            if moms:
+                ratio = new_lr / self._prev_lr
+                for m in moms:
+                    m.assign(m * ratio)
+        self._set(self._lr_var(), new_lr)
+        self._prev_lr = new_lr
+
+
+class LearningRateScheduleCallback(_LRAdjuster, tf.keras.callbacks.Callback):
+    """Multiplier schedule over epoch ranges (reference
+    _keras/callbacks.py LearningRateScheduleCallback): within
+    [start_epoch, end_epoch) the LR is initial_lr * multiplier(epoch);
+    ``staircase`` floors the (fractional) epoch, matching the reference's
+    per-batch interpolation toggle."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch=None, staircase: bool = True,
+                 momentum_correction: bool = True, steps_per_epoch=None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.multiplier = (
+            multiplier if callable(multiplier)
+            else (lambda epoch: multiplier)
+        )
+        self._epoch = 0
+
+    def _apply(self, epoch_f: float) -> None:
+        epoch = math.floor(epoch_f) if self.staircase else epoch_f
+        if epoch < self.start_epoch or (
+            self.end_epoch is not None and epoch >= self.end_epoch
+        ):
+            return
+        self._apply_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        if self.staircase:
+            self._apply(float(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase:
+            return
+        steps = self.steps_per_epoch or (self.params or {}).get("steps") or 1
+        self._apply(self._epoch + batch / steps)
+
+
+class LearningRateWarmupCallback(_LRAdjuster, tf.keras.callbacks.Callback):
+    """Linear LR warmup from lr/size to lr over ``warmup_epochs``
+    (reference _keras/callbacks.py:79-135: large-batch training warms up
+    the size-scaled learning rate)."""
+
+    def __init__(self, warmup_epochs: int = 5, momentum_correction=True,
+                 steps_per_epoch=None, verbose: int = 0):
+        super().__init__()
+        self.warmup_epochs = warmup_epochs
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._initial_lr = None
+        self._epoch = 0
+
     def on_train_begin(self, logs=None):
         self._initial_lr = self._get(self._lr_var())
 
@@ -111,20 +176,6 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
         if self.verbose and rank() == 0 and batch == 0:
             print(f"LearningRateWarmup: epoch {self._epoch} "
                   f"lr={self._initial_lr * factor:.6f}")
-
-    def _apply_lr(self, new_lr: float) -> None:
-        """Set the LR; with momentum correction, rescale SGD momentum
-        accumulators by new_lr/old_lr so the effective velocity tracks
-        the changing LR (reference _keras/callbacks.py
-        LearningRateScheduleCallbackImpl momentum restoration)."""
-        if self.momentum_correction and self._prev_lr not in (None, 0.0):
-            moms = getattr(self.model.optimizer, "momentums", None)
-            if moms:
-                ratio = new_lr / self._prev_lr
-                for m in moms:
-                    m.assign(m * ratio)
-        self._set(self._lr_var(), new_lr)
-        self._prev_lr = new_lr
 
     def on_epoch_end(self, epoch, logs=None):
         if epoch == self.warmup_epochs - 1:

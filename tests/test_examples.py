@@ -94,3 +94,13 @@ def test_tf2_keras_mnist_example(mesh8):
     loss = main(["--epochs", "1", "--batch-size", "64"])
     assert np.isfinite(loss)
     assert loss < 2.3   # below chance-level cross-entropy
+
+
+def test_pytorch_synthetic_benchmark_example(mesh8):
+    pytest.importorskip("torch")
+    from examples.pytorch_synthetic_benchmark import parse_args, run
+
+    r = run(parse_args(["--num-iters", "1", "--num-batches-per-iter", "2",
+                        "--num-warmup-batches", "1"]))
+    assert r["img_sec_per_proc"] > 0
+    assert np.isfinite(r["final_loss"])

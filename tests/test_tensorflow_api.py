@@ -169,3 +169,33 @@ def test_distributed_optimizer_double_wrap_raises():
     )
     with pytest.raises(ValueError):
         hvd_tf.DistributedOptimizer(opt)
+
+
+def test_keras_lr_schedule_callback():
+    """Staircase multiplier schedule drives the optimizer LR per epoch
+    (reference _keras/callbacks.py LearningRateScheduleCallback)."""
+    from horovod_tpu.tensorflow import keras as hvd_keras
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(16,)).astype(np.int32)
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2, input_shape=(4,))])
+    model.compile(
+        optimizer=tf.keras.optimizers.SGD(learning_rate=0.1),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+    )
+    seen = []
+
+    class Spy(tf.keras.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            seen.append(float(np.asarray(
+                self.model.optimizer.learning_rate)))
+
+    model.fit(x, y, batch_size=8, epochs=3, verbose=0, callbacks=[
+        hvd_keras.callbacks.LearningRateScheduleCallback(
+            initial_lr=0.1, multiplier=lambda e: 0.1 ** e,
+            momentum_correction=False,
+        ),
+        Spy(),
+    ])
+    np.testing.assert_allclose(seen, [0.1, 0.01, 0.001], rtol=1e-5)
