@@ -1,0 +1,96 @@
+"""GPT-style decoder-only Transformer LM (flax/linen), TPU-first.
+
+The long-context model family: causal attention defaults to the Pallas
+flash kernels (ops/flash_attention.py) on TPU, and any attention override
+— ring or Ulysses sequence parallelism with ``causal=True`` — plugs into
+``attention_fn`` exactly as in the BERT encoder.  The reference ships no
+model code (SURVEY §5); this family exists so the framework's benchmark
+and long-context claims are self-contained.
+
+TPU-first choices: bf16 compute / f32 params; pre-LN; attention and MLP
+as einsums on the MXU; weight-tied LM head (one embedding matrix);
+no Python control flow in the forward pass."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .bert import EncoderLayer
+
+
+def causal_flash_attention_fn(q, k, v, mask):
+    """Default causal core: flash kernels on TPU, interpreter off-TPU
+    (ops/flash_attention.py resolves per mesh platform)."""
+    from ..ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=True)
+
+
+class GPT(nn.Module):
+    """Decoder-only LM over token ids -> logits ``[b, s, vocab]``.
+
+    ``attention_fn(q, k, v, mask)`` must apply causal masking itself
+    (the default does; for sequence parallelism pass e.g.
+    ``lambda q, k, v, m: ring_attention(q, k, v, causal=True,
+    axis="sp")``)."""
+
+    vocab_size: int = 50257
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_fn: Optional[Callable] = None
+    # offset of this shard's first token in the global sequence — nonzero
+    # under sequence parallelism, where position embeddings must be global
+    def position_ids(self, ids, seq_offset):
+        return seq_offset + jnp.arange(ids.shape[-1])[None, :]
+
+    @nn.compact
+    def __call__(self, ids, seq_offset: int = 0):
+        attn = self.attention_fn or causal_flash_attention_fn
+        embed = nn.Embed(self.vocab_size, self.hidden_dim,
+                         param_dtype=self.param_dtype, dtype=self.dtype,
+                         name="wte")
+        x = embed(ids)
+        x = x + nn.Embed(self.max_len, self.hidden_dim,
+                         param_dtype=self.param_dtype, dtype=self.dtype,
+                         name="wpe")(self.position_ids(ids, seq_offset))
+        for _ in range(self.num_layers):
+            x = EncoderLayer(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype, attention_fn=attn,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        # weight-tied LM head: logits = x @ wte^T, f32 for the softmax
+        logits = embed.attend(x.astype(self.param_dtype))
+        return logits.astype(jnp.float32)
+
+
+def gpt2_small(**kw):
+    return GPT(**kw)
+
+
+def gpt_tiny(**kw):
+    """4-layer/128-dim variant for tests and CPU dry-runs."""
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden_dim", 128)
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 256)
+    kw.setdefault("max_len", 512)
+    return GPT(**kw)
+
+
+def next_token_loss(logits, ids):
+    """Shifted cross-entropy: predict ids[t+1] from position t."""
+    logp = nn.log_softmax(logits[:, :-1])
+    tgt = ids[:, 1:]
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+    return -jnp.mean(ll)

@@ -104,3 +104,27 @@ def test_pytorch_synthetic_benchmark_example(mesh8):
                         "--num-warmup-batches", "1"]))
     assert r["img_sec_per_proc"] > 0
     assert np.isfinite(r["final_loss"])
+
+
+def test_gpt_benchmark_causal_flash(mesh8):
+    from examples.gpt_synthetic_benchmark import parse_args, run
+
+    r = run(parse_args([
+        "--model", "tiny", "--batch-size", "2", "--seq-len", "64",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1", "--dtype", "float32",
+    ]))
+    assert np.isfinite(r["final_loss"])
+    assert r["seq_sec_per_chip"] > 0
+
+
+def test_gpt_benchmark_ring_sp(mesh8):
+    from examples.gpt_synthetic_benchmark import parse_args, run
+
+    r = run(parse_args([
+        "--model", "tiny", "--batch-size", "2", "--seq-len", "64",
+        "--seq-parallel", "ring", "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "1", "--num-iters", "1",
+        "--dtype", "float32",
+    ]))
+    assert np.isfinite(r["final_loss"])
