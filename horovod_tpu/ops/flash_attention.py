@@ -535,3 +535,21 @@ def flash_attention(q, k, v, *, causal: bool = False,
     vt = jnp.swapaxes(v, 1, 2)
     o = fn(qt, kt, vt, _offsets(q_offset, kv_offset))
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
+def softmax_attention(q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None):
+    """Plain (materialized) softmax attention in ``[b,s,h,d]`` layout —
+    the XLA-fused reference path the flash kernels are checked against,
+    shared by the Ulysses local step and the benchmarks' --attn xla
+    mode.  XLA fuses the chain; memory is O(s^2)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    sl = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s = q.shape[1]
+        pos = jnp.arange(s)
+        sl = jnp.where((pos[:, None] >= pos[None, :])[None, None], sl,
+                       -jnp.inf)
+    p = jax.nn.softmax(sl, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

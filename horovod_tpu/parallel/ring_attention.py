@@ -290,13 +290,7 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                                 block_q=block_q, block_k=block_k,
                                 interpret=interpret)
     elif impl == "xla":
-        sl = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * scale
-        if causal:
-            pos = jnp.arange(sg)
-            sl = jnp.where((pos[:, None] >= pos[None, :])[None, None], sl,
-                           -jnp.inf)
-        p = jax.nn.softmax(sl, axis=-1).astype(vh.dtype)
-        oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+        oh = fa.softmax_attention(qh, kh, vh, causal=causal, scale=scale)
     else:
         raise ValueError(f"unknown impl {impl!r} (want 'xla' or 'pallas')")
     return to_seq(oh).astype(q.dtype)
