@@ -1,0 +1,119 @@
+"""Tensor parallelism (GSPMD): sharded-parameter MLP under jit on a
+(dp, tp) mesh — forward, gradients, and a training step all match the
+single-device oracle, and the compiled HLO contains the row-parallel
+all-reduce (beyond reference parity: the reference is DP-only,
+SURVEY §2.6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.tensor_parallel import (
+    TP_MLP_RULES, ParallelMLP, shard_tp_params, tp_constraint,
+)
+
+D_IN, HIDDEN, D_OUT = 8, 32, 8
+TP = 4
+
+
+def _mesh():
+    devs = np.array(jax.devices("cpu")[:8]).reshape(2, TP)
+    return Mesh(devs, ("dp", "tp"))
+
+
+@pytest.fixture
+def setup(hvd_init, rng):
+    mesh = _mesh()
+    model = ParallelMLP(hidden=HIDDEN, out=D_OUT, dtype=jnp.float32)
+    x = rng.normal(size=(8, D_IN)).astype(np.float32)
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, D_IN)))[
+            "params"]
+    sharded = shard_tp_params(params, mesh, rules=TP_MLP_RULES)
+    return mesh, model, params, sharded, x
+
+
+def test_tp_forward_matches_oracle(setup):
+    mesh, model, params, sharded, x = setup
+
+    @jax.jit
+    def fwd(p, x):
+        return model.apply({"params": p}, x)
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    out = np.asarray(fwd(sharded, xs))
+    with jax.default_device(jax.devices("cpu")[0]):
+        expected = np.asarray(model.apply({"params": params},
+                                          jnp.asarray(x)))
+    np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
+    # the kernels really are sharded
+    up_sh = fwd.lower(sharded, xs)  # noqa: F841 — compile check below
+    assert sharded["up"]["kernel"].sharding.spec == P(None, "tp")
+    assert sharded["down"]["kernel"].sharding.spec == P("tp", None)
+
+
+def test_tp_row_parallel_inserts_allreduce(setup):
+    """The partitioner must materialize Megatron's g operator: one
+    all-reduce over tp in the forward pass."""
+    mesh, model, params, sharded, x = setup
+
+    def fwd(p, x):
+        return model.apply({"params": p}, x)
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    txt = jax.jit(fwd).lower(sharded, xs).compile().as_text()
+    assert "all-reduce" in txt
+
+
+def test_tp_training_matches_oracle(setup):
+    """Gradients and one SGD step equal the single-device result — the
+    partitioner derives the backward collectives (no hand-written
+    gradient sync)."""
+    mesh, model, params, sharded, x = setup
+    y = np.sin(np.arange(8 * D_OUT, dtype=np.float32)).reshape(8, D_OUT)
+
+    def loss_fn(p, x, y):
+        out = model.apply({"params": p}, x)
+        return jnp.mean((out - y) ** 2)
+
+    @jax.jit
+    def train(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+        return loss, p
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    ys = jax.device_put(y, NamedSharding(mesh, P("dp")))
+    loss, new_p = train(sharded, xs, ys)
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        eloss, eg = jax.value_and_grad(loss_fn)(
+            params, jnp.asarray(x), jnp.asarray(y))
+        expected_p = jax.tree_util.tree_map(
+            lambda a, b: a - 0.1 * b, params, eg)
+
+    np.testing.assert_allclose(float(loss), float(eloss), rtol=1e-5)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(new_p)[0],
+        jax.tree_util.tree_flatten_with_path(expected_p)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(b),
+            rtol=1e-4, atol=1e-5, err_msg=str(pa),
+        )
+
+
+def test_tp_constraint_pins_layout(setup):
+    mesh, model, params, sharded, x = setup
+
+    @jax.jit
+    def fwd(p, x):
+        out = model.apply({"params": p}, x)
+        return tp_constraint(out, mesh, P())
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    out = fwd(sharded, xs)
+    assert out.sharding.is_fully_replicated
