@@ -1,0 +1,95 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+The reference implements data parallelism only (SURVEY §2.6: PP
+"absent") — this completes the TPU build's parallelism layer (dp / tp /
+sp / pp) on the same collective substrate: stages are ranks along a
+``pp`` mesh axis, every tick each rank applies its stage to the resident
+activation and the results rotate one hop over ICI via ``ppermute`` —
+the neighbor-only traffic pattern pipelining was designed for.
+
+Formulation (the "circulating buffer" SPMD pipeline): all stages share
+one activation shape; with S stages and M microbatches the loop runs
+``T = M + S - 1`` ticks.  Rank 0 injects microbatch ``t`` at tick ``t``;
+rank ``S-1`` banks its output for microbatch ``t-(S-1)``; a final psum
+over the pp axis replicates the collected outputs (only the last rank's
+buffer is nonzero).  The schedule is a ``lax.scan`` — compiled control
+flow, no Python loop over ticks — and is differentiable end-to-end
+(``ppermute``'s transpose is the inverse permutation, so gradients
+counter-rotate through the pipeline automatically).
+
+Bubble fraction is the usual (S-1)/(M+S-1); pick M >> S.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _to_varying(x, axis):
+    """Mark ``x`` varying over ``axis`` for the replication checker
+    (pcast on current jax; pvary on older releases)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis,), to="varying")
+    return lax.pvary(x, (axis,))
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_mbs, *,
+                   axis: str = "pp"):
+    """Run ``x_mbs`` microbatches through the S-stage pipeline.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``
+        (one pipeline stage; this rank's slice of the layer stack).
+      stage_params: THIS rank's stage parameters (stack the per-stage
+        pytrees on a leading axis sharded over ``axis`` and index
+        ``[0]`` inside the shard_map, as the tests do).
+      x_mbs: ``[M, microbatch, ...]`` microbatches, replicated across the
+        pp axis (only rank 0 reads them).
+      axis: the pipeline mesh axis.
+
+    Returns ``[M, microbatch, ...]`` outputs, replicated across ``axis``.
+    """
+    s = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = x_mbs.shape[0]
+    ticks = m + s - 1
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        state, outbuf = carry
+        # rank 0 injects microbatch t (clipped reads past the end feed
+        # junk whose pipeline exit lands outside the valid window)
+        inject = _to_varying(x_mbs[jnp.clip(t, 0, m - 1)], axis)
+        inp = jnp.where(idx == 0, inject, state)
+        out = stage_fn(stage_params, inp)
+        pos = t - (s - 1)
+        valid = (idx == s - 1) & (pos >= 0)
+        outbuf = jnp.where(
+            valid, outbuf.at[jnp.clip(pos, 0, m - 1)].set(out), outbuf
+        )
+        state = lax.ppermute(out, axis, perm)
+        return (state, outbuf), None
+
+    # NB: the region must run with replication checking ON
+    # (shard_map(check_vma=True), the default): the final psum's
+    # transpose is then the correct pbroadcast.  Under check_vma=False
+    # the backward pass mis-scales (measured) — hence the explicit
+    # pvary marking on the carries and the injected microbatch.
+    state0 = _to_varying(jnp.zeros_like(x_mbs[0]), axis)
+    outbuf0 = _to_varying(jnp.zeros_like(x_mbs), axis)
+    (_, outbuf), _ = lax.scan(tick, (state0, outbuf0),
+                              jnp.arange(ticks))
+    # only the last rank banked outputs; replicate them
+    return lax.psum(outbuf, axis)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack S per-stage pytrees on a new leading axis (shard it over the
+    pp axis; each rank then indexes ``[0]`` to get its stage)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params
+    )
