@@ -1,0 +1,101 @@
+"""Expert parallelism: mixture-of-experts with all-to-all token dispatch.
+
+The reference implements data parallelism only (SURVEY §2.6: EP
+"absent") — this is the last letter of the TPU build's parallelism layer
+(dp / tp / sp / pp / ep), in the GShard/Mesh-TensorFlow formulation that
+XLA compiles well: static capacity-bounded dispatch tensors (no
+data-dependent shapes), einsum dispatch/combine, and ONE ``all_to_all``
+each way over the ``ep`` mesh axis to move token buffers between the
+ranks that hold the tokens and the ranks that hold the experts.
+
+Layout (inside a shard_map over ``axis``): each rank holds ``n_local``
+tokens and ``experts_per_rank`` experts; E = ep_size *
+experts_per_rank.  Top-1 routing with per-expert capacity C — tokens
+beyond capacity are dropped (standard GShard semantics; size C
+generously for tests).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_dispatch(gates: jnp.ndarray, capacity: int):
+    """Build static dispatch/combine tensors from router probabilities.
+
+    Args:
+      gates: ``[n, E]`` router probabilities (softmax output).
+      capacity: per-expert buffer size C.
+
+    Returns ``(dispatch [n, E, C] bool-ish f32, combine [n, E, C] f32)``:
+    token t goes to slot ``position(t)`` of its argmax expert unless the
+    expert is over capacity; combine carries the gate probability.
+    """
+    n, e = gates.shape
+    expert = jnp.argmax(gates, axis=-1)                     # [n]
+    onehot = jax.nn.one_hot(expert, e, dtype=gates.dtype)   # [n, E]
+    # position of each token within its expert's buffer
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot    # [n, E]
+    pos = jnp.sum(pos, axis=-1).astype(jnp.int32)           # [n]
+    keep = pos < capacity
+    gate = jnp.max(gates * onehot, axis=-1) * keep          # [n]
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [n, C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_apply(expert_fn: Callable, expert_params, x, router_kernel, *,
+              capacity: int, axis: str = "ep"):
+    """One EP MoE layer inside a shard_map over ``axis``.
+
+    Args:
+      expert_fn: ``(params_for_one_expert, tokens [m, d]) -> [m, d]``.
+      expert_params: THIS rank's experts, stacked ``[experts_per_rank,
+        ...]`` (vmapped over).
+      x: this rank's tokens ``[n_local, d]``.
+      router_kernel: ``[d, E]`` routing weights (replicated; E = ep *
+        experts_per_rank).
+      capacity: per-expert, per-source-rank buffer size.
+
+    Returns ``[n_local, d]`` with each token's expert output weighted by
+    its gate (dropped tokens contribute zero, as in GShard top-1).
+    """
+    ep = lax.axis_size(axis)
+    _, d = x.shape
+    e = router_kernel.shape[-1]
+    if e % ep:
+        raise ValueError(f"experts {e} not divisible by ep={ep}")
+    per_rank = e // ep
+
+    gates = jax.nn.softmax(
+        (x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)), axis=-1
+    ).astype(x.dtype)
+    dispatch, combine = top1_dispatch(gates, capacity)
+
+    # gather token buffers per expert: [E, C, d]
+    expert_in = jnp.einsum("nd,nec->ecd", x, dispatch)
+    # reshape to [ep, per_rank, C, d] and all_to_all the ep dim: after
+    # the exchange this rank holds, for ITS experts, every source rank's
+    # buffers: [ep(src), per_rank, C, d]
+    expert_in = expert_in.reshape(ep, per_rank, capacity, d)
+    expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                               concat_axis=0, tiled=False)
+    # run this rank's experts on [src*ep buffers x C] tokens each
+    flat = jnp.moveaxis(expert_in, 1, 0).reshape(
+        per_rank, ep * capacity, d
+    )
+    out = jax.vmap(expert_fn)(expert_params, flat)     # [per_rank, ep*C, d]
+    out = jnp.moveaxis(
+        out.reshape(per_rank, ep, capacity, d), 0, 1
+    )                                                  # [ep, per_rank, C, d]
+    # route back: inverse all_to_all
+    out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                         tiled=False)
+    out = out.reshape(e, capacity, d)
+    # combine on the token side
+    return jnp.einsum("ecd,nec->nd", out, combine.astype(out.dtype))
