@@ -57,6 +57,9 @@ def parse_args(argv=None):
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--dtype", choices=["bfloat16", "float32"],
                    default="bfloat16")
+    p.add_argument("--adasum", action="store_true", default=False,
+                   help="Adasum gradient reduction (BASELINE.json config "
+                        "4: Adasum allreduce on BERT)")
     return p.parse_args(argv)
 
 
@@ -121,7 +124,13 @@ def run(args) -> dict:
     def train_step(params, opt_state, ids_in, ids_tgt, m):
         loss, grads = jax.value_and_grad(loss_fn)(
             params, head, ids_in, ids_tgt, m)
-        grads = allreduce_pytree(grads, op=hvd.Average)
+        if args.adasum:
+            from horovod_tpu.ops import collectives as _coll
+
+            grads = jax.tree_util.tree_map(
+                lambda g: _coll.allreduce(g, op=hvd.Adasum), grads)
+        else:
+            grads = allreduce_pytree(grads, op=hvd.Average)
         from horovod_tpu.ops import collectives
         loss = collectives.allreduce(loss, op=hvd.Average)
         updates, opt_state = opt.update(grads, opt_state, params)

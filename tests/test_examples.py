@@ -128,3 +128,16 @@ def test_gpt_benchmark_ring_sp(mesh8):
         "--dtype", "float32",
     ]))
     assert np.isfinite(r["final_loss"])
+
+
+def test_bert_benchmark_adasum(mesh8):
+    """BASELINE.json config 4: Adasum allreduce on BERT."""
+    from examples.bert_synthetic_benchmark import parse_args, run
+
+    r = run(parse_args([
+        "--model", "tiny", "--batch-size", "2", "--seq-len", "64",
+        "--adasum", "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "1", "--num-iters", "1",
+        "--dtype", "float32",
+    ]))
+    assert np.isfinite(r["final_loss"])
