@@ -1,0 +1,73 @@
+"""Checkpoint/resume helpers.
+
+The reference has no checkpointing in its core — the supported pattern
+is rank-0-writes + broadcast-on-start (SURVEY §5:
+``broadcast_parameters`` / ``broadcast_optimizer_state`` /
+BroadcastGlobalVariablesHook; examples gate ModelCheckpoint on rank 0).
+This module packages that pattern TPU-natively on orbax (the JAX
+checkpoint library: async-capable, works against gs:// paths on pods):
+
+    save_checkpoint(path, state, step=n)          # rank 0 writes
+    state = restore_checkpoint(path, state)       # all load + broadcast
+
+``restore_checkpoint`` finishes with ``broadcast_parameters`` so every
+controller process holds rank-0's bytes even if their filesystem reads
+raced a concurrent save — the reference's broadcast-on-start contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .. import core
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, *, step: Optional[int] = None,
+                    force: bool = True) -> Optional[str]:
+    """Write ``state`` (any pytree of arrays) from the root process only
+    (reference idiom: rank-0-gated ModelCheckpoint).  Returns the
+    written path on the root, None elsewhere."""
+    target = os.path.join(path, f"step_{step}") if step is not None else path
+    if core.is_initialized() and core.process_rank() != 0:
+        return None
+    import jax
+
+    state = jax.device_get(state)  # host copy; orbax owns the layout
+    _checkpointer().save(target, state, force=force)
+    return target
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest ``step_N`` under ``path`` (None if no step dirs)."""
+    try:
+        steps = [int(d[len("step_"):]) for d in os.listdir(path)
+                 if d.startswith("step_")]
+    except FileNotFoundError:
+        return None
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(path: str, like: Any, *, step: Optional[int] = None,
+                       broadcast: bool = True) -> Any:
+    """Load the pytree stored at ``path`` (or its ``step_N`` subdir),
+    then broadcast root's copy to every controller process (the
+    reference's broadcast-on-start resume contract).  ``like`` supplies
+    the tree structure/dtypes."""
+    if step is None:
+        step = latest_step(path)
+    target = os.path.join(path, f"step_{step}") if step is not None else path
+    import jax
+
+    restored = _checkpointer().restore(target, item=jax.device_get(like))
+    if broadcast and core.is_initialized() and core.process_size() > 1:
+        from ..optim.distributed import broadcast_parameters
+
+        restored = broadcast_parameters(restored)
+    return restored

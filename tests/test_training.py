@@ -179,3 +179,32 @@ def test_space_to_depth_stem_equivalent(rng):
         o2 = m2.apply(v, x, train=False)
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_orbax_checkpoint_roundtrip(hvd_init, rng, tmp_path):
+    """save/restore/latest_step through orbax, with the broadcast-on-
+    restore resume contract (reference: rank-0 writes +
+    broadcast_parameters on start)."""
+    pytest.importorskip("orbax.checkpoint")
+    from horovod_tpu.utils.checkpoint import (
+        latest_step, restore_checkpoint, save_checkpoint,
+    )
+
+    state = {
+        "w": rng.normal(size=(4, 4)).astype(np.float32),
+        "step": np.asarray(7, np.int32),
+    }
+    base = str(tmp_path / "ckpt")
+    out = save_checkpoint(base, state, step=7)
+    assert out is not None and out.endswith("step_7")
+    save_checkpoint(base, {**state, "step": np.asarray(9, np.int32)},
+                    step=9)
+    assert latest_step(base) == 9
+
+    like = {"w": np.zeros((4, 4), np.float32),
+            "step": np.asarray(0, np.int32)}
+    restored = restore_checkpoint(base, like)      # latest: step 9
+    assert int(restored["step"]) == 9
+    np.testing.assert_allclose(np.asarray(restored["w"]), state["w"])
+    restored7 = restore_checkpoint(base, like, step=7)
+    assert int(restored7["step"]) == 7
