@@ -70,10 +70,15 @@ struct Request {
 };
 
 // Coordinator verdict for one fused group (reference common/message.h
-// Response: type, tensor_names, error_message, devices).
+// Response: type, tensor_names, error_message, devices).  Each name
+// carries the canonical (dtype, payload bytes) from the first request —
+// so a joined rank can synthesize an identity contribution for a ring
+// transfer it never submitted, and fusion can budget by real bytes.
 struct Response {
   ResponseType type = ResponseType::kAllreduce;
   std::vector<std::string> tensor_names;
+  std::vector<uint8_t> tensor_dtypes;   // parallel to tensor_names
+  std::vector<int64_t> tensor_bytes;    // parallel to tensor_names
   std::string error_message;
 
   void Serialize(std::string* out) const;
@@ -90,6 +95,85 @@ struct ResponseList {
   void Serialize(std::string* out) const;
   static bool Parse(const char* data, size_t len, ResponseList* out);
 };
+
+// -- 16-bit float conversions ----------------------------------------------
+// Software bf16/fp16 ↔ f32 for host-plane reductions (the path the
+// reference keeps in common/half.cc:38-75; no AVX needed at these sizes).
+inline float Bf16ToF32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t F32ToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even, as hardware bf16 casts do
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+inline float Fp16ToF32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(mant & 0x400)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3FF;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (mant << 13);  // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t RneShift(uint32_t mant, uint32_t shift) {
+  // round-to-nearest-even right shift
+  uint32_t h = mant >> shift;
+  uint32_t low = mant & ((1u << shift) - 1);
+  uint32_t half_point = 1u << (shift - 1);
+  if (low > half_point || (low == half_point && (h & 1))) h += 1;
+  return static_cast<uint16_t>(h);
+}
+
+inline uint16_t F32ToFp16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  uint32_t absbits = bits & 0x7FFFFFFFu;
+  if (absbits >= 0x7F800000u) {  // inf / nan
+    uint16_t mant = (absbits & 0x7FFFFF) ? 0x200 : 0;
+    return static_cast<uint16_t>(sign | 0x7C00u | mant);
+  }
+  int32_t exp = static_cast<int32_t>(absbits >> 23) - 127 + 15;
+  uint32_t mant = absbits & 0x7FFFFF;
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00u);  // overflow
+  if (exp <= 0) {                                               // subnormal
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    return static_cast<uint16_t>(
+        sign | RneShift(mant | 0x800000u, static_cast<uint32_t>(14 - exp)));
+  }
+  // normal: mantissa rounding may carry into the exponent — addition makes
+  // the carry correct by construction (a full-mantissa round-up increments
+  // exp; exp 31 becomes inf with zero mantissa)
+  uint32_t h = (static_cast<uint32_t>(exp) << 10) +
+               (static_cast<uint32_t>(RneShift(mant | 0x800000u, 13)) - 0x400u);
+  return static_cast<uint16_t>(sign | h);
+}
 
 // -- little-endian primitive packing ----------------------------------------
 inline void PutU32(std::string* s, uint32_t v) {

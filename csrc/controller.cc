@@ -42,6 +42,7 @@
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <set>
@@ -107,7 +108,10 @@ bool RecvMsg(int fd, uint8_t* type, std::string* payload) {
   if (!ReadFull(fd, hdr, 4)) return false;
   uint32_t len;
   std::memcpy(&len, hdr, 4);
-  if (len == 0 || len > (64u << 20)) return false;
+  // 1 GB frame ceiling: large host-plane payloads are legitimate (the
+  // star is the comparison arm for the ring bench); anything bigger is
+  // a corrupt frame
+  if (len == 0 || len > (1u << 30)) return false;
   std::string buf(len, '\0');
   if (!ReadFull(fd, buf.data(), len)) return false;
   *type = static_cast<uint8_t>(buf[0]);
@@ -121,21 +125,6 @@ bool RecvMsg(int fd, uint8_t* type, std::string* payload) {
 // GlooAllreduce/GlooAllgather/GlooBroadcast) — host-resident tensors (object
 // broadcast, torch CPU tensors, metrics) reduce over the controller's TCP
 // fabric without touching the XLA device plane.
-
-inline float Bf16ToF32(uint16_t v) {
-  uint32_t bits = static_cast<uint32_t>(v) << 16;
-  float f;
-  std::memcpy(&f, &bits, 4);
-  return f;
-}
-
-inline uint16_t F32ToBf16(float f) {
-  uint32_t bits;
-  std::memcpy(&bits, &f, 4);
-  // round-to-nearest-even, as hardware bf16 casts do
-  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-  return static_cast<uint16_t>((bits + rounding) >> 16);
-}
 
 template <typename T>
 void SumInto(std::string* acc, const std::string& src) {
@@ -153,75 +142,48 @@ void SumIntoBf16(std::string* acc, const std::string& src) {
     a[i] = F32ToBf16(Bf16ToF32(a[i]) + Bf16ToF32(b[i]));
 }
 
-// IEEE fp16 ↔ fp32 (the software path the reference keeps in half.cc:38-75;
-// no AVX needed at control-plane sizes).
-inline float Fp16ToF32(uint16_t h) {
-  uint32_t sign = (h & 0x8000u) << 16;
-  uint32_t exp = (h >> 10) & 0x1F;
-  uint32_t mant = h & 0x3FF;
-  uint32_t bits;
-  if (exp == 0) {
-    if (mant == 0) {
-      bits = sign;
-    } else {  // subnormal: normalize
-      int shift = 0;
-      while (!(mant & 0x400)) {
-        mant <<= 1;
-        ++shift;
-      }
-      mant &= 0x3FF;
-      bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
-    }
-  } else if (exp == 31) {
-    bits = sign | 0x7F800000u | (mant << 13);  // inf / nan
-  } else {
-    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
-  }
-  float f;
-  std::memcpy(&f, &bits, 4);
-  return f;
-}
-
-inline uint16_t RneShift(uint32_t mant, uint32_t shift) {
-  // round-to-nearest-even right shift
-  uint32_t h = mant >> shift;
-  uint32_t low = mant & ((1u << shift) - 1);
-  uint32_t half_point = 1u << (shift - 1);
-  if (low > half_point || (low == half_point && (h & 1))) h += 1;
-  return static_cast<uint16_t>(h);
-}
-
-inline uint16_t F32ToFp16(float f) {
-  uint32_t bits;
-  std::memcpy(&bits, &f, 4);
-  uint32_t sign = (bits >> 16) & 0x8000u;
-  uint32_t absbits = bits & 0x7FFFFFFFu;
-  if (absbits >= 0x7F800000u) {  // inf / nan
-    uint16_t mant = (absbits & 0x7FFFFF) ? 0x200 : 0;
-    return static_cast<uint16_t>(sign | 0x7C00u | mant);
-  }
-  int32_t exp = static_cast<int32_t>(absbits >> 23) - 127 + 15;
-  uint32_t mant = absbits & 0x7FFFFF;
-  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00u);  // overflow
-  if (exp <= 0) {                                               // subnormal
-    if (exp < -10) return static_cast<uint16_t>(sign);
-    return static_cast<uint16_t>(
-        sign | RneShift(mant | 0x800000u, static_cast<uint32_t>(14 - exp)));
-  }
-  // normal: mantissa rounding may carry into the exponent — addition makes
-  // the carry correct by construction (a full-mantissa round-up increments
-  // exp; exp 31 becomes inf with zero mantissa)
-  uint32_t h = (static_cast<uint32_t>(exp) << 10) +
-               (static_cast<uint32_t>(RneShift(mant | 0x800000u, 13)) - 0x400u);
-  return static_cast<uint16_t>(sign | h);
-}
-
 void SumIntoFp16(std::string* acc, const std::string& src) {
   uint16_t* a = reinterpret_cast<uint16_t*>(acc->data());
   const uint16_t* b = reinterpret_cast<const uint16_t*>(src.data());
   size_t n = acc->size() / 2;
   for (size_t i = 0; i < n; ++i)
     a[i] = F32ToFp16(Fp16ToF32(a[i]) + Fp16ToF32(b[i]));
+}
+
+template <typename T>
+void MinInto(std::string* acc, const std::string& src) {
+  T* a = reinterpret_cast<T*>(acc->data());
+  const T* b = reinterpret_cast<const T*>(src.data());
+  size_t n = acc->size() / sizeof(T);
+  for (size_t i = 0; i < n; ++i) a[i] = b[i] < a[i] ? b[i] : a[i];
+}
+
+template <typename T>
+void MaxInto(std::string* acc, const std::string& src) {
+  T* a = reinterpret_cast<T*>(acc->data());
+  const T* b = reinterpret_cast<const T*>(src.data());
+  size_t n = acc->size() / sizeof(T);
+  for (size_t i = 0; i < n; ++i) a[i] = b[i] > a[i] ? b[i] : a[i];
+}
+
+void MinMaxBf16(std::string* acc, const std::string& src, bool want_max) {
+  uint16_t* a = reinterpret_cast<uint16_t*>(acc->data());
+  const uint16_t* b = reinterpret_cast<const uint16_t*>(src.data());
+  size_t n = acc->size() / 2;
+  for (size_t i = 0; i < n; ++i) {
+    float fa = Bf16ToF32(a[i]), fb = Bf16ToF32(b[i]);
+    a[i] = (want_max ? fb > fa : fb < fa) ? b[i] : a[i];
+  }
+}
+
+void MinMaxFp16(std::string* acc, const std::string& src, bool want_max) {
+  uint16_t* a = reinterpret_cast<uint16_t*>(acc->data());
+  const uint16_t* b = reinterpret_cast<const uint16_t*>(src.data());
+  size_t n = acc->size() / 2;
+  for (size_t i = 0; i < n; ++i) {
+    float fa = Fp16ToF32(a[i]), fb = Fp16ToF32(b[i]);
+    a[i] = (want_max ? fb > fa : fb < fa) ? b[i] : a[i];
+  }
 }
 
 // dtype codes match horovod_tpu/runtime/controller.py _DTYPES.
@@ -236,6 +198,119 @@ bool SumPayload(uint8_t dtype, std::string* acc, const std::string& src) {
     case 5: SumInto<int64_t>(acc, src); return true;
     default: return false;
   }
+}
+
+// op: false = min, true = max (data-plane codes 6/7; reference keeps
+// these in the MPI op table, mpi_operations.cc — here elementwise C++).
+bool MinMaxPayload(uint8_t dtype, bool want_max, std::string* acc,
+                   const std::string& src) {
+  if (acc->size() != src.size()) return false;
+  switch (dtype) {
+    case 0: want_max ? MaxInto<float>(acc, src) : MinInto<float>(acc, src);
+            return true;
+    case 1: MinMaxBf16(acc, src, want_max); return true;
+    case 2: MinMaxFp16(acc, src, want_max); return true;
+    case 3: want_max ? MaxInto<double>(acc, src) : MinInto<double>(acc, src);
+            return true;
+    case 4: want_max ? MaxInto<int32_t>(acc, src) : MinInto<int32_t>(acc, src);
+            return true;
+    case 5: want_max ? MaxInto<int64_t>(acc, src) : MinInto<int64_t>(acc, src);
+            return true;
+    default: return false;
+  }
+}
+
+// --- host-plane Adasum ------------------------------------------------------
+// The coordinator holds every rank's payload, so VHDD collapses to the
+// XOR-tree pairwise reduction (same pairing order as the device
+// implementation, horovod_tpu/ops/adasum.py numpy_adasum; reference
+// adasum/adasum_mpi.cc).  Accumulation in float64, like the reference's
+// NumPy checker (reference test/test_adasum_pytorch.py:16-32).
+bool PayloadToF64(uint8_t dtype, const std::string& src,
+                  std::vector<double>* out) {
+  size_t esz = (dtype == 1 || dtype == 2) ? 2 : (dtype == 0 || dtype == 4)
+               ? 4 : 8;
+  size_t n = src.size() / esz;
+  out->resize(n);
+  const char* p = src.data();
+  for (size_t i = 0; i < n; ++i) {
+    switch (dtype) {
+      case 0: { float v; std::memcpy(&v, p + 4 * i, 4); (*out)[i] = v; break; }
+      case 1: (*out)[i] = Bf16ToF32(
+                  reinterpret_cast<const uint16_t*>(p)[i]); break;
+      case 2: (*out)[i] = Fp16ToF32(
+                  reinterpret_cast<const uint16_t*>(p)[i]); break;
+      case 3: { double v; std::memcpy(&v, p + 8 * i, 8); (*out)[i] = v; break; }
+      default: return false;  // integer Adasum is undefined
+    }
+  }
+  return true;
+}
+
+void F64ToPayload(uint8_t dtype, const std::vector<double>& v,
+                  std::string* out) {
+  size_t esz = (dtype == 1 || dtype == 2) ? 2 : dtype == 0 ? 4 : 8;
+  out->assign(v.size() * esz, '\0');
+  char* p = out->data();
+  for (size_t i = 0; i < v.size(); ++i) {
+    switch (dtype) {
+      case 0: { float f = static_cast<float>(v[i]);
+                std::memcpy(p + 4 * i, &f, 4); break; }
+      case 1: reinterpret_cast<uint16_t*>(p)[i] =
+                  F32ToBf16(static_cast<float>(v[i])); break;
+      case 2: reinterpret_cast<uint16_t*>(p)[i] =
+                  F32ToFp16(static_cast<float>(v[i])); break;
+      default: std::memcpy(p + 8 * i, &v[i], 8); break;
+    }
+  }
+}
+
+std::vector<double> AdasumPair(const std::vector<double>& a,
+                               const std::vector<double>& b) {
+  double dot = 0, na2 = 0, nb2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    dot += a[i] * b[i];
+    na2 += a[i] * a[i];
+    nb2 += b[i] * b[i];
+  }
+  double ca = na2 == 0 ? 1.0 : 1.0 - dot / (2.0 * na2);
+  double cb = nb2 == 0 ? 1.0 : 1.0 - dot / (2.0 * nb2);
+  std::vector<double> out(a.size());
+  for (size_t i = 0; i < a.size(); ++i) out[i] = ca * a[i] + cb * b[i];
+  return out;
+}
+
+bool AdasumReduce(uint8_t dtype, const std::vector<std::string>& payloads,
+                  std::string* result, std::string* err) {
+  int n = static_cast<int>(payloads.size());
+  if (n & (n - 1)) {
+    *err = "host-plane Adasum requires a power-of-two world size, got " +
+           std::to_string(n);
+    return false;
+  }
+  std::vector<std::vector<double>> vals(n);
+  for (int r = 0; r < n; ++r) {
+    if (!PayloadToF64(dtype, payloads[r], &vals[r])) {
+      *err = "Adasum unsupported for dtype code " + std::to_string(dtype);
+      return false;
+    }
+    if (vals[r].size() != vals[0].size()) {
+      *err = "Adasum payload sizes mismatch across ranks";
+      return false;
+    }
+  }
+  for (int level = 1; level < n; level *= 2) {
+    std::vector<std::vector<double>> nxt(n);
+    for (int r = 0; r < n; ++r) {
+      int p = r ^ level;
+      int lo = (r / level) % 2 == 0 ? r : p;
+      int hi = (r / level) % 2 == 0 ? p : r;
+      nxt[r] = AdasumPair(vals[lo], vals[hi]);
+    }
+    vals = std::move(nxt);
+  }
+  F64ToPayload(dtype, vals[0], result);
+  return true;
 }
 
 std::string MetaKey(const Request& r) {
@@ -434,7 +509,8 @@ class ControllerServer {
     }
     if (d.count >= nranks_) {
       std::string result;
-      bool ok = !d.error && ComputeDataResult(d, &result);
+      std::string compute_err;
+      bool ok = !d.error && ComputeDataResult(d, &result, &compute_err);
       // kDataResult payload: [u8 ok][u32 nlen][name][data-or-error]
       std::string out;
       out.push_back(ok ? 1 : 0);
@@ -444,22 +520,34 @@ class ControllerServer {
         out += result;
       } else if (d.error) {
         out += d.error_message;
+      } else if (!compute_err.empty()) {
+        out += compute_err;
       } else {
         out += std::string("host collective failed: dtype ") +
                std::to_string(d.dtype) +
-               " unsupported for allreduce or payload sizes mismatch "
-               "across ranks";
+               " unsupported for op " + std::to_string(d.op) +
+               " or payload sizes mismatch across ranks";
       }
       for (auto& [fd, r] : clients_) SendMsg(fd, kDataResult, out);
       data_table_.erase(name);
     }
   }
 
-  bool ComputeDataResult(PendingData& d, std::string* result) {
-    if (d.op == 0 || d.op == 4) {  // allreduce / adasum-on-host → sum
+  bool ComputeDataResult(PendingData& d, std::string* result,
+                         std::string* err) {
+    if (d.op == 0) {  // allreduce → elementwise sum
       *result = std::move(d.payloads[0]);
       for (int r = 1; r < nranks_; ++r)
         if (!SumPayload(d.dtype, result, d.payloads[r])) return false;
+      return true;
+    }
+    if (d.op == 4)  // Adasum: real VHDD tree, NOT a sum
+      return AdasumReduce(d.dtype, d.payloads, result, err);
+    if (d.op == 6 || d.op == 7) {  // min / max
+      *result = std::move(d.payloads[0]);
+      for (int r = 1; r < nranks_; ++r)
+        if (!MinMaxPayload(d.dtype, d.op == 7, result, d.payloads[r]))
+          return false;
       return true;
     }
     if (d.op == 1) {  // allgather: [u32 nranks][u32 sizes...][blobs]
@@ -475,6 +563,12 @@ class ControllerServer {
       return true;
     }
     return false;
+  }
+
+  static int64_t RequestBytes(const Request& r) {
+    int64_t n = 1;
+    for (int64_t d : r.shape) n *= d;
+    return n * static_cast<int64_t>(DataTypeSize(r.dtype));
   }
 
   void AddRequest(const Request& r) {
@@ -535,6 +629,8 @@ class ControllerServer {
           cache_[name] = MetaKey(t.first);
         }
         resp.tensor_names.push_back(name);
+        resp.tensor_dtypes.push_back(static_cast<uint8_t>(t.first.dtype));
+        resp.tensor_bytes.push_back(RequestBytes(t.first));
         rl.responses.push_back(std::move(resp));
         done.push_back(name);
       } else if (stall_warn_sec_ > 0 && !t.warned &&
@@ -579,8 +675,13 @@ class ControllerServer {
         Response& last = fused.back();
         if (last.type == r.type &&
             FusedBytes(last) + FusedBytes(r) <= fusion_threshold_) {
-          for (auto& n : r.tensor_names)
-            last.tensor_names.push_back(std::move(n));
+          for (size_t i = 0; i < r.tensor_names.size(); ++i) {
+            last.tensor_names.push_back(std::move(r.tensor_names[i]));
+            last.tensor_dtypes.push_back(
+                i < r.tensor_dtypes.size() ? r.tensor_dtypes[i] : 0);
+            last.tensor_bytes.push_back(
+                i < r.tensor_bytes.size() ? r.tensor_bytes[i] : 0);
+          }
           merged = true;
         }
       }
@@ -589,18 +690,13 @@ class ControllerServer {
     rl->responses = std::move(fused);
   }
 
-  int64_t FusedBytes(const Response& r) {
+  // responses already carry each tensor's canonical byte count
+  // (tensor_bytes, filled in RunCycle from the first request)
+  static int64_t FusedBytes(const Response& r) {
     int64_t total = 0;
-    for (const auto& n : r.tensor_names) {
-      auto it = sizes_.find(n);
-      if (it != sizes_.end()) total += it->second;
-    }
+    for (int64_t b : r.tensor_bytes) total += b;
     return total;
   }
-
- public:
-  // populated by AddRequest via MetaKey bookkeeping
-  std::unordered_map<std::string, int64_t> sizes_;
 
  private:
   int nranks_;
@@ -739,6 +835,39 @@ class ControllerClient {
     return Wait("join", timeout_ms, &err, &group);
   }
 
+  // --- ordered response stream ---------------------------------------------
+  // The coordinator broadcasts identical ResponseLists to every rank, so
+  // consuming responses in arrival order yields the same global op order
+  // on every process — the agreement a blocking peer-ring data plane
+  // needs (reference controller.h:58-99: the response list IS the
+  // execution order for the background thread).  Off by default so
+  // jobs without a ring executor don't accumulate an unread deque.
+  void EnableOrderStream() {
+    std::lock_guard<std::mutex> lk(mu_);
+    order_enabled_ = true;
+  }
+
+  // Pop the next negotiated response (blocking).  Encoding (fields
+  // separated by \x1f, records by \x1e):
+  //   [0] type code, [1] error message (empty unless type==6),
+  //   then one record per tensor: name \x1f dtype \x1f bytes.
+  // Returns 0 = ok, 2 = timeout, 3 = connection lost, 4 = buffer too
+  // small (*needed set; the record stays queued for a retry).
+  int NextNegotiated(double timeout_ms, char* out, size_t cap,
+                     size_t* needed) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool got = cv_.wait_for(
+        lk, std::chrono::milliseconds(static_cast<int64_t>(timeout_ms)),
+        [&] { return !order_.empty() || dead_; });
+    if (!got || order_.empty()) return dead_ ? 3 : 2;
+    const std::string& rec = order_.front();
+    *needed = rec.size();
+    if (!out || cap < rec.size()) return 4;
+    std::memcpy(out, rec.data(), rec.size());
+    order_.pop_front();
+    return 0;
+  }
+
   // Ask the coordinator for its counters.  Returns 0 = OK, 2 = timeout,
   // 3 = connection lost.  Callers are serialized, and replies are counted
   // (FIFO on the single TCP stream, one reply per request) so a late reply
@@ -802,6 +931,25 @@ class ControllerClient {
       ResponseList rl;
       if (!ResponseList::Parse(payload.data(), payload.size(), &rl)) continue;
       std::lock_guard<std::mutex> lk(mu_);
+      if (order_enabled_) {
+        for (const auto& resp : rl.responses) {
+          std::string rec;
+          rec += std::to_string(static_cast<int>(resp.type));
+          rec.push_back('\x1f');
+          rec += resp.error_message;
+          for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+            rec.push_back('\x1e');
+            rec += resp.tensor_names[i];
+            rec.push_back('\x1f');
+            rec += std::to_string(
+                i < resp.tensor_dtypes.size() ? resp.tensor_dtypes[i] : 0);
+            rec.push_back('\x1f');
+            rec += std::to_string(
+                i < resp.tensor_bytes.size() ? resp.tensor_bytes[i] : 0);
+          }
+          order_.push_back(std::move(rec));
+        }
+      }
       for (const auto& resp : rl.responses) {
         std::string group;
         for (const auto& n : resp.tensor_names) {
@@ -834,6 +982,8 @@ class ControllerClient {
       results_;
   // name → (ok, payload-or-error)
   std::unordered_map<std::string, std::pair<bool, std::string>> data_results_;
+  bool order_enabled_ = false;          // guarded by mu_
+  std::deque<std::string> order_;       // encoded negotiated responses
   int64_t stats_[3] = {0, 0, 0};
   std::mutex stats_call_mu_;   // serializes QueryStats callers
   uint64_t stats_sent_ = 0;    // kStatsReq sent (guarded by mu_)
@@ -941,6 +1091,23 @@ int hvd_client_wait_data(void* h, const char* name, double timeout_ms,
       cap > 0 ? static_cast<size_t>(cap) : 0, &n, &err);
   if (out_len) *out_len = static_cast<long long>(n);
   if (err_buf && err_len > 0) std::snprintf(err_buf, err_len, "%s", err.c_str());
+  return rc;
+}
+
+void hvd_client_enable_order_stream(void* h) {
+  static_cast<hvd::ControllerClient*>(h)->EnableOrderStream();
+}
+
+// Pop the next negotiated response in coordinator order.  Returns 0 with
+// the encoded record in out (see ControllerClient::NextNegotiated for the
+// encoding), 2 on timeout, 3 on connection loss, 4 when out is too small
+// (needed size in *out_len; the record stays queued for a retry).
+int hvd_client_next_negotiated(void* h, double timeout_ms, char* out,
+                               long long cap, long long* out_len) {
+  size_t needed = 0;
+  int rc = static_cast<hvd::ControllerClient*>(h)->NextNegotiated(
+      timeout_ms, out, cap > 0 ? static_cast<size_t>(cap) : 0, &needed);
+  if (out_len) *out_len = static_cast<long long>(needed);
   return rc;
 }
 

@@ -31,7 +31,13 @@ bool Request::Parse(const char* data, size_t len, Request* out) {
 void Response::Serialize(std::string* out) const {
   out->push_back(static_cast<char>(type));
   PutU32(out, static_cast<uint32_t>(tensor_names.size()));
-  for (const auto& n : tensor_names) PutStr(out, n);
+  for (size_t i = 0; i < tensor_names.size(); ++i) {
+    PutStr(out, tensor_names[i]);
+    out->push_back(i < tensor_dtypes.size()
+                       ? static_cast<char>(tensor_dtypes[i])
+                       : 0);
+    PutI64(out, i < tensor_bytes.size() ? tensor_bytes[i] : 0);
+  }
   PutStr(out, error_message);
 }
 
@@ -41,8 +47,13 @@ bool Response::Parse(const char* data, size_t len, Response* out,
   out->type = static_cast<ResponseType>(c.U8());
   uint32_t n = c.U32();
   out->tensor_names.clear();
-  for (uint32_t i = 0; i < n && c.ok; ++i)
+  out->tensor_dtypes.clear();
+  out->tensor_bytes.clear();
+  for (uint32_t i = 0; i < n && c.ok; ++i) {
     out->tensor_names.push_back(c.Str());
+    out->tensor_dtypes.push_back(c.U8());
+    out->tensor_bytes.push_back(c.I64());
+  }
   out->error_message = c.Str();
   if (c.ok && consumed) *consumed = len - c.left;
   return c.ok;

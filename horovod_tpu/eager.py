@@ -284,15 +284,32 @@ def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
     ]
 
 
+# payloads at or above this ride the peer ring (flat per-rank wire volume,
+# csrc/ring.cc); below it the coordinator star wins on latency (1 RTT vs
+# the ring's negotiate + 2(n-1) hops).
+_RING_MIN_BYTES = 1 << 15
+
+_WIRE_OPS = {Average: "allreduce", Sum: "allreduce", Min: "min",
+             Max: "max", Adasum: "adasum"}
+
+
 def process_allreduce(arr, *, op: str = Average,
                       name: Optional[str] = None) -> np.ndarray:
     """Reduce one numpy array per controller process (host plane).
 
-    The torch/TF bindings' cross-process reduction: over the native data
-    plane when available (true elementwise sum in C++, the Gloo-CPU-ops
-    analog), falling back to the pickle allgather on jax.distributed pods.
+    The torch/TF/MXNet bindings' cross-process reduction.  Transport
+    selection (native-controller jobs): large payloads ride the peer
+    ring (csrc/ring.cc — the Gloo-ring analog, reference
+    gloo_operations.cc:120-158) under coordinator ordering; small ones
+    and Adasum (VHDD tree at the coordinator, csrc/controller.cc
+    AdasumReduce) use the star.  jax.distributed pods without the native
+    plane fall back to the pickle allgather.  All five reference ops
+    (Average/Sum/Adasum/Min/Max, reference torch/mpi_ops.py:103-119)
+    keep their real semantics on every path.
     """
     arr = np.asarray(arr)
+    if op not in _WIRE_OPS:
+        raise ValueError(f"unknown reduction op {op!r}")
     if core.process_size() == 1:
         return arr
     c = eager_controller.client()
@@ -301,14 +318,31 @@ def process_allreduce(arr, *, op: str = Average,
         wire = arr if str(arr.dtype) in (
             "float32", "float64", "int32", "int64", "bfloat16", "float16"
         ) else arr.astype(np.float32)
-        out = c.allreduce_data(nm, wire)
+        wire_op = _WIRE_OPS[op]
+        rx = eager_controller.ring()
+        if (rx is not None and wire_op in ("allreduce", "min", "max")
+                and wire.nbytes >= _RING_MIN_BYTES):
+            out = rx.allreduce(nm, np.array(wire, copy=True), op=wire_op)
+        else:
+            out = c.allreduce_data(nm, wire, op=wire_op)
         if op == Average:
             out = out / core.process_size()
         return out.astype(arr.dtype) if out.dtype != arr.dtype else out
     gathered = allgather_object(arr, name=name)
-    stacked = np.stack(gathered)
-    return stacked.mean(0).astype(arr.dtype) if op == Average \
-        else stacked.sum(0).astype(arr.dtype)
+    stacked = np.stack([np.asarray(g) for g in gathered])
+    if op == Average:
+        out = stacked.mean(0)
+    elif op == Sum:
+        out = stacked.sum(0)
+    elif op == Min:
+        out = stacked.min(0)
+    elif op == Max:
+        out = stacked.max(0)
+    else:  # Adasum
+        from .ops.adasum import numpy_adasum
+
+        out = numpy_adasum(list(stacked))
+    return out.astype(arr.dtype)
 
 
 def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
@@ -327,11 +361,41 @@ def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
 def process_broadcast(arr, root_rank: int = 0, *,
                       name: Optional[str] = None) -> np.ndarray:
     """Root process's numpy array on every process (single-process:
-    identity) — the bindings' shared broadcast bridge."""
+    identity) — the bindings' shared broadcast bridge.  Non-root values
+    are ignored, as before.  Large tensors ride the pipelined ring
+    broadcast (csrc/ring.cc Broadcast, O(payload) per link): a tiny
+    pickled metadata broadcast ships ROOT's (shape, dtype, nbytes) first,
+    so every rank makes the same transport choice and lays out its
+    receive buffer in root's type — local placeholder values can't
+    diverge the ranks.  Small ones pickle through the coordinator."""
     arr = np.asarray(arr)
     if core.process_size() == 1:
         return arr
-    return np.asarray(broadcast_object(arr, root_rank=root_rank, name=name))
+    rx = eager_controller.ring()
+    if rx is None:
+        return np.asarray(
+            broadcast_object(arr, root_rank=root_rank, name=name)
+        )
+    nm = name or eager_controller.next_name("process_broadcast")
+    shape, dtype_s, nbytes = broadcast_object(
+        (arr.shape, str(arr.dtype), arr.nbytes),
+        root_rank=root_rank, name=f"{nm}.meta",
+    )
+    if nbytes < _RING_MIN_BYTES:
+        return np.asarray(
+            broadcast_object(arr, root_rank=root_rank, name=nm)
+        )
+    if core.process_rank() == root_rank:
+        buf = np.array(arr, copy=True)
+    else:
+        if dtype_s == "bfloat16":  # not a plain-numpy dtype name
+            import ml_dtypes
+
+            dt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dt = np.dtype(dtype_s)
+        buf = np.zeros(shape, dt)
+    return rx.broadcast(nm, buf, root_rank)
 
 
 def normalize_op(average, op):

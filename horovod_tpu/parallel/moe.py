@@ -37,11 +37,13 @@ def top1_dispatch(gates: jnp.ndarray, capacity: int):
     """
     n, e = gates.shape
     expert = jnp.argmax(gates, axis=-1)                     # [n]
-    onehot = jax.nn.one_hot(expert, e, dtype=gates.dtype)   # [n, E]
-    # position of each token within its expert's buffer
-    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot    # [n, E]
-    pos = jnp.sum(pos, axis=-1).astype(jnp.int32)           # [n]
+    # Buffer positions are computed in int32: a low-precision cumsum
+    # (e.g. bf16 gates) saturates at 256 tokens and collides slots.
+    onehot_i = jax.nn.one_hot(expert, e, dtype=jnp.int32)   # [n, E]
+    pos = (jnp.cumsum(onehot_i, axis=0) - onehot_i) * onehot_i  # [n, E]
+    pos = jnp.sum(pos, axis=-1)                             # [n] int32
     keep = pos < capacity
+    onehot = onehot_i.astype(gates.dtype)                   # [n, E]
     gate = jnp.max(gates * onehot, axis=-1) * keep          # [n]
     pos_oh = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [n, C]
     dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]

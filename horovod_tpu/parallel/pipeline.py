@@ -22,6 +22,7 @@ Bubble fraction is the usual (S-1)/(M+S-1); pick M >> S.
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable
 
 import jax
@@ -35,6 +36,22 @@ def _to_varying(x, axis):
     if hasattr(lax, "pcast"):
         return lax.pcast(x, (axis,), to="varying")
     return lax.pvary(x, (axis,))
+
+
+def _vma_state(x, axis) -> str:
+    """'on' when the replication checker recorded ``x`` as varying over
+    ``axis`` (shard_map check_vma=True), 'off' when the checker is
+    demonstrably disabled, 'unknown' when this JAX can't tell (no false
+    alarms in that case)."""
+    if not hasattr(jax, "typeof"):
+        return "unknown"
+    try:
+        vma = getattr(jax.typeof(x), "vma", None)
+    except Exception:
+        return "unknown"
+    if vma is None:
+        return "unknown"
+    return "on" if axis in vma else "off"
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_mbs, *,
@@ -52,6 +69,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_mbs, *,
       axis: the pipeline mesh axis.
 
     Returns ``[M, microbatch, ...]`` outputs, replicated across ``axis``.
+
+    .. warning:: The enclosing ``shard_map`` MUST run with
+       ``check_vma=True`` (the default).  Under ``check_vma=False`` the
+       final psum's transpose is not rewritten to a pbroadcast and the
+       backward pass mis-scales gradients by the pipeline size — a
+       warning is emitted when the checker is detected off, but the
+       forward values are identical, so there is no runtime error.
     """
     s = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -80,6 +104,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_mbs, *,
     # the backward pass mis-scales (measured) — hence the explicit
     # pvary marking on the carries and the injected microbatch.
     state0 = _to_varying(jnp.zeros_like(x_mbs[0]), axis)
+    if _vma_state(state0, axis) == "off":
+        warnings.warn(
+            "pipeline_apply requires shard_map(check_vma=True): the "
+            "replication checker is off in this trace, so gradients "
+            "through the pipeline will be mis-scaled by the stage count",
+            stacklevel=2,
+        )
     outbuf0 = _to_varying(jnp.zeros_like(x_mbs), axis)
     (_, outbuf), _ = lax.scan(tick, (state0, outbuf0),
                               jnp.arange(ticks))

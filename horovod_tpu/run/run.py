@@ -460,7 +460,18 @@ def run(fn, args=(), kwargs=None, np: int = 1,
             ))
         rcs = [p.wait() for p in procs]
         if any(rcs):
-            raise RuntimeError(f"function-mode workers failed: {rcs}")
+            # surface the tracebacks the workers published before exiting
+            errors = []
+            for pid in range(np):
+                blob = server.get("result", str(pid))
+                if blob is not None:
+                    payload = pickle.loads(blob)
+                    if payload.get("error"):
+                        errors.append(f"[worker {pid}] {payload['error']}")
+            raise RuntimeError(
+                "function-mode workers failed: rcs=%s\n%s"
+                % (rcs, "\n".join(errors))
+            )
         results = []
         for pid in range(np):
             blob = server.get("result", str(pid))

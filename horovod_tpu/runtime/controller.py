@@ -24,6 +24,10 @@ REQUEST_TYPES = {
     "allreduce": 0, "allgather": 1, "broadcast": 2, "join": 3,
     "adasum": 4, "alltoall": 5,
 }
+# Host data-plane op codes (the kData op byte, csrc/controller.cc
+# HandleData/ComputeDataResult): negotiation types plus elementwise
+# min/max, which have no negotiation RequestType of their own.
+DATA_OPS = dict(REQUEST_TYPES, min=6, max=7)
 _DTYPES = {
     "float32": 0, "bfloat16": 1, "float16": 2, "float64": 3,
     "int32": 4, "int64": 5, "uint8": 6, "bool": 7,
@@ -135,7 +139,7 @@ class ControllerClient:
         """Send this rank's payload for the host data plane (the Gloo-CPU-ops
         analog living in the coordinator, csrc/controller.cc HandleData)."""
         rc = self._lib.hvd_client_submit_data(
-            self._h, name.encode(), REQUEST_TYPES[op], _dtype_code(dtype),
+            self._h, name.encode(), DATA_OPS[op], _dtype_code(dtype),
             root_rank, payload, len(payload),
         )
         if rc != 0:
@@ -166,16 +170,18 @@ class ControllerClient:
         raise ConnectionError("controller connection lost")
 
     def allreduce_data(self, name: str, arr: "np.ndarray",
-                       timeout: float = 60.0) -> "np.ndarray":
-        """Sum ``arr`` elementwise across all ranks on the coordinator.
-        Caller divides for Average (the reference's divisor trick,
-        torch/mpi_ops.py:94-129)."""
+                       timeout: float = 60.0,
+                       op: str = "allreduce") -> "np.ndarray":
+        """Reduce ``arr`` elementwise across all ranks on the coordinator.
+        ``op``: allreduce (sum), min, max, or adasum (real VHDD tree,
+        csrc/controller.cc AdasumReduce).  Caller divides for Average
+        (the reference's divisor trick, torch/mpi_ops.py:94-129)."""
         arr = np.ascontiguousarray(arr)
         dtype = str(arr.dtype)
         if dtype not in ("float32", "float64", "int32", "int64",
                          "bfloat16", "float16"):
             raise TypeError(f"host allreduce unsupported for dtype {dtype}")
-        self.submit_data(name, arr.tobytes(), op="allreduce", dtype=dtype)
+        self.submit_data(name, arr.tobytes(), op=op, dtype=dtype)
         out = self.wait_data(name, timeout=timeout)
         return np.frombuffer(out, arr.dtype).reshape(arr.shape).copy()
 
@@ -199,6 +205,40 @@ class ControllerClient:
                        timeout: float = 60.0) -> bytes:
         self.submit_data(name, payload, op="broadcast", root_rank=root_rank)
         return self.wait_data(name, timeout=timeout)
+
+    def enable_order_stream(self) -> None:
+        """Start recording negotiated responses in coordinator order (the
+        execution order the ring executor follows — reference
+        controller.h:58-99: the response list IS the execution order)."""
+        self._lib.hvd_client_enable_order_stream(self._h)
+
+    def next_negotiated(self, timeout: float = 60.0):
+        """Pop the next negotiated response: ``(type_code, error_message,
+        [(name, dtype_code, nbytes), ...])`` in coordinator-broadcast
+        order — identical on every rank.  Raises TimeoutError /
+        ConnectionError."""
+        n = ctypes.c_longlong(0)
+        buf = ctypes.create_string_buffer(1 << 16)
+        rc = self._lib.hvd_client_next_negotiated(
+            self._h, timeout * 1000.0, buf, len(buf), ctypes.byref(n),
+        )
+        if rc == 4:  # huge fused group: retry with the exact size
+            buf = ctypes.create_string_buffer(int(n.value))
+            rc = self._lib.hvd_client_next_negotiated(
+                self._h, timeout * 1000.0, buf, len(buf), ctypes.byref(n),
+            )
+        if rc == 2:
+            raise TimeoutError("no negotiated response within timeout")
+        if rc != 0:
+            raise ConnectionError("controller connection lost")
+        raw = buf.raw[: int(n.value)].decode()
+        records = raw.split("\x1e")
+        type_s, _, err = records[0].partition("\x1f")
+        tensors = []
+        for rec in records[1:]:
+            name, dtype_s, bytes_s = rec.split("\x1f")
+            tensors.append((name, int(dtype_s), int(bytes_s)))
+        return int(type_s), err, tensors
 
     def stats(self, timeout: float = 10.0) -> dict:
         """Query the coordinator's counters over the wire — lets any rank

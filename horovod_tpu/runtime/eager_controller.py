@@ -28,12 +28,13 @@ log = get_logger(__name__)
 
 _server = None
 _client = None
+_ring_exec = None
 
 
 def setup_from_env(process_id: int, num_processes: int) -> None:
     """Called from hvd.init().  No-op unless HVD_CONTROLLER=native and the
     job spans multiple controller processes."""
-    global _server, _client
+    global _server, _client, _ring_exec
     if _client is not None or num_processes <= 1:
         return
     if env_util.get_str(env_util.HVD_CONTROLLER) != "native":
@@ -58,8 +59,16 @@ def setup_from_env(process_id: int, num_processes: int) -> None:
         _server = ControllerServer(num_processes, port=port)
     _client = ControllerClient(host, port, process_id)
     atexit.register(shutdown)
-    log.info("eager controller active: %s (process %d/%d)",
-             addr, process_id, num_processes)
+    # Peer ring for large host payloads (HVD_RING=0 keeps everything on
+    # the coordinator star — debugging aid).
+    if env_util.get_int("HVD_RING", 1):
+        from . import ring as ring_mod
+
+        # establish() degrades collectively: it returns None on EVERY
+        # rank when any link failed, so no rank is left ringing alone
+        _ring_exec = ring_mod.establish(_client, process_id, num_processes)
+    log.info("eager controller active: %s (process %d/%d, ring=%s)",
+             addr, process_id, num_processes, _ring_exec is not None)
 
 
 def active() -> bool:
@@ -72,6 +81,12 @@ def client():
     broadcast_data (csrc/controller.cc HandleData — the Gloo-CPU-ops
     analog, reference horovod/common/ops/gloo_operations.cc)."""
     return _client
+
+
+def ring():
+    """The process's RingExecutor (None when the peer ring is down) — the
+    scalable path for large host payloads (csrc/ring.cc)."""
+    return _ring_exec
 
 
 _seq = 0
@@ -124,7 +139,10 @@ def server_stats() -> Optional[dict]:
 
 
 def shutdown() -> None:
-    global _server, _client
+    global _server, _client, _ring_exec
+    if _ring_exec is not None:
+        _ring_exec.close()  # joins the dispatcher, then frees the ring
+        _ring_exec = None
     if _client is not None:
         _client.close()
         _client = None

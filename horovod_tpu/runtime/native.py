@@ -122,6 +122,36 @@ def load() -> ctypes.CDLL:
         ]
         lib.hvd_client_close.restype = None
         lib.hvd_client_close.argtypes = [ctypes.c_void_p]
+        lib.hvd_client_enable_order_stream.restype = None
+        lib.hvd_client_enable_order_stream.argtypes = [ctypes.c_void_p]
+        lib.hvd_client_next_negotiated.restype = ctypes.c_int
+        lib.hvd_client_next_negotiated.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p,
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong),
+        ]
+
+        # peer ring data plane
+        lib.hvd_ring_create.restype = ctypes.c_void_p
+        lib.hvd_ring_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+        ]
+        lib.hvd_ring_port.restype = ctypes.c_int
+        lib.hvd_ring_port.argtypes = [ctypes.c_void_p]
+        lib.hvd_ring_connect.restype = ctypes.c_int
+        lib.hvd_ring_connect.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_double,
+        ]
+        lib.hvd_ring_allreduce.restype = ctypes.c_int
+        lib.hvd_ring_allreduce.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.hvd_ring_broadcast.restype = ctypes.c_int
+        lib.hvd_ring_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+        ]
+        lib.hvd_ring_close.restype = None
+        lib.hvd_ring_close.argtypes = [ctypes.c_void_p]
 
         # autotuner
         lib.hvd_tuner_create.restype = ctypes.c_void_p

@@ -44,7 +44,16 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
 
     server = None
     addr = None
-    if native.available() and n > 1:
+    if n > 1:
+        if not native.available():
+            # Without the native transport a >1-process gang would init
+            # with no way to communicate — its host collectives would
+            # hang or return single-process answers.  Fail fast instead.
+            raise RuntimeError(
+                "horovod_tpu.spark.run(num_proc=%d) needs the native "
+                "controller extension (csrc build failed or was "
+                "disabled); rebuild it or pass num_proc=1" % n
+            )
         server = ControllerServer(n, port=0)
         host = socket.getfqdn()
         addr = f"{host}:{server.port}"

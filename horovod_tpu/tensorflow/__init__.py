@@ -118,14 +118,10 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
 
-    if op not in (Average, Sum):
-        # the process-plane transport only implements Sum (+ divide);
-        # loud error beats a silent sum (Min/Max/Adasum live on the
-        # compiled JAX path, horovod_tpu.allreduce)
-        raise NotImplementedError(
-            f"op {op!r} is not supported by the TF binding's transport; "
-            "use op=Sum or op=Average"
-        )
+    # All five reference ops have real host-plane semantics now:
+    # Min/Max elementwise and Adasum's VHDD tree run in the native data
+    # plane (csrc/controller.cc MinMaxPayload/AdasumReduce, csrc/ring.cc)
+    # — eager.process_allreduce routes and validates.
     comp, ctx = compression.compress(tensor)
     nm = name or eager_controller.next_name("allreduce.tf")
     out = _run(lambda a: _allreduce_np(a, op, nm), comp, comp.shape)
@@ -240,8 +236,26 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
             self, list(zip(grads, [v for _, v in gv])), *args, **kwargs
         )
 
+    def apply_gradients_adasum(self, grads_and_vars, *args, **kwargs):
+        # Delta-Adasum (reference tensorflow/__init__.py:321-415
+        # _DistributedAdasumOptimizer): snapshot → local step → Adasum
+        # the parameter deltas → rebase.  Reducing the *update* keeps
+        # stateful-optimizer slots consistent with what was applied.
+        gv = list(grads_and_vars)
+        variables = [v for _, v in gv]
+        starts = [tf.identity(v) for v in variables]
+        result = base.apply_gradients(self, gv, *args, **kwargs)
+        for i, (v, s) in enumerate(zip(variables, starts)):
+            reduced = allreduce(
+                v - s, op=Adasum, compression=compression,
+                name=f"adasum.delta.{i}",
+            )
+            v.assign(s + tf.cast(reduced, v.dtype))
+        return result
+
     cls = type(base.__name__, (base,), {
-        "apply_gradients": apply_gradients,
+        "apply_gradients": apply_gradients_adasum if op == Adasum
+        else apply_gradients,
         "_hvd_distributed": True,
     })
     return cls.from_config(optimizer.get_config())

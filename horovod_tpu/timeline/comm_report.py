@@ -41,6 +41,10 @@ _DTYPE_BYTES = {
 
 # instruction result: one or more "dtype[d0,d1]{layout}" entries
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# layout annotation directly after a dims bracket: TPU optimized HLO
+# writes tiled layouts like "f32[128,256]{1,0:T(8,128)}" whose parens
+# would abort _INSTR_RE's shape branch — strip them before matching.
+_LAYOUT_RE = re.compile(r"(\])\{[^{}]*\}")
 # shape group allows one level of tuple nesting: multi-operand async
 # starts have shapes like ((f32[...], f32[...]), (f32[...], f32[...]), ...)
 _INSTR_RE = re.compile(
@@ -104,6 +108,7 @@ def hlo_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
     HLO text (``-done`` halves of async pairs are skipped; ``-start``
     tuple shapes count their payload once)."""
     out: Dict[str, Dict[str, int]] = {}
+    hlo_text = _LAYOUT_RE.sub(r"\1", hlo_text)
     for m in _INSTR_RE.finditer(hlo_text):
         shapes, op, is_start = m.group(1), m.group(2), bool(m.group(3))
         d = out.setdefault(op, {"count": 0, "bytes": 0})

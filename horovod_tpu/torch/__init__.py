@@ -316,9 +316,77 @@ class _DistributedOptimizer:
         return None
 
 
+class _DistributedAdasumOptimizer:
+    """Adasum applied to parameter *deltas*, not gradients (reference
+    torch/__init__.py:219-387 _DistributedAdasumOptimizer): each step
+    snapshots the parameters, lets the wrapped optimizer take its local
+    step, Adasum-reduces ``delta = p_after - start`` across ranks, and
+    rebases ``p = start + reduced_delta``.  This is the semantically
+    correct Adasum composition with stateful optimizers (momentum/Adam):
+    the *update direction* is reduced, so per-rank optimizer state stays
+    consistent with what was actually applied.
+
+    Deltas reduce asynchronously on the handle pool (one per parameter,
+    program-order names) and join before the rebase — the TPU-era stand-in
+    for the reference's per-hook overlap."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1):
+        self._opt = optimizer
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        self._counter = 0
+        self._param_names = {}
+        if named_parameters is not None:
+            for n, p in named_parameters:
+                self._param_names[id(p)] = n
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+    def synchronize(self) -> None:
+        # deltas only exist after the local step; nothing to pre-join
+        # (reference's synchronize() is likewise a no-op, :352)
+        pass
+
+    def step(self, closure=None):
+        self._counter += 1
+        if self._counter % self.backward_passes_per_step != 0:
+            return None  # accumulate grads locally, like the grad path
+
+        params = [p for g in self._opt.param_groups for p in g["params"]
+                  if getattr(p, "grad", None) is not None]
+        starts = {id(p): p.detach().clone() for p in params}
+        loss = self._opt.step(closure)
+
+        handles = []
+        for i, p in enumerate(params):
+            delta = p.detach() - starts[id(p)]
+            nm = self._param_names.get(id(p), f"param.{i}")
+            handles.append((p, allreduce_async(
+                delta, op=Adasum, name=f"adasum.delta.{nm}",
+                compression=self._compression,
+            )))
+        for p, h in handles:
+            reduced = _handles.wait(h)  # torch tensor (allreduce_async)
+            p.data.copy_(starts[id(p)] + reduced.to(p.dtype))
+        return loss
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1, op=Average):
+    """op=Adasum returns the delta-optimizer (reference
+    torch/__init__.py:389-414 dispatches the same way)."""
+    if op == Adasum:
+        return _DistributedAdasumOptimizer(
+            optimizer, named_parameters, compression,
+            backward_passes_per_step,
+        )
     return _DistributedOptimizer(
         optimizer, named_parameters, compression,
         backward_passes_per_step, op,

@@ -45,12 +45,25 @@ def save_checkpoint(path: str, state: Any, *, step: Optional[int] = None,
 
 
 def latest_step(path: str) -> Optional[int]:
-    """Largest ``step_N`` under ``path`` (None if no step dirs)."""
+    """Largest ``step_N`` under ``path`` (None if no step dirs).
+
+    Lists through fsspec so remote stores (gs://, memory://) work the
+    same as local directories — ``os.listdir`` would raise on URLs and
+    make restore silently target the run root."""
     try:
-        steps = [int(d[len("step_"):]) for d in os.listdir(path)
-                 if d.startswith("step_")]
-    except FileNotFoundError:
+        import fsspec
+
+        fs, root = fsspec.core.url_to_fs(path)
+        names = [os.path.basename(p.rstrip("/")) for p in fs.ls(root)]
+    except ImportError:
+        try:
+            names = os.listdir(path)
+        except FileNotFoundError:
+            return None
+    except (FileNotFoundError, OSError):
         return None
+    steps = [int(d[len("step_"):]) for d in names
+             if d.startswith("step_") and d[len("step_"):].isdigit()]
     return max(steps) if steps else None
 
 
@@ -59,15 +72,51 @@ def restore_checkpoint(path: str, like: Any, *, step: Optional[int] = None,
     """Load the pytree stored at ``path`` (or its ``step_N`` subdir),
     then broadcast root's copy to every controller process (the
     reference's broadcast-on-start resume contract).  ``like`` supplies
-    the tree structure/dtypes."""
-    if step is None:
-        step = latest_step(path)
-    target = os.path.join(path, f"step_{step}") if step is not None else path
+    the tree structure/dtypes.
+
+    Multi-host: only rank 0 is required to see ``path`` — when a
+    non-root read fails (no shared filesystem), root's restored tree is
+    shipped whole via ``broadcast_object``; when every rank can read,
+    the broadcast is the cheaper array-plane ``broadcast_parameters``."""
     import jax
 
-    restored = _checkpointer().restore(target, item=jax.device_get(like))
-    if broadcast and core.is_initialized() and core.process_size() > 1:
-        from ..optim.distributed import broadcast_parameters
+    multi = core.is_initialized() and core.process_size() > 1
+    if step is None:
+        step = latest_step(path)
+        if multi:  # rank-consistent choice even if only root sees the dir
+            from .. import eager
 
-        restored = broadcast_parameters(restored)
+            step = eager.broadcast_object(step)
+    target = os.path.join(path, f"step_{step}") if step is not None else path
+
+    err: Optional[Exception] = None
+    restored = None
+    try:
+        restored = _checkpointer().restore(target, item=jax.device_get(like))
+    except Exception as e:  # noqa: BLE001
+        if not (multi and broadcast):
+            raise
+        err = e  # held until the agreement round, so no rank is stranded
+
+    if broadcast and multi:
+        from .. import eager
+
+        # Every rank must pick the SAME collective, and a root failure
+        # must surface on every rank (raising before the agreement would
+        # leave the others blocked until timeout with no root cause).
+        statuses = eager.allgather_object(
+            None if restored is not None else repr(err)
+        )
+        if statuses[0] is not None:
+            raise RuntimeError(
+                f"rank 0 failed to restore {target!r}: {statuses[0]}"
+            )
+        if all(s is None for s in statuses):
+            from ..optim.distributed import broadcast_parameters
+
+            restored = broadcast_parameters(restored)
+        else:
+            restored = eager.broadcast_object(restored)
+    elif err is not None:
+        raise err
     return restored

@@ -1,0 +1,138 @@
+"""Minimal in-repo stand-in for ``mxnet`` so the adapter logic in
+horovod_tpu/mxnet/__init__.py executes on every CI pass (the real
+framework is not on this image; the reference exercises its binding with
+584 LoC of tests, reference test/test_mxnet.py — zero-execution modules
+are dead weight).
+
+Only the surface the binding touches exists: ``mx.nd.array``/``ones``
+(NDArray with asnumpy / as_in_context / slice-assign), ``gluon.Trainer``
+with ``_params``/``_allreduce_grads``, ``gluon.parameter.Parameter`` with
+``data()``/``list_grad()``/``grad_req``, and
+``DeferredInitializationError``.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, arr, dtype=None):
+        self._a = np.array(arr, dtype=dtype if dtype is not None
+                           else np.float32)
+
+    def asnumpy(self) -> np.ndarray:
+        return self._a.copy()
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def context(self):
+        return "cpu(0)"
+
+    def as_in_context(self, ctx):
+        return self
+
+    def __setitem__(self, key, value):
+        self._a[key] = value._a if isinstance(value, NDArray) else value
+
+    def __repr__(self):
+        return f"FakeNDArray({self._a!r})"
+
+
+def _array(arr, dtype=None, ctx=None):
+    return NDArray(arr, dtype=dtype)
+
+
+def _ones(shape, dtype=None):
+    return NDArray(np.ones(shape), dtype=dtype)
+
+
+def _zeros(shape, dtype=None):
+    return NDArray(np.zeros(shape), dtype=dtype)
+
+
+class DeferredInitializationError(Exception):
+    pass
+
+
+class Parameter:
+    """Gluon parameter: data/grad pair (reference mxnet gluon surface)."""
+
+    def __init__(self, name, arr, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._data = NDArray(arr)
+        self._grad = NDArray(np.zeros_like(np.asarray(arr, np.float32)))
+
+    def data(self):
+        return self._data
+
+    def grad(self):
+        return self._grad
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class Trainer:
+    """Just enough of gluon.Trainer for DistributedTrainer: holds
+    ``_params`` and steps them with plain SGD after
+    ``_allreduce_grads``."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None, **kwargs):
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = list(params)
+        self._optimizer = optimizer
+        self._lr = float((optimizer_params or {}).get("learning_rate", 0.1))
+
+    def _allreduce_grads(self):  # overridden by DistributedTrainer
+        pass
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        self._allreduce_grads()
+        for p in self._params:
+            if p.grad_req != "null":
+                p._data._a -= self._lr * p._grad._a / batch_size
+
+
+def install() -> types.ModuleType:
+    """Register the fake under ``sys.modules['mxnet']`` (plus the gluon
+    submodules the binding imports) and return it."""
+    mx = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = _array
+    nd.ones = _ones
+    nd.zeros = _zeros
+    nd.NDArray = NDArray
+    gluon = types.ModuleType("mxnet.gluon")
+    parameter = types.ModuleType("mxnet.gluon.parameter")
+    parameter.Parameter = Parameter
+    parameter.DeferredInitializationError = DeferredInitializationError
+    gluon.Trainer = Trainer
+    gluon.parameter = parameter
+    mx.nd = nd
+    mx.gluon = gluon
+    mx.__version__ = "0.0-fake"
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = nd
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.gluon.parameter"] = parameter
+    return mx
+
+
+def uninstall() -> None:
+    for name in ("mxnet", "mxnet.nd", "mxnet.gluon",
+                 "mxnet.gluon.parameter", "horovod_tpu.mxnet"):
+        sys.modules.pop(name, None)

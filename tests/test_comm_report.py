@@ -27,6 +27,21 @@ def test_hlo_parser_counts_and_bytes():
     assert cols["all-gather"] == {"count": 1, "bytes": 64 * 2}
 
 
+def test_hlo_parser_tiled_tpu_layouts():
+    """Regression: TPU optimized HLO carries tiled layouts whose parens
+    ('{1,0:T(8,128)}') aborted the shape match and silently zeroed the
+    collective report."""
+    txt = """
+  %ar = f32[128,256]{1,0:T(8,128)} all-reduce(%x), replica_groups={}
+  %ag = bf16[64,8]{1,0:T(16,128)(2,1)} all-gather(%y), dimensions={0}
+  %start = (f32[32]{0:T(256)}, f32[32]{0:T(256)}) all-reduce-start(%z)
+"""
+    cols = hlo_collectives(txt)
+    assert cols["all-reduce"]["count"] == 2
+    assert cols["all-reduce"]["bytes"] == 128 * 256 * 4 + 32 * 4
+    assert cols["all-gather"] == {"count": 1, "bytes": 64 * 8 * 2}
+
+
 def test_report_finds_gradient_allreduce(hvd_init, rng):
     model = MLP(features=(32, 10))
     opt = optax.sgd(0.1)

@@ -67,6 +67,24 @@ def test_top1_dispatch_capacity():
     np.testing.assert_allclose(float(combine[1, 0, 1]), 0.8, rtol=1e-6)
 
 
+def test_top1_dispatch_bf16_many_tokens():
+    """Regression: buffer positions must be computed in int32 — a bf16
+    cumsum saturates at 256, colliding slots (tokens summed into one
+    buffer entry) once an expert sees >256 tokens."""
+    n = 600
+    gates = jnp.full((n, 2), 0.5, dtype=jnp.bfloat16).at[:, 0].set(
+        jnp.bfloat16(0.9)
+    )  # every token routes to expert 0
+    dispatch, _ = top1_dispatch(gates, capacity=n)
+    d = np.asarray(dispatch, dtype=np.float32)
+    # each kept token occupies exactly one slot...
+    np.testing.assert_allclose(d.sum(axis=(1, 2)), 1.0)
+    # ...and no slot holds more than one token
+    assert d.sum(axis=0).max() == 1.0
+    # slots 0..n-1 of expert 0 are each used exactly once
+    np.testing.assert_allclose(d[:, 0, :].sum(axis=0), 1.0)
+
+
 def test_moe_matches_dense_oracle(rng):
     """Per-rank EP computation == the dense oracle run on each rank's
     tokens (experts are global; each rank routes over all E)."""

@@ -158,9 +158,14 @@ def test_allreduce_scalar_keeps_shape():
     assert float(out) == 2.0
 
 
-def test_allreduce_unsupported_op_raises():
-    with pytest.raises(NotImplementedError):
-        hvd_tf.allreduce(tf.constant([1.0]), op=hvd_tf.Min)
+def test_allreduce_min_max_ops():
+    """Min/Max have real host-plane semantics since round 3
+    (csrc/controller.cc MinMaxPayload; single process: identity).  The
+    2-process semantics are proven in tests/test_ring.py."""
+    out = hvd_tf.allreduce(tf.constant([1.0, -2.0]), op=hvd_tf.Min)
+    assert out.numpy().tolist() == [1.0, -2.0]
+    out = hvd_tf.allreduce(tf.constant([3.0]), op=hvd_tf.Max)
+    assert out.numpy().tolist() == [3.0]
 
 
 def test_distributed_optimizer_double_wrap_raises():
