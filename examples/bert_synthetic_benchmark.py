@@ -164,10 +164,20 @@ def run(args) -> dict:
             print(f"Iter: sentences/sec total: {sps:.1f}")
 
     mean = float(np.mean(sent_secs))
+    from horovod_tpu.utils.flops import param_count, transformer_mfu
+
+    # the MLM head is a separate array outside `params` but its matmuls
+    # (fwd + bwd) run every step — count it or MFU undercounts ~10%
+    mfu = transformer_mfu(
+        mean / hvd.size(), param_count(params) + int(np.prod(head.shape)),
+        model.num_layers, model.hidden_dim, args.seq_len,
+    )
     if hvd.rank() == 0:
-        print(f"sentences/sec per chip: {mean / hvd.size():.1f}")
+        print(f"sentences/sec per chip: {mean / hvd.size():.1f}  "
+              f"(analytic MFU {mfu:.1%} of v5e bf16 peak)")
     return {"sent_sec_total": mean,
             "sent_sec_per_chip": mean / hvd.size(),
+            "mfu": mfu,
             "final_loss": float(np.asarray(jax.device_get(loss)))}
 
 

@@ -167,8 +167,16 @@ def run(args) -> dict:
     mean = float(np.mean(rates))
     # in both modes the whole mesh jointly produced the counted sequences
     per_chip = mean / hvd.size()
-    log(f"sequences/sec per chip: {per_chip:.1f}")
+    from horovod_tpu.utils.flops import param_count, transformer_mfu
+
+    mfu = transformer_mfu(
+        per_chip, param_count(state.params), model.num_layers,
+        model.hidden_dim, args.seq_len, causal=True,
+    )
+    log(f"sequences/sec per chip: {per_chip:.1f}  "
+        f"(analytic MFU {mfu:.1%} of v5e bf16 peak)")
     return {"seq_sec_per_chip": per_chip,
+            "mfu": mfu,
             "final_loss": float(np.asarray(jax.device_get(loss)))}
 
 

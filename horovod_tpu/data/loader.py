@@ -11,7 +11,7 @@ analog of "rank r joined early" (reference controller.cc:253-264).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -19,6 +19,29 @@ import jax
 
 from .. import core
 from ..training import shard_batch
+
+
+def pad_tail(cols: List[np.ndarray], valid: int, batch_size: int,
+             size: int) -> Tuple[List[np.ndarray], np.ndarray]:
+    """THE Join-tail layout (single definition — ShardedLoader and the
+    estimator's StoreLoader share it): zero-pad a partial global batch to
+    ``batch_size * size`` rows, packing valid rows onto the lowest ranks,
+    and return ``(cols, rows_per_rank)`` where ``rows_per_rank > 0`` is
+    the active mask."""
+    g = batch_size * size
+    rows_per_rank = np.full((size,), batch_size, np.int32)
+    if valid < g:
+        full, rem = divmod(valid, batch_size)
+        rows_per_rank = np.array(
+            [batch_size] * full + ([rem] if rem else [])
+            + [0] * (size - full - (1 if rem else 0)), np.int32,
+        )
+        pad = g - valid
+        cols = [
+            np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            for a in cols
+        ]
+    return cols, rows_per_rank
 
 
 class ShardedLoader:
@@ -59,16 +82,10 @@ class ShardedLoader:
         for start in range(0, stop, g):
             take = idx[start: start + g]
             valid = take.shape[0]
-            rows_per_rank = np.full((size,), self.batch_size, np.int32)
-            if valid < g:
-                full, rem = divmod(valid, self.batch_size)
-                rows_per_rank = np.array(
-                    [self.batch_size] * full + ([rem] if rem else [])
-                    + [0] * (size - full - (1 if rem else 0)), np.int32,
-                )
-                take = np.concatenate([take, np.zeros(g - valid, np.int64)])
-            shards = tuple(
-                shard_batch(a[take]) for a in self.arrays
+            cols, rows_per_rank = pad_tail(
+                [a[take] for a in self.arrays], valid, self.batch_size,
+                size,
             )
+            shards = tuple(shard_batch(a) for a in cols)
             active = shard_batch(rows_per_rank > 0)
             yield (*shards, active)

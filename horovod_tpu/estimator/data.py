@@ -1,0 +1,173 @@
+"""Store-resident training data: materialization + sharded reading.
+
+Re-design of the reference's estimator data path (reference
+horovod/spark/common/util.py ``prepare_data``: validate the DataFrame
+schema, write a petastorm dataset into ``store.get_train_data_path``;
+spark/keras/remote.py then trains from those files).  The TPU-era
+equivalent materializes named numpy arrays as npz shards plus a JSON
+manifest under the same Store location, and :class:`StoreLoader` streams
+them back per rank — one shard in memory at a time, global batches with
+the same Join-tail contract as the in-memory ``ShardedLoader``
+(padded final batch + per-rank ``active`` mask).
+
+Works over any Store: local FS, ``gs://``, ``memory://`` (tests).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+from .. import core
+from ..training import shard_batch
+from .store import Store
+
+_MANIFEST = "_manifest.json"
+
+
+def materialize_dataset(store: Store, run_id: str,
+                        arrays: Dict[str, np.ndarray], *,
+                        rows_per_shard: int = 65536) -> dict:
+    """Write ``arrays`` (equal first dims) into
+    ``store.get_train_data_path(run_id)`` as npz shards + a manifest.
+    Returns the manifest (reference util.py returns dataset metadata —
+    row counts, schema — the same facts)."""
+    names = list(arrays)
+    if not names:
+        raise ValueError("no arrays to materialize")
+    n = int(np.asarray(arrays[names[0]]).shape[0])
+    for k, a in arrays.items():
+        if np.asarray(a).shape[0] != n:
+            raise ValueError(
+                f"array {k!r} first dim {np.asarray(a).shape[0]} != {n}"
+            )
+    base = store.get_train_data_path(run_id)
+    shards = []
+    for i, start in enumerate(range(0, n, rows_per_shard)):
+        buf = io.BytesIO()
+        np.savez(buf, **{
+            k: np.asarray(a)[start: start + rows_per_shard]
+            for k, a in arrays.items()
+        })
+        fname = f"shard_{i:05d}.npz"
+        store.write(os.path.join(base, fname), buf.getvalue())
+        shards.append({
+            "file": fname,
+            "rows": min(rows_per_shard, n - start),
+        })
+    manifest = {
+        "version": 1,
+        "n_rows": n,
+        "columns": {
+            k: {"shape": list(np.asarray(a).shape[1:]),
+                "dtype": str(np.asarray(a).dtype)}
+            for k, a in arrays.items()
+        },
+        "shards": shards,
+    }
+    store.write(os.path.join(base, _MANIFEST),
+                json.dumps(manifest).encode())
+    return manifest
+
+
+def read_manifest(store: Store, run_id: str) -> dict:
+    base = store.get_train_data_path(run_id)
+    return json.loads(store.read(os.path.join(base, _MANIFEST)).decode())
+
+
+class StoreLoader:
+    """Iterate global batches from Store-resident shards.
+
+    Yield contract matches ``ShardedLoader``: ``(*columns, active)`` with
+    dim 0 of every column split across ranks, the final partial batch
+    zero-padded, and ``active`` marking ranks holding real rows (the
+    Join-tail contract, data/loader.py).  Shuffle is two-level — shard
+    order plus in-shard rows, seeded identically on every controller —
+    so only one shard is resident per process at a time (the reference's
+    petastorm reader streams row groups the same way)."""
+
+    def __init__(self, store: Store, run_id: str, *, batch_size: int,
+                 columns: List[str] = None, shuffle: bool = False,
+                 seed: int = 0, drop_remainder: bool = False):
+        self.store = store
+        self.run_id = run_id
+        self.manifest = read_manifest(store, run_id)
+        self.columns = columns or list(self.manifest["columns"])
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.n = self.manifest["n_rows"]
+
+    def __len__(self) -> int:
+        g = self.batch_size * core.size()
+        return self.n // g if self.drop_remainder else -(-self.n // g)
+
+    def _shard_arrays(self, fname: str) -> List[np.ndarray]:
+        base = self.store.get_train_data_path(self.run_id)
+        with np.load(io.BytesIO(
+                self.store.read(os.path.join(base, fname)))) as z:
+            return [z[c] for c in self.columns]
+
+    def __iter__(self) -> Iterator[Tuple]:
+        size = core.size()
+        g = self.batch_size * size
+        rng = np.random.default_rng(self.seed)
+        if self.shuffle:
+            self.seed += 1
+        order = list(range(len(self.manifest["shards"])))
+        if self.shuffle:
+            rng.shuffle(order)
+
+        pending: List[List[np.ndarray]] = []  # per-column row buffers
+        buffered = 0
+
+        def flush(cols_rows: List[np.ndarray], valid: int):
+            from ..data.loader import pad_tail
+
+            cols_rows, rows_per_rank = pad_tail(
+                cols_rows, valid, self.batch_size, size,
+            )
+            shards = tuple(shard_batch(a) for a in cols_rows)
+            active = shard_batch(rows_per_rank > 0)
+            return (*shards, active)
+
+        for si in order:
+            cols = self._shard_arrays(self.manifest["shards"][si]["file"])
+            if self.shuffle:
+                perm = rng.permutation(cols[0].shape[0])
+                cols = [a[perm] for a in cols]
+            pending.append(cols)
+            buffered += cols[0].shape[0]
+            while buffered >= g:
+                batch_cols, taken = self._take(pending, g)
+                buffered -= taken
+                yield flush(batch_cols, g)
+        if buffered and not self.drop_remainder:
+            batch_cols, taken = self._take(pending, buffered)
+            yield flush(batch_cols, taken)
+
+    @staticmethod
+    def _take(pending: List[List[np.ndarray]], want: int):
+        """Pop ``want`` rows across the buffered shards (in order)."""
+        out_parts: List[List[np.ndarray]] = []
+        got = 0
+        while got < want and pending:
+            cols = pending[0]
+            avail = cols[0].shape[0]
+            take = min(want - got, avail)
+            out_parts.append([a[:take] for a in cols])
+            if take == avail:
+                pending.pop(0)
+            else:
+                pending[0] = [a[take:] for a in cols]
+            got += take
+        merged = [
+            np.concatenate([p[i] for p in out_parts])
+            for i in range(len(out_parts[0]))
+        ]
+        return merged, got

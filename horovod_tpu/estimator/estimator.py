@@ -114,10 +114,68 @@ class Estimator:
         self.verbose = verbose
 
     def fit(self, x: np.ndarray, y: np.ndarray) -> EstimatorModel:
+        """Train on arrays.  With a Store configured this is the
+        reference's two-phase shape (reference spark/common/util.py
+        prepare_data → spark/keras/remote.py trains from the store):
+        the data is materialized into ``store.get_train_data_path`` and
+        training reads it back shard-streamed, NOT from the arrays."""
+        if not core.is_initialized():
+            core.init()
+        if self.store is not None:
+            from .data import materialize_dataset
+
+            if core.process_size() > 1:
+                from .. import eager
+
+                if core.process_rank() == 0:
+                    materialize_dataset(
+                        self.store, self.run_id, {"x": x, "y": y},
+                    )
+                eager.broadcast_object("materialized")  # barrier
+            else:
+                materialize_dataset(
+                    self.store, self.run_id, {"x": x, "y": y},
+                )
+            return self.fit_on_store(
+                sample_shape=(2,) + tuple(np.asarray(x).shape[1:]),
+                dtype=np.asarray(x).dtype,
+            )
+        return self._fit_loader(
+            ShardedLoader(
+                x, y, batch_size=self.batch_size, shuffle=self.shuffle,
+                drop_remainder=True,
+            ),
+            sample_shape=self.sample_input_shape
+            or (2,) + tuple(np.asarray(x).shape[1:]),
+            dtype=np.asarray(x).dtype,
+        )
+
+    def fit_on_store(self, run_id: Optional[str] = None, *,
+                     sample_shape: Optional[tuple] = None,
+                     dtype=np.float32) -> EstimatorModel:
+        """Train from data already materialized in the Store (columns
+        'x'/'y'), streaming one shard at a time with Join tails."""
+        from .data import StoreLoader, read_manifest
+
+        if self.store is None:
+            raise ValueError("fit_on_store requires a store")
+        rid = run_id or self.run_id
+        if sample_shape is None:
+            meta = read_manifest(self.store, rid)
+            sample_shape = (2,) + tuple(meta["columns"]["x"]["shape"])
+            dtype = np.dtype(meta["columns"]["x"]["dtype"])
+        loader = StoreLoader(
+            self.store, rid, batch_size=self.batch_size,
+            columns=["x", "y"], shuffle=self.shuffle,
+            drop_remainder=True,  # epoch loop trains full batches only
+        )
+        return self._fit_loader(loader, sample_shape=sample_shape,
+                                dtype=dtype)
+
+    def _fit_loader(self, loader, *, sample_shape, dtype) -> EstimatorModel:
         if not core.is_initialized():
             core.init()
 
-        sample_shape = self.sample_input_shape or (2,) + tuple(x.shape[1:])
         step = make_train_step(
             apply_fn=self.model.apply,
             loss_fn=self.loss,
@@ -127,16 +185,13 @@ class Estimator:
             has_batch_stats=self.has_batch_stats,
         )
         state = init_train_state(
-            self.model, self.optimizer, jnp.zeros(sample_shape, x.dtype),
+            self.model, self.optimizer,
+            jnp.zeros(self.sample_input_shape or sample_shape, dtype),
             has_batch_stats=self.has_batch_stats,
         )
         for cb in self.callbacks:
             state = cb.on_train_begin(state) or state
 
-        loader = ShardedLoader(
-            x, y, batch_size=self.batch_size, shuffle=self.shuffle,
-            drop_remainder=True,
-        )
         history = []
         for epoch in range(self.epochs):
             losses = []

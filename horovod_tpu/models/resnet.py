@@ -70,11 +70,23 @@ class SpaceToDepthConvInit(nn.Module):
         )
 
 
+def _residual_join(residual, y, kind: str):
+    """The block output ``relu(residual + y)``: XLA elementwise fusion by
+    default, or the Pallas single-pass kernel (the docs/PERF.md §56×56
+    experiment — measured by scripts/pallas_residual_experiment.py)."""
+    if kind == "pallas":
+        from ..ops.elementwise import residual_relu
+
+        return residual_relu(residual, y)
+    return nn.relu(residual + y)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
     conv: ModuleDef
     norm: ModuleDef
+    join: str = "xla"  # "xla" | "pallas"
 
     @nn.compact
     def __call__(self, x):
@@ -93,7 +105,7 @@ class BottleneckBlock(nn.Module):
                 name="conv_proj",
             )(residual)
             residual = self.norm(name="norm_proj")(residual)
-        return nn.relu(residual + y)
+        return _residual_join(residual, y, self.join)
 
 
 class BasicBlock(nn.Module):
@@ -101,6 +113,7 @@ class BasicBlock(nn.Module):
     strides: int
     conv: ModuleDef
     norm: ModuleDef
+    join: str = "xla"  # "xla" | "pallas"
 
     @nn.compact
     def __call__(self, x):
@@ -116,7 +129,7 @@ class BasicBlock(nn.Module):
                 name="conv_proj",
             )(residual)
             residual = self.norm(name="norm_proj")(residual)
-        return nn.relu(residual + y)
+        return _residual_join(residual, y, self.join)
 
 
 class ResNet(nn.Module):
@@ -127,6 +140,7 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     stem: str = "conv"  # "conv" | "space_to_depth" (same params/output)
+    residual_join: str = "xla"  # "xla" | "pallas" (same math, see blocks)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -161,6 +175,7 @@ class ResNet(nn.Module):
                 x = self.block_cls(
                     filters=self.num_filters * 2 ** i,
                     strides=strides, conv=conv, norm=norm,
+                    join=self.residual_join,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,

@@ -345,16 +345,33 @@ def alltoall(tensor, *, process_set: Optional[ProcessSet] = None):
     if process_set is not None:
         groups = process_set.equal_groups()
         if groups is None:
-            raise ValueError(
-                "alltoall requires a ProcessSet whose complement splits "
-                "into equal-size groups (XLA all_to_all constraint); "
-                f"got set of {process_set.size()} in a world of "
-                f"{core.size()}"
-            )
+            # XLA all_to_all needs equal-size groups; psum accepts any
+            # partition — same embed trick as allgather's uneven path
+            return _psum_embed_alltoall(tensor, axes[0], process_set)
     split = tensor.reshape((n, tensor.shape[0] // n) + tensor.shape[1:])
     out = lax.all_to_all(split, axes[0], split_axis=0, concat_axis=0,
                          axis_index_groups=groups, tiled=False)
     return out.reshape((-1,) + tensor.shape[1:])
+
+
+def _psum_embed_alltoall(tensor, axis_name, process_set: "ProcessSet"):
+    """alltoall for uneven ProcessSets: member at position p embeds its k
+    chunks at row p of a zero [k, k, chunk, ...] buffer; after a psum
+    over the set, every member holds the full exchange matrix and takes
+    column p (its incoming chunks).  Wire cost is k× the minimal
+    alltoall — acceptable at ProcessSet control sizes, and the only
+    schedule XLA can express for ragged groups (reference keeps uneven
+    sets on MPI sub-communicators instead, operations.cc:655-663)."""
+    k = process_set.size()
+    chunk = tensor.shape[0] // k
+    member, pos = process_set.member_position()
+    split = tensor.reshape((k, chunk) + tuple(tensor.shape[1:]))
+    contrib = jnp.where(member, split, jnp.zeros_like(split))
+    buf = jnp.zeros((k,) + split.shape, tensor.dtype)
+    buf = buf.at[pos].set(contrib)  # OOB pos (non-member) drops the update
+    full = lax.psum(buf, axis_name, axis_index_groups=process_set.groups())
+    out = jnp.take(full, jnp.minimum(pos, k - 1), axis=1)  # [k, chunk, ...]
+    return out.reshape((-1,) + tuple(tensor.shape[1:]))
 
 
 def reducescatter(tensor, *, op: str = Sum,

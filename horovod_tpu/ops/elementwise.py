@@ -1,0 +1,92 @@
+"""Pallas elementwise kernels for the HBM-bound ResNet joins.
+
+docs/PERF.md's profile names the 56×56 residual-add fusions (3 × 5.45 ms
+at batch 256) as the one untried framework-side lever on the ResNet-50
+headline; this module is that experiment's kernel.  ``residual_relu``
+computes ``relu(x + y)`` in one HBM pass with explicit [rows, 256]
+blocking; ``scripts/pallas_residual_experiment.py`` measures it against
+XLA's own elementwise fusion standalone and end-to-end (the result —
+either a headline move or a measured negative — is recorded in
+docs/PERF.md).
+
+Off-TPU the kernel runs in Pallas interpreter mode, same policy as
+ops/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import _resolve_interpret
+
+
+def _residual_relu_kernel(x_ref, y_ref, o_ref):
+    o_ref[...] = jnp.maximum(x_ref[...] + y_ref[...], 0)
+
+
+def _relu_grad_kernel(o_ref, g_ref, dx_ref):
+    # compare in f32: Mosaic can't lower bf16 vector cmpf on this target
+    mask = o_ref[...].astype(jnp.float32) > 0
+    dx_ref[...] = jnp.where(mask, g_ref[...], jnp.zeros_like(g_ref[...]))
+
+
+# per-buffer VMEM budget: 3 buffers x 2 (double buffering) must fit the
+# ~16 MB scoped-vmem limit with headroom
+_BLOCK_BYTES = 2 << 20
+
+
+def _flat_call(kernel, a, b, *, block_rows, interpret):
+    lanes = a.shape[-1]
+    af = a.reshape(-1, lanes)
+    bf = b.reshape(-1, lanes)
+    rows = af.shape[0]
+    cap = max(8, _BLOCK_BYTES // (lanes * a.dtype.itemsize))
+    block = min(block_rows, cap, rows)
+    out = pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[
+            pl.BlockSpec((block, lanes), lambda i: (i, 0)),
+            pl.BlockSpec((block, lanes), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, lanes), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), a.dtype),
+        interpret=_resolve_interpret(interpret),
+    )(af, bf)
+    return out.reshape(a.shape)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def residual_relu(x, y, block_rows: int = 1024,
+                  interpret: Optional[bool] = None):
+    """``relu(x + y)`` as a single Pallas pass (custom VJP: the backward
+    is one masked pass reusing the saved output, the same residual the
+    XLA fusion keeps).
+
+    Shapes: any, as long as x and y match; internally flattened to
+    [rows, lanes] with the trailing dimension kept whole (channel-last
+    NHWC tensors put C on the lanes, which is the TPU-friendly layout).
+    """
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch: {x.shape} vs {y.shape}")
+    return _flat_call(_residual_relu_kernel, x, y,
+                      block_rows=block_rows, interpret=interpret)
+
+
+def _residual_relu_fwd(x, y, block_rows, interpret):
+    out = residual_relu(x, y, block_rows, interpret)
+    return out, out
+
+
+def _residual_relu_bwd(block_rows, interpret, out, g):
+    dx = _flat_call(_relu_grad_kernel, out, g,
+                    block_rows=block_rows, interpret=interpret)
+    return dx, dx
+
+
+residual_relu.defvjp(_residual_relu_fwd, _residual_relu_bwd)

@@ -216,8 +216,7 @@ class RingExecutor:
             if type_code == 6:  # coordinator ERROR response
                 self._fail(ring_names, RuntimeError(err))
             else:
-                for nm, dtype_code, nbytes in ring_names:
-                    self._execute(nm, dtype_code, nbytes, type_code)
+                self._execute_group(ring_names, type_code)
             # Drain the per-name Wait entries the client recorded for
             # these responses: ring ops never call wait(), and the
             # entries would otherwise accumulate one per collective.
@@ -244,6 +243,74 @@ class RingExecutor:
         else:
             fill = 0
         return np.full(n, fill, dt)
+
+    def _execute_group(self, ring_names, type_code: int) -> None:
+        """Execute one negotiated group of ring ops.
+
+        The coordinator already fused small same-type tensors into one
+        response (csrc/controller.cc FuseResponses); this is the host
+        plane's fusion *buffer*: same-(op, dtype) reduce ops in the group
+        concatenate into a single ring transfer — one 2(n−1)-hop
+        schedule instead of one per tensor (the reference's fusion
+        buffer, common/operations.cc FuseResponses + buffer assembly).
+        Bucket order follows group order, so every rank runs identical
+        transfers.  Broadcasts execute singly (different roots can't
+        share a buffer)."""
+        buckets = {}
+        singles = []
+        for nm, dtype_code, nbytes in ring_names:
+            tag = nm[len(RING_PREFIX):].partition(":")[0]
+            if tag in _TAG_OPS:
+                buckets.setdefault((tag, dtype_code), []).append(
+                    (nm, dtype_code, nbytes))
+            else:
+                singles.append((nm, dtype_code, nbytes))
+        for nm, dtype_code, nbytes in singles:
+            self._execute(nm, dtype_code, nbytes, type_code)
+        for (tag, dtype_code), items in buckets.items():
+            if len(items) == 1:
+                nm, dc, nb = items[0]
+                self._execute(nm, dc, nb, type_code)
+            else:
+                self._execute_fused(tag, dtype_code, items)
+
+    def _execute_fused(self, tag: str, dtype_code: int, items) -> None:
+        op = _TAG_OPS[tag]
+        parts, futs = [], []
+        for nm, _, nbytes in items:
+            with self._lock:
+                entry = self._pending.pop(nm, None)
+            if entry is None:  # joined rank: identity contribution
+                parts.append((self._identity(op, dtype_code, nbytes),
+                              None, nbytes))
+                futs.append(None)
+            else:
+                arr, _, _, fut = entry
+                parts.append((arr, arr.shape, nbytes))
+                futs.append(fut)
+        try:
+            for (arr, _, nbytes), (nm, _, _) in zip(parts, items):
+                if arr.nbytes != nbytes:
+                    raise ValueError(
+                        f"ring op {nm!r}: local payload is {arr.nbytes} B "
+                        f"but the negotiated size is {nbytes} B"
+                    )
+            flat = np.concatenate([a.ravel() for a, _, _ in parts])
+            out = self._ring.allreduce(flat, op=op)
+            off = 0
+            for (arr, shape, _), fut in zip(parts, futs):
+                n = arr.size
+                if fut is not None:
+                    fut.set_result(out[off: off + n].reshape(shape))
+                off += n
+        except BaseException as e:  # noqa: BLE001
+            delivered = False
+            for fut in futs:
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+                    delivered = True
+            if not delivered:  # all-joined group: nobody to tell — log
+                log.warning("joined-rank fused ring group failed: %s", e)
 
     def _execute(self, name: str, dtype_code: int, nbytes: int,
                  type_code: int) -> None:

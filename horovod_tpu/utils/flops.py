@@ -1,0 +1,42 @@
+"""Analytic FLOP accounting for MFU reporting.
+
+The ResNet bench reports MFU from the usual 3×-forward analytic count
+(bench.py); this gives the transformer benches the same legibility
+(reference docs/benchmarks.rst:66-80 publishes per-model throughput —
+MFU is the hardware-normalized form).  Formula is the standard decoder
+accounting (PaLM appendix B): 6·N FLOPs per token of parameter math
+(fwd + bwd) plus the attention score/value matmuls, 12·L·s·d per token
+— halved for causal models whose flash kernels skip fully-future
+blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+V5E_PEAK_FLOPS = 197e12  # bf16 nameplate, per chip
+
+
+def param_count(params) -> int:
+    return int(sum(np.prod(x.shape)
+                   for x in jax.tree_util.tree_leaves(params)))
+
+
+def transformer_train_flops_per_seq(n_params: int, num_layers: int,
+                                    hidden_dim: int, seq_len: int, *,
+                                    causal: bool = False) -> float:
+    attn_per_token = 12.0 * num_layers * seq_len * hidden_dim
+    if causal:
+        attn_per_token /= 2.0
+    return seq_len * (6.0 * n_params + attn_per_token)
+
+
+def transformer_mfu(seq_per_sec_per_chip: float, n_params: int,
+                    num_layers: int, hidden_dim: int, seq_len: int, *,
+                    causal: bool = False,
+                    peak_flops: float = V5E_PEAK_FLOPS) -> float:
+    fps = transformer_train_flops_per_seq(
+        n_params, num_layers, hidden_dim, seq_len, causal=causal,
+    )
+    return seq_per_sec_per_chip * fps / peak_flops

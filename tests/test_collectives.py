@@ -244,6 +244,28 @@ def test_process_set_allgatherv_uneven(hvd_init, rng):
             np.testing.assert_allclose(rows[nv:], 0.0)
 
 
+@pytest.mark.parametrize("ranks", [[0, 1, 2], [1, 4, 6]])
+def test_process_set_alltoall_uneven(hvd_init, rng, ranks):
+    """alltoall over an uneven set (3 of 8: complement 5 can't split into
+    equal groups) via the psum-embed fallback — the last loud-error gap
+    in the ProcessSet matrix (VERDICT round-2 item 8)."""
+    k = len(ranks)
+    xs = [rng.normal(size=(k * 2, 3)).astype(np.float32) for _ in range(8)]
+    ps = hvd.ProcessSet(ranks)
+
+    @hvd.spmd
+    def step(x):
+        return hvd.alltoall(x[0], process_set=ps)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    for p, r in enumerate(ranks):
+        # member at position p receives chunk p of every member, in order
+        expected = np.concatenate(
+            [xs[src][2 * p: 2 * (p + 1)] for src in ranks], axis=0
+        )
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5, atol=1e-6)
+
+
 def test_process_set_reducescatter_uneven(hvd_init, rng):
     ps = hvd.ProcessSet([0, 1, 2])
     xs = [rng.normal(size=(6, 2)).astype(np.float32) for _ in range(8)]
@@ -258,16 +280,6 @@ def test_process_set_reducescatter_uneven(hvd_init, rng):
         np.testing.assert_allclose(
             out[r], total[2 * i: 2 * (i + 1)], rtol=1e-4, atol=1e-5
         )
-
-
-def test_process_set_alltoall_uneven_raises(hvd_init):
-    ps = hvd.ProcessSet([0, 1, 2])
-    with pytest.raises(ValueError, match="equal-size groups"):
-        @hvd.spmd
-        def step(x):
-            return hvd.alltoall(x[0], process_set=ps)[None]
-
-        step(np.zeros((8, 3, 2), np.float32))
 
 
 def test_grouped_allreduce(hvd_init, rng):

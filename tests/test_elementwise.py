@@ -1,0 +1,53 @@
+"""Pallas residual-join kernel vs the XLA oracle (fwd + grad) — the
+docs/PERF.md §56×56 experiment's correctness gate; perf verdict lives in
+scripts/pallas_residual_experiment.py / PERF.md."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.elementwise import residual_relu
+
+
+def test_residual_relu_matches_xla(rng):
+    x = jnp.asarray(rng.normal(size=(4, 8, 8, 256)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(4, 8, 8, 256)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(residual_relu(x, y)),
+        np.asarray(jax.nn.relu(x + y)),
+        rtol=1e-6,
+    )
+
+
+def test_residual_relu_gradients(rng):
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 128)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(2, 4, 4, 128)), jnp.float32)
+
+    def loss_pallas(a, b):
+        return jnp.sum(residual_relu(a, b) ** 2)
+
+    def loss_xla(a, b):
+        return jnp.sum(jax.nn.relu(a + b) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1))(x, y)
+    gx = jax.grad(loss_xla, argnums=(0, 1))(x, y)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_resnet_block_pallas_join_matches(rng):
+    """A ResNet block with residual_join='pallas' computes the same
+    function as the default."""
+    from horovod_tpu.models.resnet import ResNet18
+
+    x = jnp.asarray(rng.uniform(size=(2, 32, 32, 3)), jnp.float32)
+    out = {}
+    for join in ("xla", "pallas"):
+        model = ResNet18(num_classes=10, dtype=jnp.float32,
+                         residual_join=join)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out[join] = np.asarray(
+            model.apply(variables, x, train=False), np.float32
+        )
+    np.testing.assert_allclose(out["pallas"], out["xla"], rtol=2e-5,
+                               atol=1e-5)

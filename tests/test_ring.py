@@ -162,6 +162,51 @@ def test_adasum_non_power_of_two_raises():
         assert "power-of-two" in res
 
 
+def _worker_concurrent_ring():
+    """Concurrent out-of-order submissions: per-handle threads fire ring
+    ops in different orders on each rank; the coordinator-ordered
+    dispatcher (and its fusion buckets) must serialize them identically
+    — the deadlock scenario the response stream exists to prevent."""
+    import threading
+
+    import numpy as np
+
+    import jax
+    import horovod_tpu as hvd
+    from horovod_tpu import eager
+
+    hvd.init(devices=jax.devices("cpu"))
+    r = hvd.process_rank()
+    n = hvd.process_size()
+
+    results = {}
+    lock = threading.Lock()
+
+    def one(i):
+        arr = np.full(20_000, float((i + 1) * (r + 1)), np.float32)
+        out = eager.process_allreduce(arr, op=hvd.Sum, name=f"conc.{i}")
+        with lock:
+            results[i] = float(out[0])
+
+    # ranks submit in opposite orders
+    order = range(6) if r % 2 == 0 else reversed(range(6))
+    threads = [threading.Thread(target=one, args=(i,)) for i in order]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    expected = {
+        i: float((i + 1) * sum(range(1, n + 1))) for i in range(6)
+    }
+    return {"rank": r, "ok": results == expected, "got": results}
+
+
+def test_concurrent_out_of_order_ring_ops():
+    results = run(_worker_concurrent_ring, np=2, extra_env=_env())
+    for res in results:
+        assert res["ok"], res
+
+
 def _worker_adasum_delta():
     import numpy as np
 

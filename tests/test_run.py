@@ -297,6 +297,61 @@ def test_tpu_host_discovery_metadata(monkeypatch):
         ("10.0.0.2", 4), ("10.0.0.3", 4)]
 
 
+def test_tpu_host_discovery_http_metadata_server(monkeypatch):
+    """All three sources end-to-end with a REAL mocked GCE metadata
+    endpoint: the HTTP fetch (incl. the Metadata-Flavor header contract)
+    and the HVD_TPU_HOSTS > TPU_WORKER_HOSTNAMES > metadata precedence
+    (reference run/run.py:62-115 tests its host checks similarly)."""
+    import http.server
+    import threading
+
+    from horovod_tpu.run import discovery
+
+    seen_headers = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen_headers.update(self.headers)
+            body = b"0:w0:10.9.0.2,1:w1:10.9.0.3"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        monkeypatch.setattr(
+            discovery, "_METADATA_URL",
+            f"http://127.0.0.1:{srv.server_port}/attr",
+        )
+        monkeypatch.delenv("HVD_TPU_HOSTS", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+
+        hosts = discovery.discover_tpu_hosts(default_slots=4)
+        assert [(h.hostname, h.slots) for h in hosts] == [
+            ("10.9.0.2", 4), ("10.9.0.3", 4)]
+        assert seen_headers.get("Metadata-Flavor") == "Google"
+
+        # precedence: the worker-hostnames env beats the metadata server
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1")
+        hosts = discovery.discover_tpu_hosts(default_slots=2)
+        assert [(h.hostname, h.slots) for h in hosts] == [
+            ("w0", 2), ("w1", 2)]
+
+        # ...and the explicit override beats both
+        monkeypatch.setenv("HVD_TPU_HOSTS", "explicit-0:8")
+        hosts = discovery.discover_tpu_hosts()
+        assert [(h.hostname, h.slots) for h in hosts] == [("explicit-0", 8)]
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)
+
+
 def test_tpu_flag_resolves_hosts(monkeypatch):
     from horovod_tpu.run.run import _resolve_hosts, parse_args
 
