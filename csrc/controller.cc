@@ -367,12 +367,25 @@ class ControllerServer {
   int64_t cycles() const { return cycles_.load(); }
   int64_t stall_warnings() const { return stall_warnings_.load(); }
 
+  // Idempotent, and must run its joins even when stopping_ was already
+  // set by a client kShutdown — destroying a joinable std::thread is
+  // std::terminate.
   void Stop() {
-    bool expected = false;
-    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    stopping_.store(true);
     if (thread_.joinable()) thread_.join();
-    if (listen_fd_ >= 0) ::close(listen_fd_);
+    {
+      // lock/unlock pairs the flag write with the waiter's predicate
+      // read — notify without it can lose the wakeup and hang the join
+      std::lock_guard<std::mutex> lk(compute_mu_);
+    }
+    compute_cv_.notify_all();
+    if (compute_thread_.joinable()) compute_thread_.join();
+    if (listen_fd_ >= 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
     for (auto& [fd, rank] : clients_) ::close(fd);
+    clients_.clear();
   }
 
  private:
@@ -415,6 +428,7 @@ class ControllerServer {
         ++i;
       }
       for (int fd : dead) {
+        std::lock_guard<std::mutex> lk(send_mu_);
         ::close(fd);
         clients_.erase(fd);
       }
@@ -435,6 +449,7 @@ class ControllerServer {
     }
     int32_t rank;
     std::memcpy(&rank, payload.data(), 4);
+    std::lock_guard<std::mutex> lk(send_mu_);
     clients_[fd] = rank;
   }
 
@@ -463,6 +478,7 @@ class ControllerServer {
       std::memcpy(out.data(), &cyc, 8);
       std::memcpy(out.data() + 8, &hits, 8);
       std::memcpy(out.data() + 16, &stalls, 8);
+      std::lock_guard<std::mutex> lk(send_mu_);
       SendMsg(fd, kStatsResult, out);
     } else if (type == kShutdown) {
       stopping_.store(true);
@@ -508,13 +524,43 @@ class ControllerServer {
       d.count += 1;
     }
     if (d.count >= nranks_) {
+      // Hand the reduction to the compute worker: summing (or the
+      // float64 Adasum tree) over n payloads on THIS thread would block
+      // negotiation for every other tensor in flight (the reference
+      // keeps data-plane work off its coordination thread the same way,
+      // operations.cc BackgroundThreadLoop vs the op execution path).
+      {
+        std::lock_guard<std::mutex> lk(compute_mu_);
+        compute_queue_.emplace_back(name, std::move(d));
+        if (!compute_thread_.joinable())
+          compute_thread_ = std::thread([this] { ComputeLoop(); });
+      }
+      compute_cv_.notify_one();
+      data_table_.erase(name);
+    }
+  }
+
+  void ComputeLoop() {
+    for (;;) {
+      std::pair<std::string, PendingData> job;
+      {
+        std::unique_lock<std::mutex> lk(compute_mu_);
+        compute_cv_.wait(lk, [&] {
+          return !compute_queue_.empty() || stopping_.load();
+        });
+        if (compute_queue_.empty()) return;  // stopping
+        job = std::move(compute_queue_.front());
+        compute_queue_.pop_front();
+      }
+      const std::string& name = job.first;
+      PendingData& d = job.second;
       std::string result;
       std::string compute_err;
       bool ok = !d.error && ComputeDataResult(d, &result, &compute_err);
       // kDataResult payload: [u8 ok][u32 nlen][name][data-or-error]
       std::string out;
       out.push_back(ok ? 1 : 0);
-      PutU32(&out, nlen);
+      PutU32(&out, static_cast<uint32_t>(name.size()));
       out += name;
       if (ok) {
         out += result;
@@ -528,8 +574,8 @@ class ControllerServer {
                " unsupported for op " + std::to_string(d.op) +
                " or payload sizes mismatch across ranks";
       }
+      std::lock_guard<std::mutex> lk(send_mu_);
       for (auto& [fd, r] : clients_) SendMsg(fd, kDataResult, out);
-      data_table_.erase(name);
     }
   }
 
@@ -661,6 +707,7 @@ class ControllerServer {
     FuseResponses(&rl);
     std::string payload;
     rl.Serialize(&payload);
+    std::lock_guard<std::mutex> lk(send_mu_);
     for (auto& [fd, rank] : clients_) SendMsg(fd, kResponseList, payload);
   }
 
@@ -707,7 +754,12 @@ class ControllerServer {
   int port_ = 0;
   std::thread thread_;
   std::atomic<bool> stopping_{false};
-  std::map<int, int32_t> clients_;  // fd → rank
+  std::map<int, int32_t> clients_;  // fd → rank; guarded by send_mu_
+  std::mutex send_mu_;              // serializes sends + clients_ edits
+  std::mutex compute_mu_;
+  std::condition_variable compute_cv_;
+  std::deque<std::pair<std::string, PendingData>> compute_queue_;
+  std::thread compute_thread_;      // data-plane reductions off the loop
   std::map<std::string, PendingTensor> table_;
   std::map<std::string, PendingData> data_table_;
   std::unordered_map<std::string, std::string> cache_;

@@ -4,13 +4,20 @@ Mirror of the reference harness (reference
 examples/pytorch_synthetic_benchmark.py: hvd.init → model → wrap
 optimizer in hvd.DistributedOptimizer with named_parameters +
 compression → broadcast parameters/optimizer state → timed iters).
-torch is CPU-only on this image and torchvision is absent, so the model
-is a self-contained convnet (``--model resnet18ish`` is a reduced
-basic-block stack); gradients cross processes on the framework's host
-data plane — launch with ``tpurun -np 2`` for the real multi-process
-path.
+Defaults match the reference (``--model resnet50 --batch-size 32``,
+BASELINE.json config 3); the reference pulls models from torchvision,
+which is absent here, so ResNet-50/18 are self-contained plain-torch
+implementations (``smallconv`` remains for smoke tests).  Gradients
+cross processes on the framework's host data plane — the ~100 MB/step
+ResNet-50 gradient volume rides the peer ring (csrc/ring.cc); launch
+with ``tpurun -np 2`` for the real multi-process path, or
+``scripts/host_plane_bench.py`` for the measured scaling artifact.
 
-Run:  python examples/pytorch_synthetic_benchmark.py --num-iters 3
+Run (full, reference config — ResNet-50 is minutes/iter on CPU torch):
+    python examples/pytorch_synthetic_benchmark.py --num-iters 3
+Smoke (seconds):
+    python examples/pytorch_synthetic_benchmark.py --model smallconv \
+        --batch-size 8 --image-size 32 --num-classes 10 --num-iters 1
 """
 
 from __future__ import annotations
@@ -29,17 +36,86 @@ def parse_args(argv=None):
         description="horovod_tpu PyTorch Synthetic Benchmark",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
     )
-    parser.add_argument("--model", type=str, default="smallconv",
-                        choices=["smallconv", "resnet18ish"])
-    parser.add_argument("--batch-size", type=int, default=8)
-    parser.add_argument("--image-size", type=int, default=32)
-    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--model", type=str, default="resnet50",
+                        choices=["smallconv", "resnet18", "resnet50"])
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--fp16-allreduce", action="store_true",
                         default=False)
     parser.add_argument("--num-warmup-batches", type=int, default=2)
     parser.add_argument("--num-batches-per-iter", type=int, default=3)
     parser.add_argument("--num-iters", type=int, default=3)
     return parser.parse_args(argv)
+
+
+def _resnet(layers, num_classes: int, bottleneck: bool):
+    """Plain-torch ResNet (the reference uses torchvision.models; the
+    architecture is the standard He et al. v1.5 layout)."""
+    import torch.nn as nn
+
+    class BasicBlock(nn.Module):
+        expansion = 1
+
+        def __init__(self, cin, planes, stride=1):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, planes, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(planes)
+            self.c2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(planes)
+            cout = planes * self.expansion
+            self.proj = (
+                nn.Sequential(nn.Conv2d(cin, cout, 1, stride, bias=False),
+                              nn.BatchNorm2d(cout))
+                if (stride != 1 or cin != cout) else nn.Identity()
+            )
+            self.relu = nn.ReLU(inplace=True)
+
+        def forward(self, x):
+            y = self.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            return self.relu(y + self.proj(x))
+
+    class Bottleneck(nn.Module):
+        expansion = 4
+
+        def __init__(self, cin, planes, stride=1):
+            super().__init__()
+            cout = planes * self.expansion
+            self.c1 = nn.Conv2d(cin, planes, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(planes)
+            self.c2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(planes)
+            self.c3 = nn.Conv2d(planes, cout, 1, bias=False)
+            self.b3 = nn.BatchNorm2d(cout)
+            self.proj = (
+                nn.Sequential(nn.Conv2d(cin, cout, 1, stride, bias=False),
+                              nn.BatchNorm2d(cout))
+                if (stride != 1 or cin != cout) else nn.Identity()
+            )
+            self.relu = nn.ReLU(inplace=True)
+
+        def forward(self, x):
+            y = self.relu(self.b1(self.c1(x)))
+            y = self.relu(self.b2(self.c2(y)))
+            y = self.b3(self.c3(y))
+            return self.relu(y + self.proj(x))
+
+    block = Bottleneck if bottleneck else BasicBlock
+    stages = []
+    cin = 64
+    for i, n in enumerate(layers):
+        planes = 64 * 2 ** i
+        for j in range(n):
+            stages.append(block(cin, planes, 2 if i > 0 and j == 0 else 1))
+            cin = planes * block.expansion
+    return nn.Sequential(
+        nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+        nn.ReLU(inplace=True), nn.MaxPool2d(3, 2, 1),
+        *stages,
+        nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+        nn.Linear(cin, num_classes),
+    )
 
 
 def _make_model(name: str, num_classes: int):
@@ -53,30 +129,9 @@ def _make_model(name: str, num_classes: int):
             nn.AdaptiveAvgPool2d(1), nn.Flatten(),
             nn.Linear(32, num_classes),
         )
-
-    class Block(nn.Module):
-        def __init__(self, cin, cout, stride=1):
-            super().__init__()
-            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
-            self.b1 = nn.BatchNorm2d(cout)
-            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
-            self.b2 = nn.BatchNorm2d(cout)
-            self.proj = (nn.Conv2d(cin, cout, 1, stride, bias=False)
-                         if (stride != 1 or cin != cout) else nn.Identity())
-            self.relu = nn.ReLU()
-
-        def forward(self, x):
-            y = self.relu(self.b1(self.c1(x)))
-            y = self.b2(self.c2(y))
-            return self.relu(y + self.proj(x))
-
-    return nn.Sequential(
-        nn.Conv2d(3, 32, 3, padding=1, bias=False), nn.BatchNorm2d(32),
-        nn.ReLU(),
-        Block(32, 32), Block(32, 64, 2), Block(64, 128, 2),
-        nn.AdaptiveAvgPool2d(1), nn.Flatten(),
-        nn.Linear(128, num_classes),
-    )
+    if name == "resnet18":
+        return _resnet([2, 2, 2, 2], num_classes, bottleneck=False)
+    return _resnet([3, 4, 6, 3], num_classes, bottleneck=True)
 
 
 def run(args) -> dict:

@@ -320,11 +320,19 @@ def process_allreduce(arr, *, op: str = Average,
         ) else arr.astype(np.float32)
         wire_op = _WIRE_OPS[op]
         rx = eager_controller.ring()
-        if (rx is not None and wire_op in ("allreduce", "min", "max")
-                and wire.nbytes >= _RING_MIN_BYTES):
-            out = rx.allreduce(nm, np.array(wire, copy=True), op=wire_op)
-        else:
-            out = c.allreduce_data(nm, wire, op=wire_op)
+        use_ring = (rx is not None
+                    and wire_op in ("allreduce", "min", "max")
+                    and wire.nbytes >= _RING_MIN_BYTES)
+        # host-plane traffic shows up in the per-rank trace with its
+        # transport (the reference timelines its CPU-ops path the same
+        # way — MPI_ALLREDUCE spans, timeline.cc activity vocabulary)
+        activity = "RING_ALLREDUCE" if use_ring else "STAR_ALLREDUCE"
+        with inspector.watch(nm), timeline.span(nm, activity):
+            if use_ring:
+                out = rx.allreduce(nm, np.array(wire, copy=True),
+                                   op=wire_op)
+            else:
+                out = c.allreduce_data(nm, wire, op=wire_op)
         if op == Average:
             out = out / core.process_size()
         return out.astype(arr.dtype) if out.dtype != arr.dtype else out
@@ -395,7 +403,8 @@ def process_broadcast(arr, root_rank: int = 0, *,
         else:
             dt = np.dtype(dtype_s)
         buf = np.zeros(shape, dt)
-    return rx.broadcast(nm, buf, root_rank)
+    with inspector.watch(nm), timeline.span(nm, "RING_BROADCAST"):
+        return rx.broadcast(nm, buf, root_rank)
 
 
 def normalize_op(average, op):

@@ -100,7 +100,9 @@ def test_pytorch_synthetic_benchmark_example(mesh8):
     pytest.importorskip("torch")
     from examples.pytorch_synthetic_benchmark import parse_args, run
 
-    r = run(parse_args(["--num-iters", "1", "--num-batches-per-iter", "2",
+    r = run(parse_args(["--model", "smallconv", "--batch-size", "8",
+                        "--image-size", "32", "--num-classes", "10",
+                        "--num-iters", "1", "--num-batches-per-iter", "2",
                         "--num-warmup-batches", "1"]))
     assert r["img_sec_per_proc"] > 0
     assert np.isfinite(r["final_loss"])
