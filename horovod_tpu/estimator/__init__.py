@@ -1,2 +1,12 @@
 from .store import Store, LocalStore, FsspecStore  # noqa: F401
 from .estimator import Estimator, EstimatorModel  # noqa: F401
+
+
+def __getattr__(name):
+    # torch/keras estimators import their framework lazily (reference
+    # gates spark.keras / spark.torch the same way)
+    if name in ("TorchEstimator", "TorchEstimatorModel", "KerasEstimator"):
+        from . import frameworks
+
+        return getattr(frameworks, name)
+    raise AttributeError(name)

@@ -79,6 +79,55 @@ def read_manifest(store: Store, run_id: str) -> dict:
     return json.loads(store.read(os.path.join(base, _MANIFEST)).decode())
 
 
+def materialize_with_barrier(store: Store, run_id: str,
+                             arrays: Dict[str, np.ndarray]) -> str:
+    """Rank-0 materialization with run_id agreement + completion barrier
+    (THE multi-process materialization protocol — flax Estimator and the
+    torch/keras estimators all share it).  Every rank must end up with
+    rank 0's run_id (clock-derived defaults can differ across ranks) and
+    must not read before rank 0 finished writing.  Returns the agreed
+    run_id."""
+    if core.is_initialized() and core.process_size() > 1:
+        from .. import eager
+
+        run_id = eager.broadcast_object(run_id)
+        if core.process_rank() == 0:
+            materialize_dataset(store, run_id, arrays)
+        eager.broadcast_object("materialized")  # barrier
+    else:
+        materialize_dataset(store, run_id, arrays)
+    return run_id
+
+
+def read_rows(store: Store, run_id: str, columns: List[str],
+              start: int, stop: int) -> List[np.ndarray]:
+    """Read global rows ``[start, stop)`` of each column, streaming only
+    the overlapping shards (a rank reading its own slice must not
+    download the whole dataset — the reference's petastorm reader shards
+    row groups by rank the same way)."""
+    manifest = read_manifest(store, run_id)
+    base = store.get_train_data_path(run_id)
+    parts: Dict[str, List[np.ndarray]] = {c: [] for c in columns}
+    off = 0
+    for shard in manifest["shards"]:
+        lo, hi = off, off + shard["rows"]
+        off = hi
+        if hi <= start or lo >= stop:
+            continue
+        with np.load(io.BytesIO(
+                store.read(os.path.join(base, shard["file"])))) as z:
+            s = max(start - lo, 0)
+            e = min(stop, hi) - lo
+            for c in columns:
+                parts[c].append(z[c][s:e])
+    return [
+        np.concatenate(parts[c]) if parts[c]
+        else np.zeros((0,) + tuple(manifest["columns"][c]["shape"]),
+                      np.dtype(manifest["columns"][c]["dtype"]))
+        for c in columns
+    ]
+
+
 class StoreLoader:
     """Iterate global batches from Store-resident shards.
 

@@ -122,20 +122,12 @@ class Estimator:
         if not core.is_initialized():
             core.init()
         if self.store is not None:
-            from .data import materialize_dataset
+            from .data import materialize_with_barrier
 
-            if core.process_size() > 1:
-                from .. import eager
-
-                if core.process_rank() == 0:
-                    materialize_dataset(
-                        self.store, self.run_id, {"x": x, "y": y},
-                    )
-                eager.broadcast_object("materialized")  # barrier
-            else:
-                materialize_dataset(
-                    self.store, self.run_id, {"x": x, "y": y},
-                )
+            self.run_id = materialize_with_barrier(
+                self.store, self.run_id,
+                {"x": np.asarray(x), "y": np.asarray(y)},
+            )
             return self.fit_on_store(
                 sample_shape=(2,) + tuple(np.asarray(x).shape[1:]),
                 dtype=np.asarray(x).dtype,

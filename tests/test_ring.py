@@ -294,6 +294,60 @@ def test_tf_adasum_delta_optimizer():
         np.testing.assert_allclose(res["weight"], expected, rtol=1e-5)
 
 
+def _worker_torch_estimator():
+    import os
+
+    import numpy as np
+
+    import jax
+    import horovod_tpu as hvd
+
+    hvd.init(devices=jax.devices("cpu"))
+    import torch
+
+    from horovod_tpu.estimator import Store, TorchEstimator
+
+    rng = np.random.default_rng(7)  # same data on every process
+    # 63 rows: does NOT divide by 2 processes or batch 8 — equal-length
+    # shards (drop_remainder) must keep the collective counts matched
+    x = rng.normal(size=(63, 6)).astype(np.float32)
+    w = rng.normal(size=(6, 1)).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    # a SHARED filesystem store: memory:// is per-process, so rank 1
+    # would never see rank 0's materialized shards
+    store = Store.create(os.environ["HVD_TEST_STORE"])
+    torch.manual_seed(0)
+    model = torch.nn.Linear(6, 1)
+    if hvd.process_rank() == 1:  # diverged init: broadcast must fix it
+        with torch.no_grad():
+            model.weight.fill_(9.0)
+    est = TorchEstimator(
+        model=model,
+        optimizer_factory=lambda ps: torch.optim.SGD(ps, lr=0.05),
+        loss=torch.nn.MSELoss(),
+        store=store, batch_size=8, epochs=8, run_id="mp", verbose=0,
+    )
+    fitted = est.fit(x, y)
+    return {
+        "rank": hvd.process_rank(),
+        "loss0": fitted.history[0]["loss"],
+        "lossN": fitted.history[-1]["loss"],
+        "weights": model.weight.detach().numpy().tolist(),
+    }
+
+
+def test_two_process_torch_estimator(tmp_path):
+    """Each process trains its own row shard; gradients average over the
+    host plane; final weights identical on both ranks (reference
+    test_spark_torch.py end-to-end estimator runs)."""
+    env = dict(_env(), HVD_TEST_STORE=str(tmp_path / "store"))
+    results = run(_worker_torch_estimator, np=2, extra_env=env)
+    r0, r1 = results
+    assert r0["lossN"] < r0["loss0"]
+    np.testing.assert_allclose(r0["weights"], r1["weights"], rtol=1e-5)
+
+
 def _worker_mxnet():
     """MXNet adapter across 2 real processes over the fake-mx shim —
     the binding's transport logic is identical to torch's, so this
