@@ -48,6 +48,9 @@ def parse_args(argv=None):
     parser.add_argument("--num-iters", type=int, default=3)
     parser.add_argument("--dtype", choices=["bfloat16", "float32"],
                         default="bfloat16")
+    parser.add_argument("--num-in-graph-steps", type=int, default=1,
+                        help="optimizer steps compiled into one program "
+                             "(lax.scan); amortizes host dispatch")
     return parser.parse_args(argv)
 
 
@@ -78,6 +81,7 @@ def run(args) -> dict:
         step = make_train_step(
             apply_fn=lambda v, x, train=True: model.apply(v, x),
             loss_fn=next_token_loss, optimizer=opt,
+            in_graph_steps=args.num_in_graph_steps,
         )
         # init with the hook-free twin (the attention_fn may need the mesh)
         init_twin = factory(dtype=dtype, max_len=max(args.seq_len, 1024))
@@ -160,7 +164,11 @@ def run(args) -> dict:
             state, loss = call(state)
         float(np.asarray(jax.device_get(loss)))
         dt = time.perf_counter() - t0
-        rate = n_batches * args.num_batches_per_iter / dt
+        # sp mode runs its own single-step program; in-graph scan applies
+        # to the data-parallel make_train_step path only
+        k = (max(args.num_in_graph_steps, 1)
+             if args.seq_parallel == "none" else 1)
+        rate = n_batches * k * args.num_batches_per_iter / dt
         log(f"Iter: sequences/sec total: {rate:.1f}")
         rates.append(rate)
 

@@ -54,11 +54,16 @@ def _load_process_shard(store, run_id, x, y):
     x = np.asarray(x)
     y = np.asarray(y)
     if store is not None:
-        from .data import materialize_with_barrier, read_rows
+        from .data import materialize_with_barrier, read_manifest, read_rows
 
         run_id = materialize_with_barrier(store, run_id,
                                           {"x": x, "y": y})
-        start, stop = _shard_range(x.shape[0])
+        # row count from the MANIFEST, not the local array: only rank
+        # 0's arrays were materialized, and a rank passing a
+        # different-length x would otherwise slice a wrong/unequal
+        # range and count-mismatch the gradient collectives
+        n = read_manifest(store, run_id)["n_rows"]
+        start, stop = _shard_range(n)
         xs, ys = read_rows(store, run_id, ["x", "y"], start, stop)
         return xs, ys, run_id
     start, stop = _shard_range(x.shape[0])

@@ -220,6 +220,10 @@ def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
             # the launcher hosts the server (port 0 bound locally — no
             # remote-port race); workers are clients only
             env["HVD_CONTROLLER_SERVER"] = "external"
+            # the address peers dial for THIS worker's ring listener:
+            # the launcher knows each worker's host; self-resolution
+            # (gethostname) can pick a wrong interface on multi-NIC VMs
+            env["HVD_RING_HOST"] = hostname
         if len(hosts) > 1:
             env[env_util.HVD_COORDINATOR_ADDR] = coordinator
         envs.append(env)

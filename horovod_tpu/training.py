@@ -30,6 +30,25 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
+def scan_steps(step_fn: Callable, k: int) -> Callable:
+    """Compile ``k`` optimizer steps into one program via ``lax.scan``
+    (amortizes per-step host dispatch — the round-2 ResNet profiling win,
+    docs/PERF.md; the transformer benches reuse it).  ``step_fn(carry,
+    *args) -> (carry, loss)``; the returned fn has the same signature and
+    yields the LAST step's loss.  ``k <= 1``: identity."""
+    if k <= 1:
+        return step_fn
+
+    def scanned(carry, *args):
+        def body(c, _):
+            return step_fn(c, *args)
+
+        carry, losses = jax.lax.scan(body, carry, None, length=k)
+        return carry, losses[-1]
+
+    return scanned
+
+
 def make_train_step(
     *,
     apply_fn: Callable,
@@ -108,16 +127,7 @@ def make_train_step(
                 loss,
             )
 
-        if in_graph_steps > 1:
-            def per_rank_entry(state: TrainState, x, y):
-                def body(s, _):
-                    return per_rank_step(s, x, y)
-                state, losses = jax.lax.scan(
-                    body, state, None, length=in_graph_steps
-                )
-                return state, losses[-1]
-        else:
-            per_rank_entry = per_rank_step
+        per_rank_entry = scan_steps(per_rank_step, in_graph_steps)
 
         # params/opt_state replicated; batch sharded across ranks on dim 0.
         state_spec = TrainState(

@@ -168,6 +168,8 @@ def test_worker_envs_controller_selection():
                        controller_addr="h1:9999")
     assert all(e["HVD_CONTROLLER"] == "native" for e in envs)
     assert all(e["HVD_CONTROLLER_ADDR"] == "h1:9999" for e in envs)
+    # each worker's ring listener is addressed by its launcher-known host
+    assert [e["HVD_RING_HOST"] for e in envs] == ["h1", "h2"]
     envs = worker_envs(slots, {}, "coord:1", controller="xla")
     assert all(e["HVD_CONTROLLER"] == "xla" for e in envs)
     assert all("HVD_CONTROLLER_ADDR" not in e for e in envs)
