@@ -663,10 +663,25 @@ class ControllerServer {
     std::vector<std::string> done;
     for (auto& [name, t] : table_) {
       int effective = t.count;
+      bool joined_filled = false;
       for (int r = 0; r < nranks_; ++r)
-        if (!t.ready[r] && joined_.count(r)) effective += 1;
+        if (!t.ready[r] && joined_.count(r)) {
+          effective += 1;
+          joined_filled = true;
+        }
       if (effective >= nranks_) {
         Response resp;
+        if (!t.error && joined_filled &&
+            (t.first.type == RequestType::kAllgather ||
+             t.first.type == RequestType::kBroadcast)) {
+          // a joined rank has no data to gather and no buffer shape to
+          // receive into (reference controller.cc:453-456,527-531:
+          // allgather/broadcast unsupported under Join)
+          t.error = true;
+          t.error_message =
+              "allgather/broadcast cannot complete for " + name +
+              " while ranks are joined (Join supports reduce ops only)";
+        }
         if (t.error) {
           resp.type = ResponseType::kError;
           resp.error_message = t.error_message;

@@ -257,6 +257,26 @@ class RingPlane {
     return 0;
   }
 
+  // Equal-block ring allgather: recv is n blocks of send_nbytes; after
+  // n-1 rotation steps every rank holds every block (reference
+  // GlooAllgather, gloo_operations.cc — same rotation).
+  int Allgather(const char* send, int64_t send_nbytes, char* recv,
+                int64_t recv_nbytes) {
+    if (recv_nbytes != send_nbytes * nranks_) return -1;
+    std::memcpy(recv + rank_ * send_nbytes, send,
+                static_cast<size_t>(send_nbytes));
+    if (nranks_ == 1) return 0;
+    if (left_fd_ < 0 || right_fd_ < 0) return -1;
+    for (int s = 0; s < nranks_ - 1; ++s) {
+      int send_i = (rank_ - s + nranks_) % nranks_;
+      int recv_i = (rank_ - s - 1 + nranks_) % nranks_;
+      if (!Step(recv + send_i * send_nbytes, send_nbytes,
+                recv + recv_i * send_nbytes, send_nbytes, nullptr, 0, 0))
+        return -1;
+    }
+    return 0;
+  }
+
   // Pipelined ring broadcast from `root`: root streams chunks right; each
   // rank forwards chunk k while receiving chunk k+1; the rank left of
   // root sinks.
@@ -387,6 +407,13 @@ int hvd_ring_allreduce(void* h, void* buf, long long nbytes, int dtype,
                        int op) {
   return static_cast<hvd::RingPlane*>(h)->Allreduce(
       static_cast<char*>(buf), nbytes, static_cast<uint8_t>(dtype), op);
+}
+
+int hvd_ring_allgather(void* h, const void* send, long long send_nbytes,
+                       void* recv, long long recv_nbytes) {
+  return static_cast<hvd::RingPlane*>(h)->Allgather(
+      static_cast<const char*>(send), send_nbytes,
+      static_cast<char*>(recv), recv_nbytes);
 }
 
 int hvd_ring_broadcast(void* h, void* buf, long long nbytes, int root) {

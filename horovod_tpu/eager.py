@@ -357,10 +357,33 @@ def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
     """Concatenate one numpy array per controller process along dim 0 —
     the shared transport bridge behind the torch/TF/MXNet bindings'
     allgather (varying first dimensions allowed; single-process:
-    identity)."""
+    identity).
+
+    Large EQUAL-shape gathers ride the ring (csrc/ring.cc Allgather —
+    (n−1)/n of the output per link, vs n× the payload through the
+    coordinator): a tiny metadata allgather agrees on shapes first, so
+    every rank makes the same transport choice; unequal shapes (the
+    allgatherv contract) stay on the pickle star."""
     arr = np.asarray(arr)
     if core.process_size() == 1:
         return arr
+    rx = eager_controller.ring()
+    c = eager_controller.client()
+    # only wire dtypes may negotiate (the coordinator sizes the op by
+    # its dtype table; anything else — strings, complex, int8 — must
+    # keep the pickle star path that has always carried it)
+    ring_dtype_ok = str(arr.dtype) in (
+        "float32", "float64", "int32", "int64", "bfloat16", "float16"
+    )
+    if rx is not None and c is not None and ring_dtype_ok:
+        nm = name or eager_controller.next_name("process_allgather")
+        metas = allgather_object((arr.shape, str(arr.dtype)),
+                                 name=f"{nm}.meta")
+        if all(m == metas[0] for m in metas) \
+                and arr.nbytes >= _RING_MIN_BYTES:
+            with inspector.watch(nm), timeline.span(nm, "RING_ALLGATHER"):
+                return rx.allgather(nm, arr)
+        name = nm  # reuse the agreed name for the star path
     return np.concatenate(
         [np.asarray(g) for g in allgather_object(arr, name=name)], axis=0
     )

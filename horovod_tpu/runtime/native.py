@@ -150,6 +150,11 @@ def load() -> ctypes.CDLL:
         lib.hvd_ring_broadcast.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
         ]
+        lib.hvd_ring_allgather.restype = ctypes.c_int
+        lib.hvd_ring_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_longlong,
+        ]
         lib.hvd_ring_close.restype = None
         lib.hvd_ring_close.argtypes = [ctypes.c_void_p]
 

@@ -120,6 +120,19 @@ class Ring:
             raise RuntimeError("ring broadcast failed")
         return buf
 
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        """Equal-block ring allgather: every rank's ``arr`` concatenated
+        on dim 0, one rotation per step (csrc/ring.cc Allgather)."""
+        arr = np.ascontiguousarray(arr)
+        out = np.empty((self.nranks,) + arr.shape, arr.dtype)
+        rc = self._lib.hvd_ring_allgather(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+            out.ctypes.data_as(ctypes.c_void_p), out.nbytes,
+        )
+        if rc != 0:
+            raise RuntimeError("ring allgather failed")
+        return out.reshape((self.nranks * arr.shape[0],) + arr.shape[1:])
+
     def close(self) -> None:
         if self._h:
             self._lib.hvd_ring_close(self._h)
@@ -166,6 +179,15 @@ class RingExecutor:
                            root=root)
         return fut.result(timeout=timeout)
 
+    def allgather(self, name: str, arr: np.ndarray,
+                  timeout: float = 60.0) -> np.ndarray:
+        """Equal-shape ring allgather under coordinator ordering; the
+        negotiation runs as type allgather, so Join restrictions apply
+        (the coordinator refuses gathers while ranks are joined)."""
+        fut = self._submit(name, np.ascontiguousarray(np.atleast_1d(arr)),
+                           "allgather", root=0)
+        return fut.result(timeout=timeout)
+
     def close(self) -> None:
         """Stop the dispatcher and free the native ring.  The ring is
         only freed after the dispatcher thread exits — freeing under an
@@ -181,18 +203,24 @@ class RingExecutor:
     # -- internals ----------------------------------------------------------
     def _submit(self, name: str, arr: np.ndarray, op: str,
                 root: int) -> Future:
-        tag = f"bcast{root}" if op == "broadcast" else _OP_TAGS[op]
+        if op == "broadcast":
+            tag = f"bcast{root}"
+        elif op == "allgather":
+            tag = "gather"
+        else:
+            tag = _OP_TAGS[op]
         name = f"{RING_PREFIX}{tag}:{name}"
         fut: Future = Future()
         with self._lock:
             if name in self._pending:
                 raise ValueError(f"ring op {name!r} already in flight")
             self._pending[name] = (arr, op, root, fut)
-        # negotiation request: broadcast negotiates as broadcast, the
-        # reduce ops as allreduce (min/max share the type; cross-rank
-        # op agreement is enforced by MetaKey's name match + the local
-        # subgroup key, and all ranks pass the same op for one name).
-        req_op = "broadcast" if op == "broadcast" else "allreduce"
+        # negotiation request: broadcast/allgather negotiate as their own
+        # types (Join restrictions apply), the reduce ops as allreduce
+        # (min/max share the type; cross-rank op agreement is enforced by
+        # MetaKey's name match + the local subgroup key, and all ranks
+        # pass the same op for one name).
+        req_op = op if op in ("broadcast", "allgather") else "allreduce"
         self._client.submit(
             name, op=req_op, shape=arr.shape, dtype=str(arr.dtype),
             root_rank=root,
@@ -323,10 +351,15 @@ class RingExecutor:
                 # Joined rank: participate with the op's identity element
                 # so the ring stays connected (reference Join semantics,
                 # controller.cc:253-264: joined ranks are implicit
-                # members).
+                # members).  gather/bcast cannot reach here under Join —
+                # the coordinator errors them — but keep the ring alive
+                # defensively with a zero block.
                 if tag.startswith("bcast"):
                     arr = np.zeros(max(nbytes, 0), np.uint8)
                     op, root = "broadcast", int(tag[len("bcast"):])
+                elif tag == "gather":
+                    arr = np.zeros(max(nbytes, 0), np.uint8)
+                    op, root = "allgather", 0
                 else:
                     op = _TAG_OPS.get(tag, "allreduce")
                     arr = self._identity(op, dtype_code, nbytes)
@@ -346,6 +379,8 @@ class RingExecutor:
                 buf = bytearray(arr.tobytes())
                 self._ring.broadcast(buf, root)
                 out = np.frombuffer(buf, arr.dtype).reshape(arr.shape)
+            elif op == "allgather":
+                out = self._ring.allgather(arr)
             else:
                 out = self._ring.allreduce(arr, op=op)
             if fut is not None:

@@ -81,6 +81,25 @@ def _worker_ring_ops():
     bc = eager.process_broadcast(payload, root_rank=1, name="ring.bc.t")
     out["bcast_ok"] = bool(np.allclose(
         bc, np.arange(50_000, dtype=np.float32)))
+
+    # equal-shape large allgather rides the ring
+    rows = np.full((5_000, 4), float(r), np.float32)
+    g = eager.process_allgather(rows, name="ring.ag.t")
+    out["gather_ok"] = bool(
+        g.shape == (5_000 * n, 4)
+        and all(np.allclose(g[5_000 * i: 5_000 * (i + 1)], float(i))
+                for i in range(n))
+    )
+    # unequal first dims fall back to the star, same contract: rank i
+    # contributes i+1 rows of value i, concatenated in rank order
+    var = np.full((r + 1, 2), float(r), np.float32)
+    gv = eager.process_allgather(var, name="ring.agv.t")
+    expected_v = np.concatenate(
+        [np.full((i + 1, 2), float(i), np.float32) for i in range(n)]
+    )
+    out["gatherv_ok"] = bool(
+        gv.shape == expected_v.shape and np.allclose(gv, expected_v)
+    )
     return out
 
 
@@ -91,7 +110,7 @@ def test_ring_allreduce_ops(np_):
         assert res["rank"] == r
         assert res["ring"], "ring plane failed to establish"
         for key in ("sum_ok", "avg_ok", "min_ok", "max_ok", "f64_ok",
-                    "bcast_ok"):
+                    "bcast_ok", "gather_ok", "gatherv_ok"):
             assert res[key], f"{key} failed on rank {r}"
         assert res["small_sum"] == sum(range(1, np_ + 1))
 
