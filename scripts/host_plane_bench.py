@@ -51,11 +51,25 @@ def _allreduce_worker(payload_mb: float, iters: int):
     for i in range(iters):
         eager.process_allreduce(arr, op=hvd.Sum, name=f"bench.{i}")
     dt = time.perf_counter() - t0
+
+    # allgather + broadcast on a payload/size()-sized shard so the
+    # OUTPUT volume matches the allreduce payload
+    shard = arr[: n // hvd.process_size()]
+    t1 = time.perf_counter()
+    for i in range(iters):
+        eager.process_allgather(shard, name=f"ag.{i}")
+    ag_dt = (time.perf_counter() - t1) / iters
+    t2 = time.perf_counter()
+    for i in range(iters):
+        eager.process_broadcast(arr, root_rank=0, name=f"bc.{i}")
+    bc_dt = (time.perf_counter() - t2) / iters
     return {
         "rank": hvd.process_rank(),
         "ring": eager_controller.ring() is not None,
         "seconds_per_allreduce": dt / iters,
         "gb_per_sec": arr.nbytes / (dt / iters) / 1e9,
+        "seconds_per_allgather": ag_dt,
+        "seconds_per_broadcast": bc_dt,
     }
 
 
@@ -124,6 +138,10 @@ def bench_allreduce(np_: int, payload_mb: float, iters: int, ring: bool):
         "transport": "ring" if ring else "star",
         "payload_mb": payload_mb,
         "seconds_per_allreduce": sec,
+        "seconds_per_allgather": max(
+            r["seconds_per_allgather"] for r in res),
+        "seconds_per_broadcast": max(
+            r["seconds_per_broadcast"] for r in res),
         "gb_per_sec_per_rank": per_rank,
         # on one host all ranks share loopback + memory bandwidth, so the
         # scalability signal is the AGGREGATE staying flat as np grows
