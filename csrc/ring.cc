@@ -186,6 +186,11 @@ class RingPlane {
       if (::poll(&p, 1, 100) > 0 && (p.revents & POLLIN)) {
         int fd = ::accept(listen_fd_, nullptr, nullptr);
         if (fd < 0) continue;
+        // the accepted fd is still blocking here: without a receive
+        // timeout a stray connection that sends no hello would wedge
+        // Connect (and rank startup) past the intended deadline
+        timeval tv{2, 0};
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
         int32_t peer = -1;
         if (::recv(fd, &peer, 4, MSG_WAITALL) == 4 &&
             peer == (rank_ - 1 + nranks_) % nranks_) {

@@ -117,9 +117,12 @@ def allgather_(tensors, *, name: Optional[str] = None):
                 return collectives.allgather(v[0])
 
         out = _spmd_op(body, out_sharded=False)(x)
-        out = jax.device_get(out)
+        out = np.asarray(jax.device_get(out))
         if as_list:
-            return [np.asarray(out)] * core.size()
+            # independent per-rank outputs (reference semantics: each rank
+            # owns its gathered buffer) — aliasing one ndarray N times would
+            # let a caller's mutation of result[0] corrupt every "rank"
+            return [out.copy() for _ in range(core.size())]
         return out
 
 
@@ -193,6 +196,13 @@ def _sum_rows_fn(pmesh):
                    out_shardings=NamedSharding(pmesh, P()))
 
 
+@_functools.lru_cache(maxsize=64)
+def _reduce_rows_fn(pmesh, kind: str):
+    red = {"min": jnp.min, "max": jnp.max}[kind]
+    return jax.jit(lambda x: red(x, axis=0),
+                   out_shardings=NamedSharding(pmesh, P()))
+
+
 def _mesh_rows_array(row: np.ndarray):
     """The per-process ``row`` assembled as an ``[nproc, ...]`` global
     array sharded one-row-per-process over the job mesh's backend.
@@ -221,6 +231,12 @@ def _mesh_sum_rows(row: np.ndarray) -> np.ndarray:
     wire/memory (an allreduce), unlike the O(nproc x payload) gather."""
     pmesh, garr = _mesh_rows_array(row)
     return np.asarray(_sum_rows_fn(pmesh)(garr).addressable_data(0))
+
+
+def _mesh_minmax_rows(row: np.ndarray, kind: str) -> np.ndarray:
+    """Elementwise min/max of one row per process, replicated."""
+    pmesh, garr = _mesh_rows_array(row)
+    return np.asarray(_reduce_rows_fn(pmesh, kind)(garr).addressable_data(0))
 
 
 def broadcast_object(obj: Any, root_rank: int = 0, *, name: Optional[str] = None):
@@ -292,6 +308,10 @@ _RING_MIN_BYTES = 1 << 15
 _WIRE_OPS = {Average: "allreduce", Sum: "allreduce", Min: "min",
              Max: "max", Adasum: "adasum"}
 
+# dtypes the native coordinator and the XLA process mesh can carry as raw
+# numeric payloads; anything else is cast (reductions) or pickled (gathers)
+_WIRE_DTYPES = ("float32", "float64", "int32", "int64", "bfloat16", "float16")
+
 
 def process_allreduce(arr, *, op: str = Average,
                       name: Optional[str] = None) -> np.ndarray:
@@ -314,10 +334,9 @@ def process_allreduce(arr, *, op: str = Average,
         return arr
     c = eager_controller.client()
     if c is not None:
+        wire = arr if str(arr.dtype) in _WIRE_DTYPES \
+            else arr.astype(np.float32)
         nm = name or eager_controller.next_name("process_allreduce")
-        wire = arr if str(arr.dtype) in (
-            "float32", "float64", "int32", "int64", "bfloat16", "float16"
-        ) else arr.astype(np.float32)
         wire_op = _WIRE_OPS[op]
         rx = eager_controller.ring()
         use_ring = (rx is not None
@@ -329,28 +348,55 @@ def process_allreduce(arr, *, op: str = Average,
         activity = "RING_ALLREDUCE" if use_ring else "STAR_ALLREDUCE"
         with inspector.watch(nm), timeline.span(nm, activity):
             if use_ring:
-                out = rx.allreduce(nm, np.array(wire, copy=True),
-                                   op=wire_op)
+                # RingExecutor copies at submit; no defensive copy here
+                out = rx.allreduce(nm, wire, op=wire_op)
             else:
                 out = c.allreduce_data(nm, wire, op=wire_op)
         if op == Average:
             out = out / core.process_size()
         return out.astype(arr.dtype) if out.dtype != arr.dtype else out
-    gathered = allgather_object(arr, name=name)
-    stacked = np.stack([np.asarray(g) for g in gathered])
-    if op == Average:
-        out = stacked.mean(0)
-    elif op == Sum:
-        out = stacked.sum(0)
-    elif op == Min:
-        out = stacked.min(0)
-    elif op == Max:
-        out = stacked.max(0)
-    else:  # Adasum
-        from .ops.adasum import numpy_adasum
+    # No native controller, so the XLA plane spans the job (jax.distributed
+    # pod — the only other transport process_size()>1 can stand on).
+    # Reductions ride the process mesh as an O(payload) XLA allreduce —
+    # never a pickled O(nproc·payload) gather — matching the reference's
+    # CPU path, which is always a Gloo ring/halving-doubling (reference
+    # horovod/common/ops/gloo_operations.cc:120-158).
+    if str(arr.dtype) not in _WIRE_DTYPES:
+        # exotic dtypes (complex, object...) cannot ride the mesh without
+        # a lossy cast; reduce the pickled gather exactly, as before
+        stacked = np.stack(
+            [np.asarray(g) for g in allgather_object(arr, name=name)]
+        )
+        if op == Average:
+            out = stacked.mean(0)
+        elif op == Sum:
+            out = stacked.sum(0)
+        elif op == Min:
+            out = stacked.min(0)
+        elif op == Max:
+            out = stacked.max(0)
+        else:  # Adasum
+            from .ops.adasum import numpy_adasum
 
-        out = numpy_adasum(list(stacked))
-    return out.astype(arr.dtype)
+            out = numpy_adasum(list(stacked))
+        return out.astype(arr.dtype)
+    wire = arr  # wire dtype guaranteed by the branch above
+    nm = name or eager_controller.next_name("process_allreduce")
+    with inspector.watch(nm), timeline.span(nm, "MESH_ALLREDUCE"):
+        if op in (Average, Sum):
+            out = _mesh_sum_rows(wire)
+            if op == Average:
+                out = out / core.process_size()
+        elif op in (Min, Max):
+            out = _mesh_minmax_rows(wire, "min" if op == Min else "max")
+        else:  # Adasum: VHDD needs every row's dot products, so the
+            # O(nproc·payload) gather is inherent — but the transport is
+            # the XLA-plane gather, not pickle
+            from .ops.adasum import numpy_adasum
+
+            out = numpy_adasum(list(_mesh_allgather_rows(wire)))
+    out = np.asarray(out)
+    return out.astype(arr.dtype) if out.dtype != arr.dtype else out
 
 
 def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
@@ -369,23 +415,50 @@ def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
         return arr
     rx = eager_controller.ring()
     c = eager_controller.client()
-    # only wire dtypes may negotiate (the coordinator sizes the op by
-    # its dtype table; anything else — strings, complex, int8 — must
-    # keep the pickle star path that has always carried it)
-    ring_dtype_ok = str(arr.dtype) in (
-        "float32", "float64", "int32", "int64", "bfloat16", "float16"
-    )
-    if rx is not None and c is not None and ring_dtype_ok:
-        nm = name or eager_controller.next_name("process_allgather")
-        metas = allgather_object((arr.shape, str(arr.dtype)),
-                                 name=f"{nm}.meta")
-        if all(m == metas[0] for m in metas) \
-                and arr.nbytes >= _RING_MIN_BYTES:
-            with inspector.watch(nm), timeline.span(nm, "RING_ALLGATHER"):
-                return rx.allgather(nm, arr)
-        name = nm  # reuse the agreed name for the star path
+    nm = name or eager_controller.next_name("process_allgather")
+    # Every rank ALWAYS runs the tiny dtype-agnostic metadata allgather
+    # and derives the transport from the GATHERED facts — a rank-local
+    # decision here (e.g. keyed on the local dtype) would let mismatched
+    # inputs send ranks down different branches and hang the job instead
+    # of raising.
+    metas = allgather_object((tuple(arr.shape), str(arr.dtype)),
+                             name=f"{nm}.meta")
+    shapes = [tuple(m[0]) for m in metas]
+    dtypes = [m[1] for m in metas]
+    if len(set(dtypes)) > 1:
+        # explicit cross-rank validation, like the reference coordinator's
+        # dtype-mismatch ERROR response (reference controller.cc:377-610)
+        raise ValueError(
+            f"process_allgather dtype mismatch across ranks: {dtypes}"
+        )
+    if len({len(s) for s in shapes}) > 1 or \
+            any(s[1:] != shapes[0][1:] for s in shapes):
+        raise ValueError(
+            "process_allgather shape mismatch across ranks (all dims but "
+            f"the first must agree): {shapes}"
+        )
+    wire_ok = dtypes[0] in _WIRE_DTYPES
+    equal = all(s == shapes[0] for s in shapes)
+    if rx is not None and c is not None and wire_ok and equal \
+            and arr.nbytes >= _RING_MIN_BYTES:
+        with inspector.watch(nm), timeline.span(nm, "RING_ALLGATHER"):
+            return rx.allgather(nm, arr)
+    if c is None and wire_ok and len(shapes[0]) >= 1:
+        # jax.distributed pod without the native plane: rows ride the
+        # process mesh (XLA gather), pickle stays for true objects only.
+        # Varying first dims pad to the longest row, then slice back —
+        # the allgatherv contract.
+        with inspector.watch(nm), timeline.span(nm, "MESH_ALLGATHER"):
+            first = [s[0] for s in shapes]
+            maxn = max(first)
+            padded = np.zeros((maxn,) + shapes[0][1:], arr.dtype)
+            padded[: arr.shape[0]] = arr
+            rows = _mesh_allgather_rows(padded)
+            return np.concatenate(
+                [rows[i, : first[i]] for i in range(len(first))], axis=0
+            )
     return np.concatenate(
-        [np.asarray(g) for g in allgather_object(arr, name=name)], axis=0
+        [np.asarray(g) for g in allgather_object(arr, name=nm)], axis=0
     )
 
 

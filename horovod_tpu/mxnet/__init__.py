@@ -81,13 +81,33 @@ def broadcast_(tensor, root_rank: int = 0, name=None, priority=0):
     return tensor
 
 
+def _append_broadcast_init(param, root_rank: int, name: str):
+    """Wrap ``param._init_impl`` so the data is broadcast from
+    ``root_rank`` right after deferred initialization fires (reference
+    mxnet/__init__.py:138-145 _append_broadcast_init: same injection,
+    minus the explicit wait_to_read — this plane is synchronous)."""
+    init_impl = getattr(param, "_init_impl")
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank,
+                   name=f"parameter.{name}")
+
+    return wrapped_init_impl
+
+
 def broadcast_parameters(params, root_rank: int = 0) -> None:
-    """reference mxnet/__init__.py broadcast_parameters: accepts a gluon
-    ParameterDict or a dict of NDArrays; in-place."""
+    """reference mxnet/__init__.py broadcast_parameters (:148-183):
+    accepts a gluon ParameterDict or a dict of NDArrays; in-place.
+    Shape-deferred parameters get the reference's post-init broadcast
+    hook injected into ``_init_impl`` so every rank converges to root's
+    init once the first forward pass materializes them."""
     if hasattr(params, "items"):
         items = sorted(params.items())
     else:
         raise ValueError("invalid params of type: %s" % type(params))
+    import types as _types
+
     from ..utils.logging import get_logger
 
     log = get_logger(__name__)
@@ -96,13 +116,20 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
             try:
                 tensor = p.data()
             except mx.gluon.parameter.DeferredInitializationError:
-                # shape-deferred param: skipping silently would leave each
-                # rank on its own init — tell the user to run a forward
-                # pass (or initialize) before broadcasting
-                log.warning(
-                    "broadcast_parameters: %s is deferred-initialized and "
-                    "was NOT broadcast; run a forward pass first", name,
-                )
+                if hasattr(p, "_init_impl"):
+                    # reference behavior: broadcast fires after the
+                    # deferred init materializes the data
+                    p._init_impl = _types.MethodType(
+                        _append_broadcast_init(p, root_rank, name), p
+                    )
+                else:
+                    # no injection point: skipping silently would leave
+                    # each rank on its own init — tell the user
+                    log.warning(
+                        "broadcast_parameters: %s is deferred-initialized "
+                        "and was NOT broadcast; run a forward pass first",
+                        name,
+                    )
                 continue
         else:
             tensor = p

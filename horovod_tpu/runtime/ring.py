@@ -100,7 +100,13 @@ class Ring:
             )
 
     def allreduce(self, arr: np.ndarray, op: str = "allreduce") -> np.ndarray:
-        """In-place ring allreduce; returns the (mutated) array."""
+        """In-place ring allreduce; returns the (mutated) array.
+
+        IN-PLACE CONTRACT: a contiguous input is reduced in its own
+        buffer (``np.ascontiguousarray`` aliases it); callers that need
+        their input preserved must pass a copy.  ``RingExecutor`` copies
+        at submit time, so only direct ``Ring`` users carry this burden.
+        """
         arr = np.ascontiguousarray(arr)
         rc = self._lib.hvd_ring_allreduce(
             self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
@@ -169,8 +175,10 @@ class RingExecutor:
     # -- public API ---------------------------------------------------------
     def allreduce(self, name: str, arr: np.ndarray, *,
                   op: str = "allreduce", timeout: float = 60.0) -> np.ndarray:
-        """Ring allreduce of ``arr`` under coordinator ordering (blocking)."""
-        fut = self._submit(name, np.ascontiguousarray(arr), op, root=0)
+        """Ring allreduce of ``arr`` under coordinator ordering (blocking).
+        The input is copied at submit time — the native ring reduces in
+        place (Ring.allreduce), and the caller's buffer must survive."""
+        fut = self._submit(name, np.array(arr, copy=True), op, root=0)
         return fut.result(timeout=timeout)
 
     def broadcast(self, name: str, arr: np.ndarray, root: int,
@@ -221,10 +229,18 @@ class RingExecutor:
         # MetaKey's name match + the local subgroup key, and all ranks
         # pass the same op for one name).
         req_op = op if op in ("broadcast", "allgather") else "allreduce"
-        self._client.submit(
-            name, op=req_op, shape=arr.shape, dtype=str(arr.dtype),
-            root_rank=root,
-        )
+        try:
+            self._client.submit(
+                name, op=req_op, shape=arr.shape, dtype=str(arr.dtype),
+                root_rank=root,
+            )
+        except BaseException as e:  # noqa: BLE001 — connection lost etc.
+            # unwind the pending entry so a retry under the same name is
+            # not rejected as "already in flight" and the Future resolves
+            with self._lock:
+                self._pending.pop(name, None)
+            fut.set_exception(e)
+            raise
         return fut
 
     def _loop(self) -> None:

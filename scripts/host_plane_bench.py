@@ -200,15 +200,31 @@ def main() -> None:
         # ranks oversubscribe the cores: total throughput cannot exceed
         # the single-process number on a 1-core host, so this isolates
         # the framework's communication overhead from core sharing)
+        core_bound = np_ > ncores
+        r["core_bound"] = core_bound
+        # per-rank efficiency vs np=1 is the reference's scaling metric —
+        # it is only MEANINGFUL when every rank has its own core(s).  On a
+        # core-bound row it measures timesharing, not transport, so it is
+        # nulled out loudly rather than committed as a fake regression.
         r["scaling_efficiency_vs_np1"] = (
-            r["img_per_sec_per_rank"] / base_total
+            None if core_bound else r["img_per_sec_per_rank"] / base_total
         )
         ceiling = base_total * min(np_, ncores)
         r["fraction_of_core_ceiling"] = r["img_per_sec_total"] / ceiling
         out["train"].append(r)
+        marker = (f"  [CORE-BOUND: {np_} ranks on {ncores} core(s); "
+                  "per-rank efficiency N/A]" if core_bound else "")
         print(f"train np={np_}: {r['img_per_sec_total']:.1f} img/s total, "
               f"{r['fraction_of_core_ceiling']:.0%} of the "
-              f"{ncores}-core compute ceiling")
+              f"{ncores}-core compute ceiling{marker}")
+    if any(t["core_bound"] for t in out["train"]):
+        out["config"]["train_note"] = (
+            f"host has {ncores} core(s): train rows with np > cores are "
+            "CORE-BOUND — they measure CPU timesharing, not the transport; "
+            "scaling_efficiency_vs_np1 is null there by design and "
+            "fraction_of_core_ceiling is the honest compute-normalized "
+            "metric (1.0 = communication overhead fully hidden)"
+        )
 
     dest = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out")
     os.makedirs(dest, exist_ok=True)

@@ -13,6 +13,7 @@ import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 from horovod_tpu.run.run import run
@@ -303,6 +304,41 @@ def _worker_jax_distributed():
     out["compiled_sum"] = float(
         np.asarray(res.addressable_data(0)).reshape(-1)[0]
     )
+
+    # --- transport assertion (round-4 VERDICT #2): on a jax.distributed
+    # pod without the native controller, numeric reductions must ride the
+    # process mesh (O(payload) XLA ops) — NEVER the pickled
+    # allgather_object star.  Count pickle-path entries directly.
+    calls = {"n": 0}
+    orig_ag = eager.allgather_object
+
+    def counting_ag(obj, *, name=None):
+        calls["n"] += 1
+        return orig_ag(obj, name=name)
+
+    eager.allgather_object = counting_ag
+    try:
+        big = np.full(100_000, float(r + 1), np.float32)
+        s = eager.process_allreduce(big, op=hvd.Sum, name="mesh.sum")
+        out["mesh_sum_ok"] = bool(np.allclose(s, 3.0))
+        mn = eager.process_allreduce(big, op=hvd.Min, name="mesh.min")
+        out["mesh_min_ok"] = bool(np.allclose(mn, 1.0))
+        ad = eager.process_allreduce(
+            np.asarray([1.0 + r, -2.0, 0.5 * r], np.float32),
+            op=hvd.Adasum, name="mesh.adasum")
+        out["mesh_adasum"] = [float(v) for v in ad]
+        out["pickle_calls_allreduce"] = calls["n"]  # must be 0
+        rows = np.full((r + 2, 3), float(r), np.float32)
+        g = eager.process_allgather(rows, name="mesh.ag")
+        out["mesh_gather_ok"] = bool(
+            g.shape == (5, 3)
+            and np.allclose(g[:2], 0.0) and np.allclose(g[2:], 1.0)
+        )
+        # exactly one pickle entry: the tiny (shape, dtype) metadata
+        # gather every rank runs to agree on the transport
+        out["pickle_calls_allgather"] = calls["n"]
+    finally:
+        eager.allgather_object = orig_ag
     return out
 
 
@@ -351,3 +387,17 @@ def test_two_process_jax_distributed_plane():
         assert res["gathered"] == ["p0", "p1p1"]
         assert res["sum"] == 3.0
         assert res["compiled_sum"] == 1.0 + 2 + 3 + 4
+        assert res["mesh_sum_ok"] and res["mesh_min_ok"]
+        assert res["mesh_gather_ok"]
+        assert res["pickle_calls_allreduce"] == 0, \
+            "gradient allreduce took the pickled star, not the mesh"
+        assert res["pickle_calls_allgather"] == 1, \
+            "payload allgather should pickle only the metadata tuple"
+    from horovod_tpu.ops.adasum import numpy_adasum
+
+    expected_adasum = numpy_adasum([
+        np.asarray([1.0 + r, -2.0, 0.5 * r], np.float32) for r in range(2)
+    ])
+    for res in results:
+        np.testing.assert_allclose(
+            res["mesh_adasum"], expected_adasum, rtol=1e-5)

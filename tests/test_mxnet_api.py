@@ -105,3 +105,24 @@ def test_distributed_optimizer_raises(binding):
     _, hvd_mx = binding
     with pytest.raises(NotImplementedError):
         hvd_mx.DistributedOptimizer()
+
+
+def test_broadcast_parameters_deferred_init(binding):
+    """A shape-deferred parameter is NOT skipped: broadcast_parameters
+    injects the reference's post-init hook (_append_broadcast_init,
+    reference mxnet/__init__.py:138-145,167-171) so the broadcast fires
+    the moment deferred initialization materializes the data."""
+    mx, hvd_mx = binding
+    from mxnet.gluon.parameter import Parameter
+
+    p = Parameter("w")  # deferred: data() raises until _init_impl
+    with pytest.raises(mx.gluon.parameter.DeferredInitializationError):
+        p.data()
+    hvd_mx.broadcast_parameters({"w": p}, root_rank=0)
+    # still deferred — nothing broadcast yet, no crash
+    with pytest.raises(mx.gluon.parameter.DeferredInitializationError):
+        p.data()
+    # the deferred init fires (a forward pass in real gluon): the
+    # injected hook must broadcast right after
+    p._init_impl(np.asarray([7.0, 8.0], np.float32))
+    assert p.data().asnumpy().tolist() == [7.0, 8.0]

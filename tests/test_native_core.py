@@ -75,13 +75,40 @@ def test_dtype_mismatch_is_error(server):
 
 
 def test_duplicate_submission_is_error(server):
+    """Duplicate in-flight names are rejected (reference common.h:160-163).
+
+    Deterministic by construction: only rank 0 submits, so the
+    negotiation can never complete and ``grad.z`` is still in flight when
+    the duplicate arrives.  The coordinator fail-fasts the error response
+    (the reference rejects duplicates at enqueue time, not at negotiation
+    completion) — submitting from both ranks here would race the first
+    cycle's completion and make the guard flaky."""
     c0, c1 = _client(server, 0), _client(server, 1)
     try:
         c0.submit("grad.z", shape=(4,))
         c0.submit("grad.z", shape=(4,))
-        c1.submit("grad.z", shape=(4,))
         with pytest.raises(RuntimeError, match="Duplicate"):
             c0.wait("grad.z", timeout=5)
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_duplicate_error_is_targeted_and_negotiation_survives(server):
+    """Reference semantics (common.h:160-163): the duplicate enqueue
+    errors at the OFFENDING rank only; the first submission stays in
+    flight.  After rank 0 consumes its targeted error, rank 1 joins the
+    (still-alive) negotiation and BOTH ranks complete normally — and
+    rank 1 never sees a stale error it did not cause."""
+    c0, c1 = _client(server, 0), _client(server, 1)
+    try:
+        c0.submit("grad.d", shape=(4,))
+        c0.submit("grad.d", shape=(4,))  # duplicate from rank 0
+        with pytest.raises(RuntimeError, match="Duplicate"):
+            c0.wait("grad.d", timeout=5)
+        c1.submit("grad.d", shape=(4,))
+        assert c1.wait("grad.d", timeout=5) == ["grad.d"]
+        assert c0.wait("grad.d", timeout=5) == ["grad.d"]
     finally:
         c0.close()
         c1.close()

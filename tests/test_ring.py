@@ -226,6 +226,65 @@ def test_concurrent_out_of_order_ring_ops():
         assert res["ok"], res
 
 
+def _worker_soak():
+    """np=4 soak: a mixed bag of ring ops (large payloads) and star ops
+    (small payloads) across Sum/Min/Max, fired from threads in a
+    DIFFERENT shuffled order on every rank.  The coordinator's response
+    stream must serialize the ring transfers identically everywhere while
+    star ops interleave freely — the combined stress of out-of-order
+    submission, transport mixing, and fusion bucketing (reference
+    test/test_torch.py:237 fused async stress)."""
+    import random
+    import threading
+
+    import numpy as np
+
+    import jax
+    import horovod_tpu as hvd
+    from horovod_tpu import eager
+
+    hvd.init(devices=jax.devices("cpu"))
+    r = hvd.process_rank()
+    n = hvd.process_size()
+
+    results = {}
+    lock = threading.Lock()
+    kinds = [hvd.Sum, hvd.Min, hvd.Max]
+
+    def one(i):
+        op = kinds[i % 3]
+        size = 20_000 if i % 2 == 0 else 16  # ring vs star transport
+        arr = np.full(size, float((i + 1) * (r + 1)), np.float32)
+        out = eager.process_allreduce(arr, op=op, name=f"soak.{i}")
+        with lock:
+            results[i] = float(out[0])
+
+    order = list(range(12))
+    random.Random(r).shuffle(order)  # rank-specific submission order
+    threads = [threading.Thread(target=one, args=(i,)) for i in order]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    expected = {}
+    for i in range(12):
+        op = kinds[i % 3]
+        if op == hvd.Sum:
+            expected[i] = float((i + 1) * sum(range(1, n + 1)))
+        elif op == hvd.Min:
+            expected[i] = float(i + 1)
+        else:
+            expected[i] = float((i + 1) * n)
+    return {"rank": r, "ok": results == expected,
+            "got": results, "want": expected}
+
+
+def test_soak_mixed_ring_star_np4():
+    results = run(_worker_soak, np=4, extra_env=_env())
+    for res in results:
+        assert res["ok"], res
+
+
 def _worker_adasum_delta():
     import numpy as np
 
