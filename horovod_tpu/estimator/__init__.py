@@ -9,4 +9,8 @@ def __getattr__(name):
         from . import frameworks
 
         return getattr(frameworks, name)
+    if name in ("prepare_data", "read_schema"):
+        from . import dataframe
+
+        return getattr(dataframe, name)
     raise AttributeError(name)

@@ -31,9 +31,10 @@ _MANIFEST = "_manifest.json"
 
 def materialize_dataset(store: Store, run_id: str,
                         arrays: Dict[str, np.ndarray], *,
-                        rows_per_shard: int = 65536) -> dict:
-    """Write ``arrays`` (equal first dims) into
-    ``store.get_train_data_path(run_id)`` as npz shards + a manifest.
+                        rows_per_shard: int = 65536,
+                        path: str = None) -> dict:
+    """Write ``arrays`` (equal first dims) into ``path`` (default:
+    ``store.get_train_data_path(run_id)``) as npz shards + a manifest.
     Returns the manifest (reference util.py returns dataset metadata —
     row counts, schema — the same facts)."""
     names = list(arrays)
@@ -45,7 +46,7 @@ def materialize_dataset(store: Store, run_id: str,
             raise ValueError(
                 f"array {k!r} first dim {np.asarray(a).shape[0]} != {n}"
             )
-    base = store.get_train_data_path(run_id)
+    base = path or store.get_train_data_path(run_id)
     shards = []
     for i, start in enumerate(range(0, n, rows_per_shard)):
         buf = io.BytesIO()
@@ -74,8 +75,8 @@ def materialize_dataset(store: Store, run_id: str,
     return manifest
 
 
-def read_manifest(store: Store, run_id: str) -> dict:
-    base = store.get_train_data_path(run_id)
+def read_manifest(store: Store, run_id: str, *, path: str = None) -> dict:
+    base = path or store.get_train_data_path(run_id)
     return json.loads(store.read(os.path.join(base, _MANIFEST)).decode())
 
 
@@ -100,13 +101,14 @@ def materialize_with_barrier(store: Store, run_id: str,
 
 
 def read_rows(store: Store, run_id: str, columns: List[str],
-              start: int, stop: int) -> List[np.ndarray]:
+              start: int, stop: int, *,
+              path: str = None) -> List[np.ndarray]:
     """Read global rows ``[start, stop)`` of each column, streaming only
     the overlapping shards (a rank reading its own slice must not
     download the whole dataset — the reference's petastorm reader shards
     row groups by rank the same way)."""
-    manifest = read_manifest(store, run_id)
-    base = store.get_train_data_path(run_id)
+    manifest = read_manifest(store, run_id, path=path)
+    base = path or store.get_train_data_path(run_id)
     parts: Dict[str, List[np.ndarray]] = {c: [] for c in columns}
     off = 0
     for shard in manifest["shards"]:

@@ -46,6 +46,93 @@ def _shard_range(n: int) -> tuple:
     return r * per, (r + 1) * per
 
 
+def _is_dataframe(obj) -> bool:
+    """Duck-typed DataFrame detection: pyspark DataFrames (and the test
+    stub) expose .columns + .collect(); arrays do not."""
+    return hasattr(obj, "collect") and hasattr(obj, "columns")
+
+
+def _prepare_df_with_barrier(store, run_id, df, label_cols, feature_cols,
+                             validation):
+    """Rank-0 DataFrame ingestion with run_id agreement + completion
+    barrier (the multi-process shape of reference prepare_data, which
+    runs once on the Spark driver before the gang trains from the
+    Store).  Schema-validation errors raised on rank 0 are re-raised on
+    EVERY rank — the alternative is n-1 ranks hanging on a barrier for a
+    dataset that will never exist.  Returns (run_id, n_val_rows)."""
+    from .dataframe import prepare_data
+
+    if core.is_initialized() and core.process_size() > 1:
+        from .. import eager
+
+        run_id = eager.broadcast_object(run_id)
+        outcome = ("ok", 0)
+        if core.process_rank() == 0:
+            try:
+                manifest = prepare_data(
+                    store, df, label_cols, feature_cols,
+                    run_id=run_id, validation=validation,
+                )
+                outcome = ("ok", int(manifest.get("n_val_rows", 0)))
+            except Exception as e:  # noqa: BLE001 — re-raised everywhere
+                outcome = ("err", f"{type(e).__name__}: {e}")
+        outcome = eager.broadcast_object(outcome)  # doubles as barrier
+        if outcome[0] == "err":
+            raise ValueError(
+                f"DataFrame ingestion failed on rank 0: {outcome[1]}"
+            )
+        return run_id, outcome[1]
+    manifest = prepare_data(
+        store, df, label_cols, feature_cols,
+        run_id=run_id, validation=validation,
+    )
+    return run_id, int(manifest.get("n_val_rows", 0))
+
+
+def _load_df_shards(store, run_id, n_val):
+    """This process's train rows plus the (small, replicated) validation
+    set from a prepared-DataFrame dataset."""
+    from .data import read_manifest, read_rows
+
+    n = read_manifest(store, run_id)["n_rows"]
+    start, stop = _shard_range(n)
+    xs, ys = read_rows(store, run_id, ["x", "y"], start, stop)
+    val = None
+    if n_val:
+        vx, vy = read_rows(
+            store, run_id, ["x", "y"], 0, n_val,
+            path=store.get_val_data_path(run_id),
+        )
+        val = (vx, vy)
+    return xs, ys, val
+
+
+def _resolve_fit_inputs(est, x, y):
+    """Shared ``fit`` dispatch for both estimators: a single DataFrame
+    argument goes through Store ingestion (+ per-rank shard load), array
+    pairs through the in-memory/Store shard path.  Mutates ``est.run_id``
+    to the agreed id.  Returns ``(xs, ys, val)``."""
+    if y is None and _is_dataframe(x):
+        if est.store is None:
+            raise ValueError(
+                "fit(df) requires a store: the DataFrame is materialized "
+                "through it (reference estimators carry the same "
+                "requirement)"
+            )
+        est.run_id, n_val = _prepare_df_with_barrier(
+            est.store, est.run_id, x, est.label_cols, est.feature_cols,
+            est.validation,
+        )
+        return _load_df_shards(est.store, est.run_id, n_val)
+    if y is None:
+        raise TypeError(
+            "fit() needs y for array inputs; a single argument must "
+            "be a DataFrame (.columns/.collect())"
+        )
+    xs, ys, est.run_id = _load_process_shard(est.store, est.run_id, x, y)
+    return xs, ys, None
+
+
 def _load_process_shard(store, run_id, x, y):
     """The rows this process trains on: when a Store is configured the
     data is materialized (rank 0) and each rank streams back ONLY its
@@ -109,6 +196,9 @@ class TorchEstimator:
                  run_id: Optional[str] = None,
                  backward_passes_per_step: int = 1,
                  op: Optional[str] = None,
+                 feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None,
+                 validation=None,
                  shuffle: bool = True, verbose: int = 1):
         self.model = model
         self.optimizer_factory = optimizer_factory
@@ -119,19 +209,26 @@ class TorchEstimator:
         self.run_id = run_id or f"torch_run_{int(time.time())}"
         self.backward_passes_per_step = backward_passes_per_step
         self.op = op
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.validation = validation
         self.shuffle = shuffle
         self.verbose = verbose
 
-    def fit(self, x, y) -> TorchEstimatorModel:
+    def fit(self, x, y=None) -> TorchEstimatorModel:
+        """``fit(x, y)`` on arrays, or ``fit(df)`` on a (py)Spark-style
+        DataFrame (reference spark/torch/estimator.py TorchEstimator.fit:
+        the DataFrame is validated + materialized through the Store,
+        then every rank trains from its shard)."""
+        if not core.is_initialized():
+            core.init()
+        xs, ys, val = _resolve_fit_inputs(self, x, y)
+        return self._train_arrays(xs, ys, val=val)
+
+    def _train_arrays(self, xs, ys, val=None) -> TorchEstimatorModel:
         import torch
 
         import horovod_tpu.torch as hvd_torch
-
-        if not core.is_initialized():
-            core.init()
-        xs, ys, self.run_id = _load_process_shard(
-            self.store, self.run_id, x, y,
-        )
 
         opt = self.optimizer_factory(self.model.parameters())
         kwargs = {} if self.op is None else {"op": self.op}
@@ -164,6 +261,14 @@ class TorchEstimator:
                 losses.append(float(loss))
             metrics = {"loss": float(np.mean(losses)) if losses
                        else float("nan")}
+            if val is not None:
+                self.model.eval()
+                with torch.no_grad():
+                    vloss = self.loss(
+                        self.model(torch.as_tensor(val[0])),
+                        torch.as_tensor(val[1]),
+                    )
+                metrics["val_loss"] = float(vloss)
             fitted.history.append(metrics)
             if self.verbose and core.process_rank() == 0:
                 log.info("epoch %d: %s", epoch, metrics)
@@ -181,7 +286,10 @@ class KerasEstimator:
     def __init__(self, *, model, optimizer, loss,
                  store: Optional[Store] = None, batch_size: int = 32,
                  epochs: int = 1, run_id: Optional[str] = None,
-                 metrics: Optional[list] = None, verbose: int = 0):
+                 metrics: Optional[list] = None,
+                 feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None,
+                 validation=None, verbose: int = 0):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -190,17 +298,21 @@ class KerasEstimator:
         self.epochs = epochs
         self.run_id = run_id or f"keras_run_{int(time.time())}"
         self.metrics = metrics or []
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.validation = validation
         self.verbose = verbose
 
-    def fit(self, x, y):
+    def fit(self, x, y=None):
+        """``fit(x, y)`` on arrays, or ``fit(df)`` on a (py)Spark-style
+        DataFrame (reference spark/keras/estimator.py KerasEstimator:
+        prepare_data through the Store, then the gang trains from it)."""
         import horovod_tpu.tensorflow as hvd_tf
         from horovod_tpu.tensorflow.keras import callbacks as hvd_cb
 
         if not core.is_initialized():
             core.init()
-        xs, ys, self.run_id = _load_process_shard(
-            self.store, self.run_id, x, y,
-        )
+        xs, ys, val = _resolve_fit_inputs(self, x, y)
 
         opt = hvd_tf.DistributedOptimizer(self.optimizer)
         self.model.compile(optimizer=opt, loss=self.loss,
@@ -208,6 +320,7 @@ class KerasEstimator:
         history = self.model.fit(
             xs, ys, batch_size=self.batch_size, epochs=self.epochs,
             verbose=self.verbose,
+            validation_data=val,
             callbacks=[hvd_cb.BroadcastGlobalVariablesCallback(0)],
         )
         if self.store is not None and core.process_rank() == 0:

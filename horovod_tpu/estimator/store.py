@@ -21,6 +21,11 @@ class Store:
     def get_train_data_path(self, run_id: str) -> str:
         raise NotImplementedError
 
+    def get_val_data_path(self, run_id: str) -> str:
+        """Validation split location (reference spark/common/store.py
+        get_val_data_path)."""
+        raise NotImplementedError
+
     def get_checkpoint_path(self, run_id: str) -> str:
         raise NotImplementedError
 
@@ -67,6 +72,9 @@ class LocalStore(Store):
     def get_train_data_path(self, run_id: str) -> str:
         return self._sub(run_id, "train_data")
 
+    def get_val_data_path(self, run_id: str) -> str:
+        return self._sub(run_id, "val_data")
+
     def get_checkpoint_path(self, run_id: str) -> str:
         return self._sub(run_id, "checkpoints")
 
@@ -112,6 +120,9 @@ class FsspecStore(Store):
 
     def get_train_data_path(self, run_id: str) -> str:
         return self._sub(run_id, "train_data")
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return self._sub(run_id, "val_data")
 
     def get_checkpoint_path(self, run_id: str) -> str:
         return self._sub(run_id, "checkpoints")

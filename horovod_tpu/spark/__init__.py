@@ -28,6 +28,18 @@ import pyspark  # gate: module import fails cleanly without Spark
 from ..estimator import Estimator, EstimatorModel, Store  # noqa: F401
 
 
+def __getattr__(name):
+    # reference-shaped access: horovod.spark.torch.TorchEstimator /
+    # horovod.spark.keras.KerasEstimator / spark.common.util.prepare_data
+    # map to the estimator package's lazy exports
+    if name in ("TorchEstimator", "TorchEstimatorModel", "KerasEstimator",
+                "prepare_data", "read_schema"):
+        from .. import estimator
+
+        return getattr(estimator, name)
+    raise AttributeError(name)
+
+
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         num_proc: Optional[int] = None, extra_env: Optional[dict] = None,
         verbose: int = 1):

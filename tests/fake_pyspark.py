@@ -1,0 +1,211 @@
+"""In-repo pyspark stub (pyspark is not on this image; the reference
+exercises its Spark slice against a live local SparkSession,
+test/spark_common.py — zero-execution modules are dead weight).
+
+Two surfaces:
+
+* the BARRIER-MODE gang surface ``horovod_tpu.spark.run`` drives:
+  ``SparkContext.getOrCreate/parallelize``, barrier RDDs whose
+  ``mapPartitions`` runs each partition sequentially in-process, and
+  ``BarrierTaskContext`` (reference spark/__init__.py:39-101);
+* the DATAFRAME surface the estimators' ``fit(df)`` path drives:
+  ``SparkSession.builder.getOrCreate().createDataFrame(...)``, ``Row``
+  with ``asDict()``, ``DataFrame.columns/collect()``, and
+  ``pyspark.ml.linalg.DenseVector`` (reference spark/common/util.py
+  prepare_data consumes exactly this shape).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+import numpy as np
+
+
+class BarrierTaskContext:
+    _current = None
+
+    def __init__(self, pid):
+        self._pid = pid
+
+    @classmethod
+    def get(cls):
+        return cls._current
+
+    def partitionId(self):
+        return self._pid
+
+    def barrier(self):
+        pass  # in-process sequential stand-in: nothing to sync
+
+
+class _BarrierRDD:
+    def __init__(self, n):
+        self._n = n
+
+    def mapPartitions(self, fn):
+        self._fn = fn
+        return self
+
+    def collect(self):
+        out = []
+        saved = dict(os.environ)
+        try:
+            for pid in range(self._n):
+                BarrierTaskContext._current = BarrierTaskContext(pid)
+                out.extend(list(self._fn(iter([pid]))))
+                # each "executor" starts from the driver env, not the
+                # previous task's leftovers
+                os.environ.clear()
+                os.environ.update(saved)
+        finally:
+            BarrierTaskContext._current = None
+        return out
+
+
+class _RDD:
+    def __init__(self, n):
+        self._n = n
+
+    def barrier(self):
+        return _BarrierRDD(self._n)
+
+
+class SparkContext:
+    defaultParallelism = 2
+    _instance = None
+
+    @classmethod
+    def getOrCreate(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def parallelize(self, seq, numSlices):
+        return _RDD(numSlices)
+
+
+class Row:
+    """pyspark.sql.Row stand-in: keyword fields + asDict()."""
+
+    def __init__(self, **fields):
+        self._fields = dict(fields)
+
+    def asDict(self):
+        return dict(self._fields)
+
+    def __getitem__(self, key):
+        return self._fields[key]
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self._fields.items())
+        return f"Row({inner})"
+
+
+class DenseVector:
+    """pyspark.ml.linalg.DenseVector stand-in (toArray + len)."""
+
+    def __init__(self, values):
+        self.array = np.asarray(values, np.float64)
+
+    def toArray(self):
+        return self.array
+
+    def __len__(self):
+        return self.array.shape[0]
+
+
+class DataFrame:
+    def __init__(self, rows, columns):
+        self._rows = list(rows)
+        self.columns = list(columns)
+
+    def collect(self):
+        return list(self._rows)
+
+    def count(self):
+        return len(self._rows)
+
+    @property
+    def schema(self):
+        class _Schema:
+            def __init__(self, names):
+                self.names = names
+
+        return _Schema(self.columns)
+
+
+class SparkSession:
+    _instance = None
+
+    class _Builder:
+        def appName(self, _name):
+            return self
+
+        def master(self, _url):
+            return self
+
+        def getOrCreate(self):
+            if SparkSession._instance is None:
+                SparkSession._instance = SparkSession()
+            return SparkSession._instance
+
+    builder = _Builder()
+
+    @property
+    def sparkContext(self):
+        return SparkContext.getOrCreate()
+
+    def createDataFrame(self, data, schema=None):
+        """Rows from list-of-dicts, list-of-Rows, or list-of-tuples +
+        schema names (the subset of real createDataFrame the tests and
+        estimators use)."""
+        rows = []
+        columns = list(schema) if schema else None
+        for item in data:
+            if isinstance(item, Row):
+                d = item.asDict()
+            elif isinstance(item, dict):
+                d = dict(item)
+            else:  # tuple/list + schema names
+                if not columns:
+                    raise ValueError(
+                        "createDataFrame with tuple rows needs a schema"
+                    )
+                d = dict(zip(columns, item))
+            rows.append(Row(**d))
+            if columns is None:
+                columns = list(d)
+        return DataFrame(rows, columns or [])
+
+
+def install() -> types.ModuleType:
+    """Register the stub under sys.modules['pyspark'] (+ the sql and
+    ml.linalg submodules the estimator path imports)."""
+    pyspark = types.ModuleType("pyspark")
+    sql = types.ModuleType("pyspark.sql")
+    ml = types.ModuleType("pyspark.ml")
+    linalg = types.ModuleType("pyspark.ml.linalg")
+
+    pyspark.SparkContext = SparkContext
+    pyspark.BarrierTaskContext = BarrierTaskContext
+    sql.SparkSession = SparkSession
+    sql.Row = Row
+    linalg.DenseVector = DenseVector
+    ml.linalg = linalg
+    pyspark.sql = sql
+    pyspark.ml = ml
+
+    sys.modules["pyspark"] = pyspark
+    sys.modules["pyspark.sql"] = sql
+    sys.modules["pyspark.ml"] = ml
+    sys.modules["pyspark.ml.linalg"] = linalg
+    return pyspark
+
+
+def uninstall() -> None:
+    for name in ("pyspark", "pyspark.sql", "pyspark.ml",
+                 "pyspark.ml.linalg", "horovod_tpu.spark"):
+        sys.modules.pop(name, None)

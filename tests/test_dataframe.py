@@ -1,0 +1,342 @@
+"""DataFrame → Store ingestion + estimator fit(df) (reference
+test/test_spark.py prepare_data coverage + test_spark_torch.py /
+test_spark_keras.py estimator end-to-end runs, executed here against the
+in-repo pyspark stub over a memory:// store)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+
+@pytest.fixture
+def spark(tmp_path):
+    import fake_pyspark
+
+    had_real = "pyspark" in sys.modules
+    fake = fake_pyspark.install()
+    yield fake
+    if not had_real:
+        fake_pyspark.uninstall()
+
+
+@pytest.fixture
+def store():
+    from horovod_tpu.estimator import Store
+
+    return Store.create(f"memory://df_{np.random.randint(1 << 30)}")
+
+
+def _make_df(n=20, seed=0):
+    from pyspark.ml.linalg import DenseVector
+    from pyspark.sql import SparkSession
+
+    rng = np.random.default_rng(seed)
+    spark = SparkSession.builder.getOrCreate()
+    rows = []
+    w = np.asarray([0.5, -1.0, 2.0])
+    for i in range(n):
+        f = rng.normal(size=3)
+        rows.append({
+            "features": DenseVector(f),
+            "extra": float(i),
+            "label": float(f @ w),
+        })
+    return spark.createDataFrame(rows)
+
+
+def test_prepare_data_materializes_columns(spark, store):
+    from horovod_tpu.estimator.dataframe import prepare_data, read_schema
+    from horovod_tpu.estimator.data import read_manifest, read_rows
+
+    df = _make_df(n=20)
+    manifest = prepare_data(store, df, ["label"], ["features", "extra"],
+                            run_id="prep")
+    assert manifest["n_rows"] == 20
+    # x = features(3) + extra(1) compiled into one [n, 4] matrix
+    assert manifest["columns"]["x"]["shape"] == [4]
+    # labels always 2-D: a scalar label is [n, 1], matching a
+    # Linear(d, 1)-shaped output (no silent (n,)-vs-(n,1) broadcast)
+    assert manifest["columns"]["y"]["shape"] == [1]
+    # original columns preserved under col:<name>
+    assert manifest["columns"]["col:features"]["shape"] == [3]
+    xs, ys = read_rows(store, "prep", ["x", "y"], 0, 20)
+    assert xs.shape == (20, 4) and ys.shape == (20, 1)
+    # feature order: the 'extra' scalar is the 4th feature
+    np.testing.assert_allclose(xs[:, 3], np.arange(20.0))
+    schema = read_schema(store, "prep")
+    assert schema["feature_columns"] == ["features", "extra"]
+    assert schema["columns"]["features"]["shape"] == [3]
+    assert read_manifest(store, "prep")["n_rows"] == 20
+
+
+def test_prepare_data_default_features_excludes_labels(spark, store):
+    from horovod_tpu.estimator.dataframe import prepare_data, read_schema
+
+    prepare_data(store, _make_df(), ["label"], run_id="defaults")
+    schema = read_schema(store, "defaults")
+    assert schema["feature_columns"] == ["features", "extra"]
+
+
+def test_prepare_data_schema_errors(spark, store):
+    """Reference-quality validation errors (reference
+    spark/common/util.py:167-241, :550-582)."""
+    from pyspark.ml.linalg import DenseVector
+    from pyspark.sql import SparkSession
+
+    from horovod_tpu.estimator.dataframe import prepare_data
+
+    sess = SparkSession.builder.getOrCreate()
+    df = _make_df()
+    with pytest.raises(ValueError, match="Label column z does not exist"):
+        prepare_data(store, df, ["z"], run_id="e1")
+    with pytest.raises(ValueError,
+                       match="Feature column nope does not exist"):
+        prepare_data(store, df, ["label"], ["nope"], run_id="e2")
+    with pytest.raises(ValueError,
+                       match="label_columns cannot be None or empty"):
+        prepare_data(store, df, [], run_id="e3")
+
+    ragged = sess.createDataFrame([
+        {"v": DenseVector([1.0, 2.0]), "label": 0.0},
+        {"v": DenseVector([1.0, 2.0, 3.0]), "label": 1.0},
+    ])
+    with pytest.raises(ValueError,
+                       match="Column 'v' does not have uniform shape"):
+        prepare_data(store, ragged, ["label"], run_id="e4")
+
+    nulls = sess.createDataFrame([{"v": 1.0, "label": 0.0},
+                                  {"v": None, "label": 1.0}])
+    with pytest.raises(ValueError, match="null values"):
+        prepare_data(store, nulls, ["label"], run_id="e5")
+
+
+def test_prepare_data_validation_forms(spark, store):
+    from horovod_tpu.estimator.dataframe import prepare_data
+
+    df = _make_df(n=20)
+    with pytest.raises(ValueError,
+                       match=r"must be in the range: \[0, 1\)"):
+        prepare_data(store, df, ["label"], run_id="v1", validation=1.5)
+    with pytest.raises(ValueError,
+                       match="Validation column split_col does not exist"):
+        prepare_data(store, df, ["label"], run_id="v2",
+                     validation="split_col")
+    with pytest.raises(ValueError, match='type "float" or "str"'):
+        prepare_data(store, df, ["label"], run_id="v3", validation=[0.2])
+
+    m = prepare_data(store, df, ["label"], run_id="v4", validation=0.25)
+    assert m["n_rows"] == 15 and m["n_val_rows"] == 5
+
+
+def test_prepare_data_validation_column(spark, store):
+    from pyspark.sql import SparkSession
+
+    from horovod_tpu.estimator.dataframe import prepare_data, read_schema
+
+    sess = SparkSession.builder.getOrCreate()
+    rows = [{"f": float(i), "label": float(i), "is_val": i % 4 == 0}
+            for i in range(12)]
+    df = sess.createDataFrame(rows)
+    m = prepare_data(store, df, ["label"], run_id="vc",
+                     validation="is_val")
+    assert m["n_rows"] == 9 and m["n_val_rows"] == 3
+    # the indicator column is not a feature
+    assert read_schema(store, "vc")["feature_columns"] == ["f"]
+
+
+def test_torch_estimator_fit_dataframe(spark, store):
+    import torch
+
+    import horovod_tpu as hvd
+    from horovod_tpu.estimator import TorchEstimator
+
+    hvd.init(devices=jax.devices("cpu")[:1])
+    torch.manual_seed(0)
+    est = TorchEstimator(
+        model=torch.nn.Linear(3, 1),
+        optimizer_factory=lambda ps: torch.optim.SGD(ps, lr=0.05),
+        loss=torch.nn.MSELoss(),
+        store=store, batch_size=8, epochs=20, run_id="tdf",
+        label_cols=["label"], feature_cols=["features"],
+        validation=0.2, verbose=0,
+    )
+    df = _make_df(n=64)
+    fitted = est.fit(df)
+    assert fitted.history[-1]["loss"] < fitted.history[0]["loss"]
+    assert "val_loss" in fitted.history[-1]
+    # the model must learn the REGRESSION, not collapse to the label
+    # mean (the (n,)-vs-(n,1) broadcast failure mode): final MSE far
+    # below var(y) ~= 5.25
+    assert fitted.history[-1]["loss"] < 0.5, fitted.history[-1]
+    w = est.model.weight.detach().numpy().reshape(-1)
+    np.testing.assert_allclose(w, [0.5, -1.0, 2.0], atol=0.35)
+    out = fitted.predict(np.zeros((2, 3), np.float32))
+    assert out.shape == (2, 1)
+
+
+def test_torch_estimator_fit_df_requires_store(spark):
+    import torch
+
+    from horovod_tpu.estimator import TorchEstimator
+
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1),
+        optimizer_factory=lambda ps: torch.optim.SGD(ps, lr=0.05),
+        loss=torch.nn.MSELoss(), label_cols=["label"],
+    )
+    with pytest.raises(ValueError, match="requires a store"):
+        est.fit(_make_df())
+    with pytest.raises(TypeError, match="needs y for array inputs"):
+        est.fit(np.zeros((4, 2)))
+
+
+def _worker_df_estimator():
+    """2-process fit(df): rank 0 ingests the DataFrame through the shared
+    Store, both ranks train their shards, weights converge identically
+    (reference test_spark_torch.py end-to-end estimator runs)."""
+    import os
+
+    import numpy as np
+
+    import fake_pyspark
+
+    fake_pyspark.install()
+    import jax
+    import torch
+
+    import horovod_tpu as hvd
+    from horovod_tpu.estimator import Store, TorchEstimator
+
+    hvd.init(devices=jax.devices("cpu"))
+    from pyspark.ml.linalg import DenseVector
+    from pyspark.sql import SparkSession
+
+    rng = np.random.default_rng(3)  # same df on every process
+    w = np.asarray([0.5, -1.0, 2.0])
+    rows = []
+    for _ in range(48):
+        f = rng.normal(size=3)
+        rows.append({"features": DenseVector(f), "label": float(f @ w)})
+    df = SparkSession.builder.getOrCreate().createDataFrame(rows)
+
+    store = Store.create(os.environ["HVD_TEST_STORE"])
+    torch.manual_seed(0)
+    model = torch.nn.Linear(3, 1)
+    if hvd.process_rank() == 1:  # diverged init: broadcast must fix it
+        with torch.no_grad():
+            model.weight.fill_(5.0)
+    est = TorchEstimator(
+        model=model,
+        optimizer_factory=lambda ps: torch.optim.SGD(ps, lr=0.05),
+        loss=torch.nn.MSELoss(), store=store, batch_size=8, epochs=6,
+        run_id="mpdf", label_cols=["label"], feature_cols=["features"],
+        verbose=0,
+    )
+    fitted = est.fit(df)
+    return {
+        "rank": hvd.process_rank(),
+        "loss0": fitted.history[0]["loss"],
+        "lossN": fitted.history[-1]["loss"],
+        "weights": model.weight.detach().numpy().tolist(),
+    }
+
+
+def test_two_process_fit_dataframe(tmp_path):
+    import os
+
+    from horovod_tpu.run.run import run
+    from horovod_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        "PYTHONPATH": tests_dir + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+        "HVD_TEST_STORE": str(tmp_path / "store"),
+    }
+    results = run(_worker_df_estimator, np=2, extra_env=env)
+    r0, r1 = results
+    assert r0["lossN"] < r0["loss0"]
+    np.testing.assert_allclose(r0["weights"], r1["weights"], rtol=1e-5)
+
+
+def _worker_df_schema_error():
+    """Rank 0's schema-validation failure must raise on EVERY rank (not
+    strand ranks 1..n-1 on the materialization barrier)."""
+    import os
+
+    import fake_pyspark
+
+    fake_pyspark.install()
+    import jax
+    import torch
+
+    import horovod_tpu as hvd
+    from horovod_tpu.estimator import Store, TorchEstimator
+
+    hvd.init(devices=jax.devices("cpu"))
+    from pyspark.ml.linalg import DenseVector
+    from pyspark.sql import SparkSession
+
+    df = SparkSession.builder.getOrCreate().createDataFrame([
+        {"v": DenseVector([1.0, 2.0]), "label": 0.0},
+        {"v": DenseVector([1.0, 2.0, 3.0]), "label": 1.0},
+    ])
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1),
+        optimizer_factory=lambda ps: torch.optim.SGD(ps, lr=0.05),
+        loss=torch.nn.MSELoss(),
+        store=Store.create(os.environ["HVD_TEST_STORE"]),
+        run_id="badschema", label_cols=["label"],
+    )
+    try:
+        est.fit(df)
+        return "no-error"
+    except ValueError as e:
+        return f"error: {e}"
+
+
+def test_two_process_schema_error_raises_everywhere(tmp_path):
+    import os
+
+    from horovod_tpu.run.run import run
+    from horovod_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        "PYTHONPATH": tests_dir + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+        "HVD_TEST_STORE": str(tmp_path / "store"),
+    }
+    results = run(_worker_df_schema_error, np=2, extra_env=env)
+    for res in results:
+        assert res.startswith("error:"), res
+        assert "uniform shape" in res
+
+
+def test_keras_estimator_fit_dataframe(spark, store):
+    tf = pytest.importorskip("tensorflow")
+
+    import horovod_tpu as hvd
+    from horovod_tpu.estimator import KerasEstimator
+
+    hvd.init(devices=jax.devices("cpu")[:1])
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((3,)), tf.keras.layers.Dense(1),
+    ])
+    est = KerasEstimator(
+        model=model, optimizer=tf.keras.optimizers.SGD(0.05),
+        loss="mse", store=store, batch_size=8, epochs=5, run_id="kdf",
+        label_cols=["label"], feature_cols=["features"],
+        validation=0.2, verbose=0,
+    )
+    fitted = est.fit(_make_df(n=64))
+    hist = fitted.history_
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert "val_loss" in hist

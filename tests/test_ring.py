@@ -285,6 +285,77 @@ def test_soak_mixed_ring_star_np4():
         assert res["ok"], res
 
 
+def _worker_kill_mid_ring():
+    """Rank 1 negotiates a ring allreduce then dies WITHOUT executing its
+    side of the transfer — deterministic kill injection (no timing race:
+    the survivor is guaranteed to be blocked inside the ring op when the
+    peer's sockets close).  Rank 0 must fail FAST with a clear error, not
+    hang to the stall deadline (reference gloo_run.py:253-259: any rank
+    exiting kills the job)."""
+    import os
+    import time
+
+    import numpy as np
+
+    import jax
+    import horovod_tpu as hvd
+    from horovod_tpu import eager
+    from horovod_tpu.runtime import eager_controller
+
+    hvd.init(devices=jax.devices("cpu"))
+    r = hvd.process_rank()
+    assert eager_controller.ring() is not None, "ring failed to establish"
+    arr = np.ones(1 << 18, np.float32)  # 1 MB: rides the ring
+
+    if r == 1:
+        # Freeze this rank's dispatcher FIRST — otherwise it would
+        # consume the negotiated response and helpfully execute an
+        # identity-element transfer (the Join path), completing the ring.
+        rx = eager_controller.ring()
+        rx._stopping = True
+        rx._thread.join(timeout=10)
+        # file the negotiation request exactly as RingExecutor._submit
+        # would (name tag + shape/dtype), then crash: the coordinator
+        # completes the negotiation, rank 0 starts the transfer and
+        # blocks on this rank's never-arriving data, and this process's
+        # death closes the ring sockets under it
+        eager_controller.client().submit(
+            "ring.sum:kill.t", op="allreduce", shape=arr.shape,
+            dtype="float32",
+        )
+        time.sleep(0.3)  # rank 0 is now blocked mid-transfer
+        os._exit(17)
+
+    t0 = time.perf_counter()
+    try:
+        eager_controller.ring().allreduce("kill.t", arr, op="allreduce")
+    except RuntimeError as e:
+        elapsed = time.perf_counter() - t0
+        raise RuntimeError(
+            f"survivor failed fast after {elapsed:.1f}s: {e}"
+        ) from None
+    return "ring op unexpectedly succeeded"
+
+
+def test_kill_injection_survivor_fails_fast():
+    """Kill one worker mid-ring-allreduce: the survivor's op must raise a
+    clear ring error within seconds (peer-closed detection in
+    csrc/ring.cc Step: recv()==0 -> fail), and the job as a whole must
+    fail (function-mode run() surfaces worker tracebacks + exit codes,
+    the launcher analog of gloo_run kill-on-nonzero)."""
+    import time
+
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError) as ei:
+        run(_worker_kill_mid_ring, np=2, extra_env=_env())
+    elapsed = time.perf_counter() - t0
+    msg = str(ei.value)
+    assert "ring allreduce failed" in msg, msg
+    assert "survivor failed fast" in msg, msg
+    # fail-fast, not stall-deadline: generous bound for a loaded 1-core CI
+    assert elapsed < 60, f"took {elapsed:.0f}s — not fail-fast"
+
+
 def _worker_adasum_delta():
     import numpy as np
 

@@ -5,92 +5,22 @@ env wiring + controller lifecycle — no more zero-execution module)."""
 
 import os
 import sys
-import types
 
 import pytest
 
 from horovod_tpu.runtime import native
 
 
-def _install_fake_pyspark():
-    """Just enough of pyspark for horovod_tpu.spark.run: SparkContext
-    .getOrCreate/parallelize, barrier RDDs whose mapPartitions runs each
-    partition sequentially in-process, and BarrierTaskContext."""
-    pyspark = types.ModuleType("pyspark")
-
-    class BarrierTaskContext:
-        _current = None
-
-        def __init__(self, pid):
-            self._pid = pid
-
-        @classmethod
-        def get(cls):
-            return cls._current
-
-        def partitionId(self):
-            return self._pid
-
-        def barrier(self):
-            pass  # in-process sequential stand-in: nothing to sync
-
-    class _BarrierRDD:
-        def __init__(self, n):
-            self._n = n
-
-        def mapPartitions(self, fn):
-            self._fn = fn
-            return self
-
-        def collect(self):
-            out = []
-            saved = dict(os.environ)
-            try:
-                for pid in range(self._n):
-                    BarrierTaskContext._current = BarrierTaskContext(pid)
-                    out.extend(list(self._fn(iter([pid]))))
-                    # each "executor" starts from the driver env, not the
-                    # previous task's leftovers
-                    os.environ.clear()
-                    os.environ.update(saved)
-            finally:
-                BarrierTaskContext._current = None
-            return out
-
-    class _RDD:
-        def __init__(self, n):
-            self._n = n
-
-        def barrier(self):
-            return _BarrierRDD(self._n)
-
-    class SparkContext:
-        defaultParallelism = 2
-        _instance = None
-
-        @classmethod
-        def getOrCreate(cls):
-            if cls._instance is None:
-                cls._instance = cls()
-            return cls._instance
-
-        def parallelize(self, seq, numSlices):
-            return _RDD(numSlices)
-
-    pyspark.SparkContext = SparkContext
-    pyspark.BarrierTaskContext = BarrierTaskContext
-    sys.modules["pyspark"] = pyspark
-    return pyspark
-
-
 @pytest.fixture
 def spark_env():
+    import fake_pyspark
+
     had_real = "pyspark" in sys.modules
-    fake = _install_fake_pyspark()
+    fake = fake_pyspark.install()
     sys.modules.pop("horovod_tpu.spark", None)
     yield fake
     if not had_real:
-        sys.modules.pop("pyspark", None)
+        fake_pyspark.uninstall()
     sys.modules.pop("horovod_tpu.spark", None)
 
 
