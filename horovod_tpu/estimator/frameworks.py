@@ -258,7 +258,7 @@ class TorchEstimator:
                 )
                 loss.backward()
                 opt.step()
-                losses.append(float(loss))
+                losses.append(float(loss.detach()))
             metrics = {"loss": float(np.mean(losses)) if losses
                        else float("nan")}
             if val is not None:

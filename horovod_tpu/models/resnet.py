@@ -70,6 +70,59 @@ class SpaceToDepthConvInit(nn.Module):
         )
 
 
+class PallasConvBN3x3(nn.Module):
+    """Fused stride-1 3x3 conv + BatchNorm + ReLU over the Pallas kernels
+    (ops/conv_bn.py): train mode runs the conv+stats-epilogue kernel with
+    the full-BN-backward custom VJP; eval mode runs the folded-affine
+    kernel.  The round-4 conv+BN experiment module (docs/PERF.md) —
+    selected by ``ResNet(conv_bn="pallas")``; its parameter layout is its
+    own (kernel/scale/bias + batch_stats mean/var), so checkpoints do NOT
+    interchange with the (Conv, BatchNorm) pair it replaces."""
+
+    features: int
+    train: bool
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.conv_bn import conv3x3_bn_relu, conv3x3_bn_relu_train
+
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, cin, self.features), self.param_dtype,
+        )
+        gamma = self.param("scale", nn.initializers.ones,
+                           (self.features,), self.param_dtype)
+        beta = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((self.features,), jnp.float32))
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((self.features,), jnp.float32))
+        k = kernel.astype(self.dtype)
+        x = x.astype(self.dtype)
+        if self.train:
+            out, mean, var = conv3x3_bn_relu_train(
+                x, k, gamma.astype(jnp.float32), beta.astype(jnp.float32),
+                self.epsilon,
+            )
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        else:
+            scale = gamma * (lax.rsqrt(ra_var.value + self.epsilon))
+            bias = beta - ra_mean.value * scale
+            out = conv3x3_bn_relu(x, k, scale, bias)
+        return out
+
+
 def _residual_join(residual, y, kind: str):
     """The block output ``relu(residual + y)``: XLA elementwise fusion by
     default, or the Pallas single-pass kernel (the docs/PERF.md §56×56
@@ -87,6 +140,7 @@ class BottleneckBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     join: str = "xla"  # "xla" | "pallas"
+    fused: ModuleDef = None  # PallasConvBN3x3 partial (conv_bn="pallas")
 
     @nn.compact
     def __call__(self, x):
@@ -94,9 +148,15 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(y)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        if self.fused is not None and self.strides == 1:
+            # the 3x3+BN+ReLU as one fused Pallas op (stride-1 blocks;
+            # stride-2 stage entries keep the XLA pair)
+            y = self.fused(features=self.filters)(y)
+        else:
+            y = self.conv(self.filters, (3, 3),
+                          strides=(self.strides,) * 2)(y)
+            y = self.norm()(y)
+            y = nn.relu(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
@@ -114,13 +174,20 @@ class BasicBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     join: str = "xla"  # "xla" | "pallas"
+    fused: ModuleDef = None  # PallasConvBN3x3 partial (conv_bn="pallas")
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        if self.fused is not None and self.strides == 1:
+            # first 3x3+BN+ReLU fused; the second conv's BN has no ReLU
+            # before the join, so it stays on the XLA pair
+            y = self.fused(features=self.filters)(x)
+        else:
+            y = self.conv(self.filters, (3, 3),
+                          strides=(self.strides,) * 2)(x)
+            y = self.norm()(y)
+            y = nn.relu(y)
         y = self.conv(self.filters, (3, 3))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
@@ -141,6 +208,7 @@ class ResNet(nn.Module):
     param_dtype: Any = jnp.float32
     stem: str = "conv"  # "conv" | "space_to_depth" (same params/output)
     residual_join: str = "xla"  # "xla" | "pallas" (same math, see blocks)
+    conv_bn: str = "xla"  # "xla" | "pallas" (fused 3x3+BN+ReLU, see blocks)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -152,6 +220,17 @@ class ResNet(nn.Module):
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype,
         )
+        fused = None
+        if self.conv_bn == "pallas":
+            fused = partial(
+                PallasConvBN3x3, train=train, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )
+        elif self.conv_bn != "xla":
+            raise ValueError(
+                f"unknown conv_bn {self.conv_bn!r} (want 'xla' or "
+                "'pallas')"
+            )
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = SpaceToDepthConvInit(
@@ -175,7 +254,7 @@ class ResNet(nn.Module):
                 x = self.block_cls(
                     filters=self.num_filters * 2 ** i,
                     strides=strides, conv=conv, norm=norm,
-                    join=self.residual_join,
+                    join=self.residual_join, fused=fused,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,

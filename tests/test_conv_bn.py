@@ -1,0 +1,159 @@
+"""Correctness gate for the Pallas conv+BN experiment kernels
+(ops/conv_bn.py) against their XLA twins — interpreter mode on the CPU
+mesh, same policy as test_elementwise.py / test_flash_attention.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.conv_bn import (
+    conv3x3_bn_relu, conv3x3_stats, xla_conv3x3_bn_relu, xla_conv3x3_stats,
+)
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init(devices=jax.devices("cpu")[:1])
+
+
+def _data(b=3, h=8, w=8, cin=16, cout=16, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(b, h, w, cin)), dtype)
+    k = jnp.asarray(rng.normal(size=(3, 3, cin, cout)) * 0.1, dtype)
+    scale = jnp.asarray(rng.uniform(0.5, 1.5, size=(cout,)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(cout,)), jnp.float32)
+    return x, k, scale, bias
+
+
+def test_conv_bn_relu_matches_xla():
+    x, k, scale, bias = _data()
+    got = conv3x3_bn_relu(x, k, scale, bias, interpret=True)
+    want = xla_conv3x3_bn_relu(x, k, scale, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_bn_relu_rectangular_channels():
+    x, k, scale, bias = _data(cin=8, cout=24)
+    got = conv3x3_bn_relu(x, k, scale, bias, interpret=True)
+    want = xla_conv3x3_bn_relu(x, k, scale, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_stats_matches_xla():
+    x, k, *_ = _data(b=4)
+    y, s, sq = conv3x3_stats(x, k, interpret=True)
+    wy, ws, wsq = xla_conv3x3_stats(x, k)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(wy),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(ws),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sq), np.asarray(wsq),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_conv_bn_relu_bf16():
+    x, k, scale, bias = _data(dtype=jnp.bfloat16)
+    got = conv3x3_bn_relu(x, k, scale, bias, interpret=True)
+    want = xla_conv3x3_bn_relu(x, k, scale, bias)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_shape_validation():
+    x, k, scale, bias = _data()
+    with pytest.raises(ValueError, match="NHWC"):
+        conv3x3_bn_relu(x[0], k, scale, bias, interpret=True)
+
+
+def _bn_train_ref(x, w, gamma, beta, eps=1e-5):
+    """Pure-XLA reference: conv + batch-stats BN + relu, grads flowing
+    through mean/var exactly as flax BatchNorm under autodiff."""
+    from jax import lax
+
+    y = lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ).astype(jnp.float32)
+    mean = y.mean(axis=(0, 1, 2))
+    var = ((y - mean) ** 2).mean(axis=(0, 1, 2))
+    out = jnp.maximum((y - mean) * jax.lax.rsqrt(var + eps) * gamma + beta,
+                      0.0)
+    return out.astype(x.dtype), mean, var
+
+
+def test_train_fwd_matches_reference():
+    from horovod_tpu.ops.conv_bn import conv3x3_bn_relu_train
+
+    x, k, *_ = _data(b=4)
+    gamma = jnp.asarray(np.linspace(0.5, 1.5, 16), jnp.float32)
+    beta = jnp.asarray(np.linspace(-0.3, 0.4, 16), jnp.float32)
+    out, mean, var = conv3x3_bn_relu_train(x, k, gamma, beta, 1e-5, True)
+    w_out, w_mean, w_var = _bn_train_ref(x, k, gamma, beta)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(w_mean),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(w_var),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(w_out),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_train_grads_match_reference():
+    """The custom VJP must implement the FULL BatchNorm backward
+    (gradients through mean and var) for x, w, gamma, and beta."""
+    from horovod_tpu.ops.conv_bn import conv3x3_bn_relu_train
+
+    x, k, *_ = _data(b=3, h=6, w=6, cin=8, cout=8)
+    gamma = jnp.asarray(np.linspace(0.6, 1.4, 8), jnp.float32)
+    beta = jnp.asarray(np.linspace(-0.2, 0.3, 8), jnp.float32)
+    tgt = jnp.asarray(
+        np.random.default_rng(1).normal(size=(3, 6, 6, 8)), jnp.float32)
+
+    def loss_pallas(x, w, g, b):
+        out, _, _ = conv3x3_bn_relu_train(x, w, g, b, 1e-5, True)
+        return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+    def loss_ref(x, w, g, b):
+        out, _, _ = _bn_train_ref(x, w, g, b)
+        return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+
+    got = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(x, k, gamma, beta)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, k, gamma, beta)
+    for g, w_, name in zip(got, want, ["dx", "dw", "dgamma", "dbeta"]):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w_, np.float32),
+            rtol=2e-3, atol=2e-3, err_msg=name,
+        )
+
+
+def test_resnet_conv_bn_pallas_trains():
+    """ResNet18(conv_bn='pallas') runs a train step (interpreter kernels
+    on CPU) and produces finite loss + finite grads."""
+    import optax
+
+    from horovod_tpu.models.resnet import ResNet18
+    from horovod_tpu.training import init_train_state, make_train_step
+
+    model = ResNet18(num_classes=4, dtype=jnp.float32,
+                     conv_bn="pallas")
+    opt = optax.sgd(0.01)
+    step = make_train_step(
+        apply_fn=model.apply,
+        loss_fn=lambda logits, y: optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean(),
+        optimizer=opt, has_batch_stats=True,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 32, 32, 3)),
+                             has_batch_stats=True)
+    from horovod_tpu.training import shard_batch
+
+    rng = np.random.default_rng(0)
+    x = shard_batch(rng.uniform(size=(2, 32, 32, 3)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 4, size=(2,)).astype(np.int32))
+    state, loss = step(state, x, y)
+    assert np.isfinite(float(np.asarray(jax.device_get(loss))))
