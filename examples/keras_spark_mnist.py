@@ -1,0 +1,80 @@
+"""Keras estimator trained from a Spark DataFrame (reference
+examples/keras_spark_mnist.py: DataFrame of feature-vector/label rows ->
+KerasEstimator with a Store -> fit(df) -> model).
+
+Same harness as pytorch_spark_mnist.py on the Keras path:
+``horovod_tpu.spark.keras.KerasEstimator`` ingests the DataFrame through
+the Store and trains through the TF binding with the broadcast callback.
+
+Run:  python examples/keras_spark_mnist.py [--epochs 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from pytorch_spark_mnist import make_dataframe  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=6)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--work-dir", default=None)
+    args = parser.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu as hvd
+    from horovod_tpu.estimator import Store
+
+    try:  # reference-shaped path (gated on pyspark, like horovod.spark)
+        from horovod_tpu.spark.keras import KerasEstimator
+    except ImportError:  # no pyspark: the estimator package is ungated
+        from horovod_tpu.estimator import KerasEstimator
+
+    hvd.init()
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="hvd_keras_mnist_")
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((64,)),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    est = KerasEstimator(
+        model=model,
+        optimizer=tf.keras.optimizers.Adam(1e-3),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        store=Store.create(work_dir),
+        batch_size=args.batch_size,
+        epochs=args.epochs,
+        feature_cols=["features"],
+        label_cols=["label"],
+        validation=0.15,
+        run_id="keras_spark_mnist",
+        verbose=0,
+    )
+    fitted = est.fit(make_dataframe())
+
+    if hvd.process_rank() == 0:
+        hist = fitted.history_
+        print(f"train loss {hist['loss'][0]:.3f} -> "
+              f"{hist['loss'][-1]:.3f}  val loss {hist['val_loss'][-1]:.3f}")
+        probe = make_dataframe(n=128, seed=7)
+        rows = [r.asDict() if hasattr(r, "asDict") else dict(r)
+                for r in probe.collect()]
+        x = np.asarray([r["features"] for r in rows], np.float32)
+        y = np.asarray([r["label"] for r in rows])
+        pred = np.asarray(fitted.predict(x, verbose=0)).argmax(axis=1)
+        print(f"holdout accuracy: {(pred == y).mean():.1%}")
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,9 @@ def env_from_args(args) -> Dict[str, str]:
     if getattr(args, "trace_end_step", None) is not None:
         env[env_util.HVD_TRACE_END_STEP] = str(args.trace_end_step)
 
+    if getattr(args, "network_interface", None):
+        env[env_util.HVD_NETWORK_INTERFACE] = str(args.network_interface)
+
     setb(env_util.HVD_STALL_CHECK_DISABLE,
          getattr(args, "no_stall_check", False))
     if getattr(args, "stall_check_warning_time_seconds", None) is not None:

@@ -79,6 +79,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--dry-run", action="store_true", dest="dry_run",
                         help="print the worker launch plan (env + command "
                              "per process) without spawning anything")
+    parser.add_argument("-cb", "--check-build", action="store_true",
+                        dest="check_build",
+                        help="print available frameworks / controllers / "
+                             "tensor operations and exit (reference "
+                             "horovodrun --check-build)")
+    parser.add_argument("--network-interface", dest="network_interface",
+                        help="network interface(s) the host data plane "
+                             "advertises on workers (reference "
+                             "--network-interface; the first name that "
+                             "resolves on each worker wins)")
 
     group_params = parser.add_argument_group("tuneable parameter arguments")
     group_params.add_argument("--fusion-threshold-mb", type=float,
@@ -387,12 +397,52 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def check_build() -> str:
+    """Availability report (reference run/run.py:289-324 check_build):
+    frameworks are import-probed, the controllers/ops reflect this
+    build's architecture — XLA collectives over ICI/DCN plus the native
+    C++ control/host plane in place of MPI/Gloo/NCCL."""
+    import importlib.util
+
+    from .. import __version__
+    from ..runtime import native
+
+    def mark(ok):
+        return "X" if ok else " "
+
+    def has(mod):
+        return importlib.util.find_spec(mod) is not None
+
+    native_ok = native.available()
+    return f"""\
+horovod_tpu v{__version__}:
+
+Available Frameworks:
+    [{mark(has('jax'))}] JAX / flax
+    [{mark(has('tensorflow'))}] TensorFlow
+    [{mark(has('torch'))}] PyTorch
+    [{mark(has('mxnet'))}] MXNet
+    [{mark(has('pyspark'))}] Spark
+
+Available Controllers:
+    [{mark(has('jax'))}] XLA (compiled SPMD schedule)
+    [{mark(native_ok)}] native (C++ TCP negotiation, csrc/controller.cc)
+
+Available Tensor Operations:
+    [{mark(has('jax'))}] XLA collectives (ICI/DCN)
+    [{mark(native_ok)}] native peer ring (host plane, csrc/ring.cc)
+    [{mark(native_ok)}] coordinator star (host plane)"""
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     if args.version:
         from .. import __version__
 
         print(__version__)
+        return 0
+    if getattr(args, "check_build", False):
+        print(check_build())
         return 0
     if not args.command:
         print("tpurun: no command given", file=sys.stderr)

@@ -421,6 +421,30 @@ class RingExecutor:
             fut.set_exception(exc)
 
 
+def _iface_ip(names: str) -> Optional[str]:
+    """IPv4 address of the first resolvable interface in the comma list
+    (reference --network-interface semantics: the operator names the
+    NIC(s) the data plane must ride; each worker resolves locally)."""
+    import fcntl
+    import struct
+
+    for name in names.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            packed = struct.pack("256s", name.encode()[:255])
+            addr = fcntl.ioctl(s.fileno(), 0x8915, packed)[20:24]
+            return socket.inet_ntoa(addr)  # 0x8915 = SIOCGIFADDR
+        except OSError:
+            continue
+        finally:
+            s.close()
+    log.warning("no interface in %r has an IPv4 address", names)
+    return None
+
+
 def establish(client, rank: int, nranks: int, *,
               host: Optional[str] = None) -> Optional[RingExecutor]:
     """Bring up the ring: listener → address allgather over the star →
@@ -431,11 +455,29 @@ def establish(client, rank: int, nranks: int, *,
     a half-established ring (some ranks falling back to the star) would
     deadlock the first large collective.  Returns None (on all ranks,
     consistently) when any link failed."""
+    # Advertised-address priority: explicit arg > operator's NIC
+    # override (--network-interface, resolved per worker) > the
+    # launcher-known hostname (HVD_RING_HOST) > self-resolution.
+    # A mandated-but-unresolvable NIC list raises OUTSIDE the degrade
+    # path: silently advertising another interface (typically the
+    # management NIC) would ride the wrong network — fail at launch,
+    # as the reference does for an absent GLOO_IFACE.
+    my_host = host
+    if not my_host:
+        ifaces = env_util.get_str(env_util.HVD_NETWORK_INTERFACE)
+        if ifaces:
+            my_host = _iface_ip(ifaces)
+            if my_host is None:
+                raise RuntimeError(
+                    f"none of the interfaces in "
+                    f"--network-interface={ifaces!r} has an IPv4 "
+                    "address on this worker"
+                )
     ring = None
     addr = b""
     try:
         ring = Ring(rank, nranks)
-        my_host = host or env_util.get_str("HVD_RING_HOST") \
+        my_host = my_host or env_util.get_str("HVD_RING_HOST") \
             or socket.gethostbyname(socket.gethostname())
         addr = f"{my_host}:{ring.port}".encode()
     except Exception as e:  # noqa: BLE001

@@ -1,0 +1,7 @@
+"""horovod_tpu.spark.keras — import-path parity with the reference's
+``horovod.spark.keras`` (reference horovod/spark/keras/__init__.py:
+exposes KerasEstimator/KerasModel).  The implementation lives in
+horovod_tpu/estimator/frameworks.py; this module is the reference-shaped
+entry point."""
+
+from ..estimator.frameworks import KerasEstimator  # noqa: F401

@@ -1,0 +1,12 @@
+"""horovod_tpu.spark.torch — import-path parity with the reference's
+``horovod.spark.torch`` (reference horovod/spark/torch/__init__.py:
+exposes TorchEstimator/TorchModel).  The implementation lives in
+horovod_tpu/estimator/frameworks.py; this module is the reference-shaped
+entry point."""
+
+from ..estimator.frameworks import (  # noqa: F401
+    TorchEstimator, TorchEstimatorModel,
+)
+
+# reference naming: horovod.spark.torch.TorchModel is the fitted artifact
+TorchModel = TorchEstimatorModel

@@ -38,6 +38,9 @@ HVD_HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
 HVD_CACHE_CAPACITY = "HVD_CACHE_CAPACITY"
 HVD_BATCH_D2D_MEMCOPIES = "HVD_BATCH_D2D_MEMCOPIES"
 HVD_NUM_NCCL_STREAMS = "HVD_NUM_NCCL_STREAMS"          # parity stub
+# comma list of NIC names the host data plane advertises on (reference
+# --network-interface / HOROVOD_GLOO_IFACE + NCCL_SOCKET_IFNAME)
+HVD_NETWORK_INTERFACE = "HVD_NETWORK_INTERFACE"
 # launcher-set topology vars (analog of HOROVOD_RANK/SIZE/LOCAL_RANK/... set
 # by gloo_run, reference run/gloo_run.py:210-216)
 HVD_RANK = "HVD_RANK"

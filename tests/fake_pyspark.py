@@ -207,5 +207,6 @@ def install() -> types.ModuleType:
 
 def uninstall() -> None:
     for name in ("pyspark", "pyspark.sql", "pyspark.ml",
-                 "pyspark.ml.linalg", "horovod_tpu.spark"):
+                 "pyspark.ml.linalg", "horovod_tpu.spark",
+                 "horovod_tpu.spark.torch", "horovod_tpu.spark.keras"):
         sys.modules.pop(name, None)

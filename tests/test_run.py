@@ -362,3 +362,58 @@ def test_tpu_flag_resolves_hosts(monkeypatch):
     hosts = _resolve_hosts(args)
     assert [(h.hostname, h.slots) for h in hosts] == [
         ("pod-a", 8), ("pod-b", 8)]
+
+
+def test_check_build_report():
+    """tpurun --check-build prints the availability matrix and exits 0
+    (reference run/run.py:289-324 check_build)."""
+    import contextlib
+    import io
+
+    from horovod_tpu.run.run import check_build, run_commandline
+
+    report = check_build()
+    assert "Available Frameworks" in report
+    assert "[X] JAX / flax" in report
+    assert "PyTorch" in report and "MXNet" in report and "Spark" in report
+    assert "Available Controllers" in report
+    assert "native (C++ TCP negotiation" in report
+    assert "XLA collectives (ICI/DCN)" in report
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = run_commandline(["--check-build"])
+    assert rc == 0
+    assert "Available Frameworks" in buf.getvalue()
+
+
+def test_network_interface_flag_and_resolution(monkeypatch):
+    """--network-interface reaches workers as HVD_NETWORK_INTERFACE and
+    each worker resolves the first live NIC locally (reference
+    --network-interface; loopback is always resolvable in CI)."""
+    from horovod_tpu.run import config_parser
+    from horovod_tpu.run.run import parse_args
+    from horovod_tpu.runtime.ring import _iface_ip
+
+    args = parse_args(["--network-interface", "eth0,lo",
+                       "-np", "2", "python", "x.py"])
+    env = config_parser.env_from_args(args)
+    assert env["HVD_NETWORK_INTERFACE"] == "eth0,lo"
+
+    assert _iface_ip("lo") == "127.0.0.1"
+    assert _iface_ip("definitely-not-a-nic") is None
+    # the comma list takes the first interface that resolves
+    assert _iface_ip("definitely-not-a-nic,lo") == "127.0.0.1"
+
+
+def test_unresolvable_mandated_nic_raises(monkeypatch):
+    """A --network-interface list that resolves on no NIC must FAIL the
+    launch, not silently advertise another interface (reference errors
+    on an absent GLOO_IFACE/NCCL_SOCKET_IFNAME the same way)."""
+    import pytest as _pytest
+
+    from horovod_tpu.runtime import ring as ring_mod
+
+    monkeypatch.setenv("HVD_NETWORK_INTERFACE", "definitely-not-a-nic")
+    with _pytest.raises(RuntimeError, match="network-interface"):
+        ring_mod.establish(None, 0, 2)

@@ -65,6 +65,27 @@ def test_run_rank_order(spark_env):
     assert hvd_spark.run(whoami, num_proc=2) == [0, 1]
 
 
+def test_reference_shaped_submodules(spark_env):
+    """Reference import paths: horovod.spark.torch.TorchEstimator /
+    horovod.spark.keras.KerasEstimator (reference
+    spark/{torch,keras}/__init__.py) map onto the estimator package."""
+    import horovod_tpu.spark.torch as hvd_spark_torch
+
+    from horovod_tpu.estimator.frameworks import TorchEstimator
+
+    assert hvd_spark_torch.TorchEstimator is TorchEstimator
+    assert hvd_spark_torch.TorchModel is hvd_spark_torch.TorchEstimatorModel
+
+    import horovod_tpu.spark.keras as hvd_spark_keras
+
+    assert hasattr(hvd_spark_keras, "KerasEstimator")
+
+    import horovod_tpu.spark as hvd_spark
+
+    assert hvd_spark.TorchEstimator is TorchEstimator
+    assert callable(hvd_spark.prepare_data)
+
+
 def test_run_fails_fast_without_native(spark_env, monkeypatch):
     """ADVICE round-2: a >1-proc gang without a transport must not
     launch (its collectives would hang)."""
