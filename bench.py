@@ -11,8 +11,11 @@ our img/sec/chip ÷ 103.55.
 
 Configuration (from the round-2 profiling study, docs/PERF.md): batch 128
 (measured sweet spot on the v5e: the 56x56-stage activations are HBM-
-bound, smaller batch wins), bf16 compute, 10 optimizer steps compiled
-into one program via lax.scan (amortizes host dispatch over the tunnel).
+bound, smaller batch wins), bf16 compute, 50 optimizer steps compiled
+into one program via lax.scan.  Round 4 re-measured the in-graph step
+count interleaved on a quiet chip: k=50 beats k=10 by ~15% (2645/2611 vs
+2300/2204 img/s across two windows each) — at k=10 the tunnel's per-call
+dispatch+sync overhead still costs a double-digit share of the step.
 
 MFU accounting: ResNet-50 training ≈ 3 x 4.09 GFLOPs forward = 12.27
 GFLOPs/image of model math (the usual analytic count; XLA's own
@@ -36,9 +39,9 @@ def main() -> None:
 
     args = parse_args([
         "--batch-size", "128",
-        "--num-in-graph-steps", "10",
-        "--num-warmup-batches", "2",
-        "--num-batches-per-iter", "2",
+        "--num-in-graph-steps", "50",
+        "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "1",
         "--num-iters", "3",
     ])
     result = run(args)
