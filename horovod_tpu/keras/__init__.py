@@ -1,0 +1,21 @@
+"""horovod_tpu.keras — standalone-Keras entry point.
+
+Mirror of ``horovod.keras`` (reference horovod/keras/__init__.py:33-60:
+builds its DistributedOptimizer over the TensorFlow backend via
+``_impl.create_distributed_optimizer``, plus the shared callbacks from
+horovod/_keras).  In the TF2/Keras-3 era standalone Keras rides the same
+backend, so this module re-exports the tensorflow.keras binding surface
+— ``import horovod_tpu.keras as hvd`` works exactly like the reference's
+``import horovod.keras as hvd``.
+"""
+
+from ..core import (  # noqa: F401 — capability probes (reference parity)
+    ccl_built, ddl_built, gloo_built, gloo_enabled, mpi_built,
+    mpi_enabled, mpi_threads_supported, nccl_built,
+)
+from ..tensorflow.keras import (  # noqa: F401
+    Compression, DistributedOptimizer, allgather, allreduce, broadcast,
+    broadcast_object, broadcast_variables, callbacks, cross_rank,
+    cross_size, init, is_initialized, load_model, local_rank,
+    local_size, rank, shutdown, size,
+)

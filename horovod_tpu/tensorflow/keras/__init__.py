@@ -9,3 +9,44 @@ from .. import (  # noqa: F401
     DistributedOptimizer,
 )
 from . import callbacks  # noqa: F401
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved Keras model with its optimizer re-wrapped in
+    :func:`DistributedOptimizer`, restored slot state included
+    (reference horovod/tensorflow/keras/__init__.py load_model /
+    horovod/keras/__init__.py:117).
+
+    The reference intercepts optimizer deserialization with a
+    ``custom_objects`` wrapping factory; Keras 3 resolves its built-in
+    optimizers from the internal registry before consulting
+    ``custom_objects``, so the equivalent here is a post-load re-wrap:
+    the deserialized optimizer's restored variables (iteration count,
+    momenta, ...) are copied into the Distributed subclass built from
+    its config — same net result, retraining picks up where the save
+    left off, now with allreduced gradients.
+
+    ``custom_optimizers`` is accepted for signature parity (Keras 3
+    deserializes custom optimizer classes via ``custom_objects`` /
+    ``keras.saving.register_keras_serializable``)."""
+    del custom_optimizers  # Keras 3: registration handles custom classes
+    import tensorflow as tf
+
+    model = tf.keras.models.load_model(filepath,
+                                       custom_objects=custom_objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is None or getattr(type(opt), "_hvd_distributed", False):
+        return model
+    wrapped = DistributedOptimizer(opt, compression=compression)
+    if getattr(opt, "built", False):
+        wrapped.build(model.trainable_variables)
+        # strict: a silent length mismatch would resume training from
+        # partially-zeroed slot state with no error
+        for dst, src in zip(wrapped.variables, opt.variables,
+                            strict=True):
+            dst.assign(src)
+    # swap in place: compile() would discard the restored loss/metrics
+    # wiring, and Keras 3's train_step reads self.optimizer directly
+    model.optimizer = wrapped
+    return model

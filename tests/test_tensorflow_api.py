@@ -204,3 +204,45 @@ def test_keras_lr_schedule_callback():
         Spy(),
     ])
     np.testing.assert_allclose(seen, [0.1, 0.01, 0.001], rtol=1e-5)
+
+
+def test_standalone_keras_entry_point():
+    """import horovod_tpu.keras as hvd — the reference's horovod.keras
+    surface (reference keras/__init__.py) maps onto the TF binding."""
+    import horovod_tpu.keras as hvd_keras
+    import horovod_tpu.tensorflow.keras as tf_keras
+
+    assert hvd_keras.DistributedOptimizer is tf_keras.DistributedOptimizer
+    assert hvd_keras.callbacks is tf_keras.callbacks
+    for name in ("init", "rank", "size", "allreduce", "broadcast",
+                 "broadcast_variables", "Compression", "load_model",
+                 "mpi_built", "nccl_built", "gloo_built",
+                 "mpi_threads_supported"):
+        assert hasattr(hvd_keras, name), name
+
+
+def test_keras_load_model_rewraps_optimizer(tmp_path):
+    """hvd.load_model restores a saved model with its optimizer wrapped
+    in DistributedOptimizer (reference keras/__init__.py:117-150)."""
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.keras as hvd_keras
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)), tf.keras.layers.Dense(2),
+    ])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y = np.zeros((8, 2), np.float32)
+    model.fit(x, y, epochs=1, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+
+    loaded = hvd_keras.load_model(path)
+    # the optimizer is re-wrapped as a dynamic Distributed subclass of
+    # the saved SGD, with the restored iteration count carried over
+    assert isinstance(loaded.optimizer, tf.keras.optimizers.SGD)
+    assert getattr(type(loaded.optimizer), "_hvd_distributed", False)
+    assert int(loaded.optimizer.iterations) == int(model.optimizer.iterations)
+    loaded.fit(x, y, epochs=1, verbose=0)  # and it still trains
