@@ -1,0 +1,8 @@
+"""horovod_tpu.run — the launcher package.
+
+Re-exports the function-mode API at the package level so
+``from horovod_tpu.run import run`` works exactly like the reference's
+``from horovod.run import run`` (reference horovod/run/__init__.py:16).
+"""
+
+from .run import run, run_commandline  # noqa: F401

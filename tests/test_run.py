@@ -255,7 +255,13 @@ def test_tpurun_failure_propagates(tmp_path):
 
 
 def test_function_mode_run():
-    import horovod_tpu.run.run as tpurun
+    # note: `import horovod_tpu.run.run as x` would bind the FUNCTION
+    # (the package __init__ re-exports `run` over the submodule
+    # attribute, exactly like reference horovod/run/__init__.py); load
+    # the module through sys.modules semantics instead
+    import importlib
+
+    tpurun = importlib.import_module("horovod_tpu.run.run")
 
     def fn(a, b):
         import os
@@ -417,3 +423,12 @@ def test_unresolvable_mandated_nic_raises(monkeypatch):
     monkeypatch.setenv("HVD_NETWORK_INTERFACE", "definitely-not-a-nic")
     with _pytest.raises(RuntimeError, match="network-interface"):
         ring_mod.establish(None, 0, 2)
+
+
+def test_package_level_run_export():
+    """from horovod_tpu.run import run — the reference's import path
+    (reference horovod/run/__init__.py:16)."""
+    from horovod_tpu.run import run as fn
+    from horovod_tpu.run.run import run as fn_module_path
+
+    assert fn is fn_module_path
