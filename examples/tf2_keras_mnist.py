@@ -47,8 +47,8 @@ def main(argv=None) -> float:
     x, y = x[r::n_proc], y[r::n_proc]
 
     model = tf.keras.Sequential([
-        tf.keras.layers.Dense(128, activation="relu",
-                              input_shape=(28 * 28,)),
+        tf.keras.layers.Input((28 * 28,)),
+        tf.keras.layers.Dense(128, activation="relu"),
         tf.keras.layers.Dense(10),
     ])
     # scale LR by world size (reference: lr * hvd.size())

@@ -119,12 +119,32 @@ def main() -> None:
     elif args.quick:
         configs = [(8, 1024), (16, 1024)]
     else:
+        # b48 is the single-chip HBM limit at s1024 (b64 OOMs the
+        # 5-step program)
         configs = [(4, 512), (8, 512), (8, 1024), (16, 1024),
-                   (32, 1024), (8, 2048), (16, 2048)]
+                   (32, 1024), (48, 1024), (8, 2048), (16, 2048)]
+
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out")
+    os.makedirs(dest, exist_ok=True)
+    path = os.path.join(dest, "gpt_mfu_sweep.json")
+    # read the mergeable prior rows BEFORE burning device time: a
+    # corrupt artifact (e.g. a killed non-atomic write) must not crash
+    # the script after the sweep, and rows measured against a different
+    # ceiling must not mix into this run's ratios
+    existing = []
+    try:
+        with open(path) as f:
+            existing = [
+                r for r in json.load(f).get("configs", [])
+                if r.get("ceiling_tflops") == MEASURED_CEILING_TFLOPS
+            ]
+    except (OSError, ValueError):
+        existing = []
 
     rows = []
     for batch, seq in configs:
         r = run_config(batch, seq)
+        r["ceiling_tflops"] = MEASURED_CEILING_TFLOPS
         rows.append(r)
         print(
             f"b{batch} s{seq}: {r['ms_per_step']:.1f} ms/step  "
@@ -135,6 +155,11 @@ def main() -> None:
             flush=True,
         )
 
+    # merge into the existing artifact by (batch, seq): a partial
+    # --configs run must not clobber the rest of the sweep
+    keyed = {(r["batch"], r["seq"]): r for r in existing}
+    keyed.update({(r["batch"], r["seq"]): r for r in rows})
+    rows = sorted(keyed.values(), key=lambda r: (r["seq"], r["batch"]))
     best = max(rows, key=lambda r: r["mfu_vs_measured_ceiling"])
     out = {
         "model": "gpt2_small (124M, bf16, causal flash attention)",
@@ -146,11 +171,10 @@ def main() -> None:
         "configs": rows,
         "best": best,
     }
-    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out")
-    os.makedirs(dest, exist_ok=True)
-    path = os.path.join(dest, "gpt_mfu_sweep.json")
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(out, f, indent=2)
+    os.replace(tmp, path)  # atomic: a killed run can't truncate the artifact
     print(f"best: b{best['batch']} s{best['seq']} -> "
           f"{best['mfu_vs_measured_ceiling']:.1%} of measured ceiling")
     print(f"wrote {path}")

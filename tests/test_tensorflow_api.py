@@ -127,7 +127,8 @@ def test_keras_model_fit_with_callbacks(tmp_path):
     y = rng.integers(0, 2, size=(32,)).astype(np.int32)
 
     model = tf.keras.Sequential([
-        tf.keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(8, activation="relu"),
         tf.keras.layers.Dense(2),
     ])
     opt = hvd_keras.DistributedOptimizer(
@@ -184,7 +185,8 @@ def test_keras_lr_schedule_callback():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(16, 4)).astype(np.float32)
     y = rng.integers(0, 2, size=(16,)).astype(np.int32)
-    model = tf.keras.Sequential([tf.keras.layers.Dense(2, input_shape=(4,))])
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input((4,)), tf.keras.layers.Dense(2)])
     model.compile(
         optimizer=tf.keras.optimizers.SGD(learning_rate=0.1),
         loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
