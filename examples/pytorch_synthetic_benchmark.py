@@ -162,7 +162,7 @@ def run(args) -> dict:
         loss = F.cross_entropy(model(data), target)
         loss.backward()
         opt.step()
-        return float(loss)
+        return float(loss.detach())
 
     from horovod_tpu import core
 
