@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models.resnet import MODELS
+from horovod_tpu.models import MODELS
 from horovod_tpu.training import (
     TrainState, init_train_state, make_train_step, shard_batch,
 )

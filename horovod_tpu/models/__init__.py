@@ -1,0 +1,24 @@
+"""Image-classification model registry: the families the reference's
+benchmark scripts instantiate from Keras applications (reference
+examples/tensorflow2_synthetic_benchmark.py:64 getattr(applications,
+args.model); the published scaling table covers Inception V3,
+ResNet-101, and VGG-16, reference README.rst:75-77).  The transformer
+families live in their submodules (models/gpt.py, models/bert.py) with
+their own benchmark harnesses — they take token inputs, not images."""
+
+from .inception import InceptionV3  # noqa: F401
+from .resnet import MODELS as _RESNET_MODELS
+from .resnet import (  # noqa: F401
+    ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+)
+from .vgg import VGG11, VGG16, VGG19  # noqa: F401
+
+# the --model CLI registry; spread from resnet.MODELS (kept for
+# backwards compatibility) so the two can never diverge
+MODELS = {
+    **_RESNET_MODELS,
+    "VGG11": VGG11,
+    "VGG16": VGG16,
+    "VGG19": VGG19,
+    "InceptionV3": InceptionV3,
+}

@@ -1,0 +1,191 @@
+"""Inception V3 (flax/linen), TPU-first.
+
+Inception V3 headlines the reference's published scaling table
+(reference README.rst:75-77, docs/benchmarks.rst:12-13: 90% scaling
+efficiency at 512 GPUs) and its benchmark scripts instantiate the Keras
+application (reference examples/tensorflow2_synthetic_benchmark.py
+``getattr(applications, args.model)``).  This is the standard published
+architecture (Szegedy et al. 2015, "Rethinking the Inception
+Architecture") built natively: the factorized 7x1/1x7 and 3x1/1x3
+branches are exactly the mix of skinny convolutions that exercises MXU
+tiling differently from ResNet's uniform 3x3s.
+
+Same TPU conventions as models/resnet.py: NHWC, bf16 compute with f32
+params, BN statistics per replica, no Python control flow in the
+forward pass.  Input: 299x299x3 (the canonical shape; any spatial size
+>= 75 works).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """conv + BN + ReLU, the Inception building block (all ~94 convs)."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features, self.kernel, strides=self.strides,
+            padding=self.padding, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not self.train, momentum=0.9,
+            epsilon=1e-3, dtype=self.dtype, param_dtype=self.param_dtype,
+        )(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(64, (1, 1))(x)
+        b5 = self.conv(48, (1, 1))(x)
+        b5 = self.conv(64, (5, 5))(b5)
+        b3 = self.conv(64, (1, 1))(x)
+        b3 = self.conv(96, (3, 3))(b3)
+        b3 = self.conv(96, (3, 3))(b3)
+        bp = self.conv(self.pool_features, (1, 1))(_avg_pool_same(x))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.conv(384, (3, 3), strides=(2, 2), padding="VALID")(x)
+        bd = self.conv(64, (1, 1))(x)
+        bd = self.conv(96, (3, 3))(bd)
+        bd = self.conv(96, (3, 3), strides=(2, 2), padding="VALID")(bd)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches (1x7 / 7x1)."""
+
+    c7: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.c7
+        b1 = self.conv(192, (1, 1))(x)
+        b7 = self.conv(c7, (1, 1))(x)
+        b7 = self.conv(c7, (1, 7))(b7)
+        b7 = self.conv(192, (7, 1))(b7)
+        bd = self.conv(c7, (1, 1))(x)
+        bd = self.conv(c7, (7, 1))(bd)
+        bd = self.conv(c7, (1, 7))(bd)
+        bd = self.conv(c7, (7, 1))(bd)
+        bd = self.conv(192, (1, 7))(bd)
+        bp = self.conv(192, (1, 1))(_avg_pool_same(x))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.conv(192, (1, 1))(x)
+        b3 = self.conv(320, (3, 3), strides=(2, 2), padding="VALID")(b3)
+        b7 = self.conv(192, (1, 1))(x)
+        b7 = self.conv(192, (1, 7))(b7)
+        b7 = self.conv(192, (7, 1))(b7)
+        b7 = self.conv(192, (3, 3), strides=(2, 2), padding="VALID")(b7)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded filter banks (split 1x3 / 3x1 outputs concatenated)."""
+
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(320, (1, 1))(x)
+        b3 = self.conv(384, (1, 1))(x)
+        b3 = jnp.concatenate([
+            self.conv(384, (1, 3))(b3),
+            self.conv(384, (3, 1))(b3),
+        ], axis=-1)
+        bd = self.conv(448, (1, 1))(x)
+        bd = self.conv(384, (3, 3))(bd)
+        bd = jnp.concatenate([
+            self.conv(384, (1, 3))(bd),
+            self.conv(384, (3, 1))(bd),
+        ], axis=-1)
+        bp = self.conv(192, (1, 1))(_avg_pool_same(x))
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype,
+                       param_dtype=self.param_dtype, train=train)
+
+        def c(features, kernel, **kw):
+            return conv(features=features, kernel=kernel, **kw)
+
+        x = x.astype(self.dtype)
+        # stem: 299 -> 35x35x192
+        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x)
+        x = c(32, (3, 3), padding="VALID")(x)
+        x = c(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = c(80, (1, 1), padding="VALID")(x)
+        x = c(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 35x35 stage
+        x = InceptionA(pool_features=32, conv=c)(x)
+        x = InceptionA(pool_features=64, conv=c)(x)
+        x = InceptionA(pool_features=64, conv=c)(x)
+        x = InceptionB(conv=c)(x)
+        # 17x17 stage
+        x = InceptionC(c7=128, conv=c)(x)
+        x = InceptionC(c7=160, conv=c)(x)
+        x = InceptionC(c7=160, conv=c)(x)
+        x = InceptionC(c7=192, conv=c)(x)
+        x = InceptionD(conv=c)(x)
+        # 8x8 stage
+        x = InceptionE(conv=c)(x)
+        x = InceptionE(conv=c)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,76 @@
+"""VGG family (flax/linen), TPU-first.
+
+VGG-16 is one of the three models in the reference's published scaling
+table (reference README.rst:75-77, docs/benchmarks.rst:12-13: 68%
+scaling efficiency at 512 GPUs — the hardest of the three because its
+~138M dense-heavy parameters make the gradient allreduce enormous).
+Providing it natively keeps that benchmark reproducible here: the
+~500 MB of fp32 gradients per step is exactly the payload that stresses
+the fused-bucket allreduce.
+
+Same TPU conventions as models/resnet.py: NHWC, bf16 compute with f32
+params, no Python control flow in the forward pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# channels per conv, "M" = 2x2 maxpool (the classic configurations)
+_CFGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    batch_norm: bool = False  # the classic nets are BN-free
+    # 0.0 by default so the model drops into make_train_step (which
+    # passes no 'dropout' rng — synthetic benchmarks don't regularize);
+    # pass 0.5 + rngs={'dropout': key} at apply time for classic VGG
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding=1,
+                       dtype=self.dtype, param_dtype=self.param_dtype)
+        x = x.astype(self.dtype)
+        for item in self.cfg:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(features=item)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(
+                        use_running_average=not train, momentum=0.9,
+                        epsilon=1e-5, dtype=self.dtype,
+                        param_dtype=self.param_dtype,
+                    )(x)
+                x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        dense = partial(nn.Dense, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+        x = nn.relu(dense(4096)(x))
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(dense(4096)(x))
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = dense(self.num_classes)(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, cfg=_CFGS[11])
+VGG16 = partial(VGG, cfg=_CFGS[16])
+VGG19 = partial(VGG, cfg=_CFGS[19])

@@ -36,7 +36,7 @@ def main(argv=None) -> dict:
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import MODELS
+    from horovod_tpu.models import MODELS
     from horovod_tpu.timeline.comm_report import collective_report
     from horovod_tpu.training import (
         init_train_state, make_train_step, shard_batch,

@@ -1,0 +1,108 @@
+"""VGG + Inception V3 families (the other two models in the reference's
+published scaling table, reference README.rst:75-77) — forward shapes,
+parameter counts against the published architectures, and a train step
+through make_train_step on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MODELS, InceptionV3, VGG16
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init(devices=jax.devices("cpu")[:2])
+
+
+def _param_count(params):
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def test_registry_covers_reference_benchmark_models():
+    for name in ("InceptionV3", "ResNet101", "VGG16", "ResNet50"):
+        assert name in MODELS, name
+
+
+def test_vgg16_shapes_and_params():
+    model = VGG16(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    # 13 conv layers + 3 dense layers
+    convs = [k for k in variables["params"] if k.startswith("Conv")]
+    denses = [k for k in variables["params"] if k.startswith("Dense")]
+    assert len(convs) == 13 and len(denses) == 3
+    # conv stack params are input-size independent: 14.71M (published)
+    conv_params = sum(
+        _param_count(variables["params"][k]) for k in convs
+    )
+    assert abs(conv_params - 14_714_688) < 1000, conv_params
+
+
+def test_inception_v3_shapes_and_params():
+    model = InceptionV3(num_classes=1000, dtype=jnp.float32)
+    # params are input-size independent (global mean pool before the
+    # head); 96x96 keeps the CPU compile an order of magnitude cheaper
+    # than the canonical 299x299
+    x = jnp.zeros((1, 96, 96, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 1000)
+    # published parameter count for keras InceptionV3: 23.85M
+    total = _param_count(variables["params"]) + _param_count(
+        variables["batch_stats"]
+    )
+    assert 23.0e6 < total < 25.0e6, total
+
+
+def test_vgg_train_step():
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model = VGG16(num_classes=4, dtype=jnp.float32)
+    opt = optax.sgd(0.01)
+    step = make_train_step(
+        apply_fn=model.apply,
+        loss_fn=lambda logits, y:
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(),
+        optimizer=opt,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 32, 32, 3)))
+    rng = np.random.default_rng(0)
+    x = shard_batch(rng.uniform(size=(2, 32, 32, 3)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 4, size=(2,)).astype(np.int32))
+    state, loss = step(state, x, y)
+    assert np.isfinite(float(np.asarray(jax.device_get(loss))))
+
+
+def test_inception_train_step():
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model = InceptionV3(num_classes=4, dtype=jnp.float32)
+    opt = optax.sgd(0.01)
+    step = make_train_step(
+        apply_fn=model.apply,
+        loss_fn=lambda logits, y:
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(),
+        optimizer=opt, has_batch_stats=True,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 96, 96, 3)),
+                             has_batch_stats=True)
+    rng = np.random.default_rng(0)
+    x = shard_batch(rng.uniform(size=(2, 96, 96, 3)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 4, size=(2,)).astype(np.int32))
+    state, loss = step(state, x, y)
+    assert np.isfinite(float(np.asarray(jax.device_get(loss))))
