@@ -22,10 +22,21 @@ GFLOPs/image of model math (the usual analytic count; XLA's own
 cost_analysis reports 23.9 GFLOPs/image because strided-conv gradients
 lower to dilated convs that multiply zeros).  Peak = 197 TFLOPS bf16 per
 v5e chip.
+
+Outage handling (round-5): the tunneled chip has TWO failure modes —
+``jax.devices()`` raising UNAVAILABLE, and ``jax.devices()`` HANGING
+(the axon plugin's make_c_api_client blocks forever when the tunnel is
+down).  Both the probe and the measurement therefore run in CHILD
+processes under hard timeouts, with a bounded retry, so a transient blip
+at capture time degrades to one structured JSON error line (rc 0)
+instead of a traceback or a hung driver.
 """
 
 import json
+import os
+import subprocess
 import sys
+import time
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
@@ -33,8 +44,21 @@ BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:27-41
 MODEL_FLOPS_PER_IMG = 12.27e9               # 3x forward, analytic
 V5E_PEAK_FLOPS = 197e12                     # bf16 per chip
 
+PROBE_TIMEOUT_S = 90       # jax.devices() normally returns in seconds
+RUN_TIMEOUT_S = 560        # compile (~40 s) + 3 measured iters, generous
+ATTEMPTS = 3
+RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
-def main() -> None:
+
+def _measure() -> None:
+    """Child-process entry: touch the TPU and print the result line."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # same guard as the probe, in-process
+        raise RuntimeError(
+            "refusing to publish a CPU number as the per-chip TPU metric"
+        )
     from examples.synthetic_benchmark import parse_args, run
 
     args = parse_args([
@@ -47,7 +71,7 @@ def main() -> None:
     result = run(args)
     per_chip = result["img_sec_per_chip"]
     mfu = per_chip * MODEL_FLOPS_PER_IMG / V5E_PEAK_FLOPS
-    print(json.dumps({
+    print("RESULT " + json.dumps({
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
@@ -58,5 +82,72 @@ def main() -> None:
     }))
 
 
+def _probe() -> str:
+    """'ok' if a child process can enumerate an ACCELERATOR within the
+    timeout; otherwise a short reason ('hang', 'unavailable',
+    'cpu_only').  A CPU-only backend (e.g. the axon plugin not
+    registered because PALLAS_AXON_POOL_IPS is unset) must read as an
+    outage — otherwise the benchmark would silently publish a CPU
+    number as the per-chip TPU metric."""
+    code = ("import jax; d = jax.devices(); "
+            "print('PLATFORM', d[0].platform)")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=PROBE_TIMEOUT_S, cwd=os.path.dirname(
+                os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return "hang"
+    if p.returncode != 0 or "PLATFORM" not in p.stdout:
+        return "unavailable"
+    platform = p.stdout.split("PLATFORM", 1)[1].strip().split()[0]
+    return "ok" if platform != "cpu" else "cpu_only"
+
+
+def main() -> None:
+    errors = []
+    for attempt in range(ATTEMPTS):
+        if attempt:
+            time.sleep(RETRY_DELAY_S)
+        status = _probe()
+        if status != "ok":
+            errors.append(f"probe {attempt + 1}: {status}")
+            continue
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=RUN_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"run {attempt + 1}: timeout after "
+                          f"{RUN_TIMEOUT_S}s")
+            continue
+        lines = [ln for ln in p.stdout.splitlines()
+                 if ln.startswith("RESULT ")]
+        if p.returncode == 0 and lines:
+            print(lines[-1][len("RESULT "):])
+            return
+        tail = (p.stderr or p.stdout).strip().splitlines()[-1:]
+        errors.append(
+            f"run {attempt + 1}: rc={p.returncode} {' '.join(tail)[:200]}")
+    # every attempt failed: one structured line, clean exit — the driver
+    # records a skip, not a crash (round-4 lost its number to a traceback)
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": "tpu_unavailable",
+        "attempts": errors,
+        "note": "TPU tunnel unreachable at capture time; last driver-"
+                "verified value 2474.8 (BENCH_r03), builder-measured "
+                "2636 (docs/PERF.md)",
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _measure()
+    else:
+        main()
