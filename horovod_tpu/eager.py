@@ -192,8 +192,16 @@ def _replicate_fn(pmesh):
 
 @_functools.lru_cache(maxsize=64)
 def _sum_rows_fn(pmesh):
-    return jax.jit(lambda x: jnp.sum(x, axis=0, dtype=x.dtype),
-                   out_shardings=NamedSharding(pmesh, P()))
+    # Half-precision rows accumulate in f32 so the mesh transport matches
+    # the native host plane's numerics (csrc reduces in double); the call
+    # site's astype(arr.dtype) casts back.  Integer/f32+ sums keep their
+    # own dtype — widening them would lose int64 exactness.
+    def _sum(x):
+        acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) \
+            else x.dtype
+        return jnp.sum(x, axis=0, dtype=acc)
+
+    return jax.jit(_sum, out_shardings=NamedSharding(pmesh, P()))
 
 
 @_functools.lru_cache(maxsize=64)
@@ -313,6 +321,23 @@ _WIRE_OPS = {Average: "allreduce", Sum: "allreduce", Min: "min",
 _WIRE_DTYPES = ("float32", "float64", "int32", "int64", "bfloat16", "float16")
 
 
+def _agree_meta(arr: np.ndarray, nm: str, opname: str) -> List[tuple]:
+    """The tiny dtype-agnostic (shape, dtype) allgather every rank runs
+    BEFORE a transport branch, returning the gathered shapes.  Transport
+    must be chosen from these GATHERED facts — a rank-local decision
+    (e.g. keyed on the local dtype) would let mismatched inputs send
+    ranks down different collectives and hang the job instead of raising
+    (reference coordinator validation, controller.cc:377-610).  Raises
+    the same ValueError on every rank for a dtype mismatch; shape rules
+    differ per op, so callers check the returned shapes themselves."""
+    metas = allgather_object((tuple(arr.shape), str(arr.dtype)),
+                             name=f"{nm}.meta")
+    dtypes = [m[1] for m in metas]
+    if len(set(dtypes)) > 1:
+        raise ValueError(f"{opname} dtype mismatch across ranks: {dtypes}")
+    return [tuple(m[0]) for m in metas]
+
+
 def process_allreduce(arr, *, op: str = Average,
                       name: Optional[str] = None) -> np.ndarray:
     """Reduce one numpy array per controller process (host plane).
@@ -361,11 +386,24 @@ def process_allreduce(arr, *, op: str = Average,
     # never a pickled O(nproc·payload) gather — matching the reference's
     # CPU path, which is always a Gloo ring/halving-doubling (reference
     # horovod/common/ops/gloo_operations.cc:120-158).
+    #
+    # The transport branch below keys on dtype, so — exactly like
+    # process_allgather — every rank first agrees on (shape, dtype) via
+    # a tiny metadata allgather and raises on mismatch; a rank-local
+    # branch would let mismatched inputs execute different collectives
+    # and hang the job (reference coordinator validation,
+    # controller.cc:377-610).
+    nm = name or eager_controller.next_name("process_allreduce")
+    shapes = _agree_meta(arr, nm, "process_allreduce")
+    if len(set(shapes)) > 1:
+        raise ValueError(
+            f"process_allreduce shape mismatch across ranks: {shapes}"
+        )
     if str(arr.dtype) not in _WIRE_DTYPES:
         # exotic dtypes (complex, object...) cannot ride the mesh without
         # a lossy cast; reduce the pickled gather exactly, as before
         stacked = np.stack(
-            [np.asarray(g) for g in allgather_object(arr, name=name)]
+            [np.asarray(g) for g in allgather_object(arr, name=nm)]
         )
         if op == Average:
             out = stacked.mean(0)
@@ -381,7 +419,6 @@ def process_allreduce(arr, *, op: str = Average,
             out = numpy_adasum(list(stacked))
         return out.astype(arr.dtype)
     wire = arr  # wire dtype guaranteed by the branch above
-    nm = name or eager_controller.next_name("process_allreduce")
     with inspector.watch(nm), timeline.span(nm, "MESH_ALLREDUCE"):
         if op in (Average, Sum):
             out = _mesh_sum_rows(wire)
@@ -421,23 +458,14 @@ def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
     # decision here (e.g. keyed on the local dtype) would let mismatched
     # inputs send ranks down different branches and hang the job instead
     # of raising.
-    metas = allgather_object((tuple(arr.shape), str(arr.dtype)),
-                             name=f"{nm}.meta")
-    shapes = [tuple(m[0]) for m in metas]
-    dtypes = [m[1] for m in metas]
-    if len(set(dtypes)) > 1:
-        # explicit cross-rank validation, like the reference coordinator's
-        # dtype-mismatch ERROR response (reference controller.cc:377-610)
-        raise ValueError(
-            f"process_allgather dtype mismatch across ranks: {dtypes}"
-        )
+    shapes = _agree_meta(arr, nm, "process_allgather")
     if len({len(s) for s in shapes}) > 1 or \
             any(s[1:] != shapes[0][1:] for s in shapes):
         raise ValueError(
             "process_allgather shape mismatch across ranks (all dims but "
             f"the first must agree): {shapes}"
         )
-    wire_ok = dtypes[0] in _WIRE_DTYPES
+    wire_ok = str(arr.dtype) in _WIRE_DTYPES
     equal = all(s == shapes[0] for s in shapes)
     if rx is not None and c is not None and wire_ok and equal \
             and arr.nbytes >= _RING_MIN_BYTES:

@@ -458,30 +458,38 @@ def establish(client, rank: int, nranks: int, *,
     # Advertised-address priority: explicit arg > operator's NIC
     # override (--network-interface, resolved per worker) > the
     # launcher-known hostname (HVD_RING_HOST) > self-resolution.
-    # A mandated-but-unresolvable NIC list raises OUTSIDE the degrade
-    # path: silently advertising another interface (typically the
-    # management NIC) would ride the wrong network — fail at launch,
-    # as the reference does for an absent GLOO_IFACE.
+    # A mandated-but-unresolvable NIC list raises: silently advertising
+    # another interface (typically the management NIC) would ride the
+    # wrong network — fail at launch, as the reference does for an
+    # absent GLOO_IFACE.  But the raise happens AFTER both setup
+    # allgathers: a rank that bails before them (heterogeneous NIC
+    # names resolving on some workers only) would leave resolving peers
+    # blocked in establish() until the stall deadline instead of
+    # degrading fast.
+    nic_error: Optional[str] = None
     my_host = host
     if not my_host:
         ifaces = env_util.get_str(env_util.HVD_NETWORK_INTERFACE)
         if ifaces:
             my_host = _iface_ip(ifaces)
             if my_host is None:
-                raise RuntimeError(
+                nic_error = (
                     f"none of the interfaces in "
                     f"--network-interface={ifaces!r} has an IPv4 "
                     "address on this worker"
                 )
+                if client is None:  # no peers to unblock
+                    raise RuntimeError(nic_error)
     ring = None
     addr = b""
-    try:
-        ring = Ring(rank, nranks)
-        my_host = my_host or env_util.get_str("HVD_RING_HOST") \
-            or socket.gethostbyname(socket.gethostname())
-        addr = f"{my_host}:{ring.port}".encode()
-    except Exception as e:  # noqa: BLE001
-        log.warning("ring listener failed: %s", e)
+    if nic_error is None:
+        try:
+            ring = Ring(rank, nranks)
+            my_host = my_host or env_util.get_str("HVD_RING_HOST") \
+                or socket.gethostbyname(socket.gethostname())
+            addr = f"{my_host}:{ring.port}".encode()
+        except Exception as e:  # noqa: BLE001
+            log.warning("ring listener failed: %s", e)
 
     addrs: List[bytes] = client.allgather_data("ring.__setup__", addr)
     ok = ring is not None and all(addrs)
@@ -495,6 +503,10 @@ def establish(client, rank: int, nranks: int, *,
             ok = False
 
     oks = client.allgather_data("ring.__ok__", b"1" if ok else b"0")
+    if nic_error is not None:
+        # both allgathers done — peers have already degraded to the
+        # star consistently; now surface the launch error locally
+        raise RuntimeError(nic_error)
     if not all(o == b"1" for o in oks):
         if ring is not None:
             ring.close()

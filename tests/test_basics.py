@@ -81,3 +81,30 @@ def test_process_rank(hvd_init):
     assert hvd.process_size() == 1
     assert hvd.rank() == 0  # outside SPMD: controller index
     assert hvd.local_rank() == 0
+
+
+def test_mesh_sum_accumulates_half_precision_in_f32(hvd_init):
+    """The process-mesh reduction must match the native host plane's
+    numerics (csrc reduces in double): bf16/f16 rows accumulate in f32,
+    int rows keep their exact dtype (advisor round-4, eager.py)."""
+    from jax.sharding import Mesh
+
+    from horovod_tpu import eager
+
+    devs = np.array(jax.devices("cpu")[:4], dtype=object)
+    pmesh = Mesh(devs, ("proc",))
+
+    # 4 bf16 rows of 0.1: a bf16-accumulated sum of many 0.1s drifts;
+    # f32 accumulation keeps the partial sums exact to f32
+    rows = jnp.full((4, 256), 0.1, jnp.bfloat16)
+    out = eager._sum_rows_fn(pmesh)(rows)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(out),
+        4 * np.full((256,), np.float32(jnp.bfloat16(0.1))),
+        rtol=1e-6,
+    )
+
+    iout = eager._sum_rows_fn(pmesh)(jnp.full((4, 8), 2**24 + 1, jnp.int32))
+    assert iout.dtype == jnp.int32  # widening to f32 would lose exactness
+    assert int(np.asarray(iout)[0]) == 4 * (2**24 + 1)

@@ -130,6 +130,80 @@ def test_metadata_mismatch_raises_on_all_ranks():
         assert "Mismatched tensor metadata" in res
 
 
+def _worker_host_adasum():
+    """Host-plane Adasum through the native controller (csrc AdasumReduce
+    f64 VHDD tree + remainder folding for non-power-of-two sizes)."""
+    import numpy as np
+
+    import jax
+    import horovod_tpu as hvd
+
+    hvd.init(devices=jax.devices("cpu"))
+    r = hvd.process_rank()
+    from horovod_tpu import eager
+
+    row = np.asarray([1.0 + r, -2.0 + 0.25 * r, 0.5 * r], np.float32)
+    out = eager.process_allreduce(row, op=hvd.Adasum, name="host.adasum")
+    return {"rank": r, "n": hvd.process_size(),
+            "adasum": [float(v) for v in out]}
+
+
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_host_plane_adasum_oracle(nproc):
+    """np=2 (power of two) and np=3 (remainder folding) must both match
+    numpy_adasum exactly — the VERDICT round-4 missing item #3."""
+    port = _free_port()
+    results = run(_worker_host_adasum, np=nproc,
+                  extra_env=_controller_env(port))
+    from horovod_tpu.ops.adasum import numpy_adasum
+
+    expected = numpy_adasum([
+        np.asarray([1.0 + r, -2.0 + 0.25 * r, 0.5 * r], np.float32)
+        for r in range(nproc)
+    ])
+    for res in results:
+        assert res["n"] == nproc
+        np.testing.assert_allclose(res["adasum"], expected, rtol=1e-5)
+
+
+def _worker_hetero_nic():
+    """Rank 1's mandated NIC doesn't exist; rank 0's resolves.  The
+    failing rank must still feed both ring-setup allgathers before
+    raising, so rank 0 degrades to the star immediately instead of
+    blocking in establish() until the stall deadline (advisor round-4
+    finding, runtime/ring.py establish)."""
+    import os
+    import time
+
+    rank = os.environ["HVD_PROCESS_ID"]
+    os.environ["HVD_NETWORK_INTERFACE"] = \
+        "lo" if rank == "0" else "no-such-nic0"
+
+    import jax
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import eager_controller
+
+    t0 = time.monotonic()
+    try:
+        hvd.init(devices=jax.devices("cpu"))
+    except RuntimeError as e:
+        return {"rank": rank, "raised": "network-interface" in str(e),
+                "secs": time.monotonic() - t0}
+    return {"rank": rank, "raised": False,
+            "ring": eager_controller.ring() is not None,
+            "secs": time.monotonic() - t0}
+
+
+def test_hetero_nic_degrades_fast_and_raises_on_failing_rank():
+    port = _free_port()
+    results = run(_worker_hetero_nic, np=2, extra_env=_controller_env(port))
+    r0, r1 = results
+    assert r0["raised"] is False and r0["ring"] is False
+    assert r1["raised"] is True
+    # both ranks settle in seconds — neither waits out a stall deadline
+    assert r0["secs"] < 20 and r1["secs"] < 20
+
+
 def _worker_optimizer():
     import numpy as np
 
@@ -209,6 +283,84 @@ def test_tpurun_native_controller_end_to_end(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "RESULT 0 [0.5, 0.5]" in proc.stdout
     assert "RESULT 1 [0.5, 0.5]" in proc.stdout
+
+
+def test_tpurun_sigint_kills_worker_tree(tmp_path):
+    """VERDICT round-4 #7: the launcher's multi-host path end-to-end —
+    real CLI entry, 2 workers (distinct host aliases), native-controller
+    rendezvous, per-rank output capture, and SIGINT to the launcher
+    killing the WHOLE tree (reference gloo_run.py:199-205 signal
+    propagation, :253-259 failure kill)."""
+    import glob
+    import os
+    import signal
+    import time
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, time, jax\n"
+        "import horovod_tpu as hvd\n"
+        "assert os.environ['HVD_NUM_PROCESSES'] == '2'\n"
+        "assert os.environ['HVD_CONTROLLER'] == 'native'\n"
+        "hvd.init(devices=jax.devices('cpu'))\n"
+        "r = hvd.process_rank()\n"
+        "assert hvd.process_size() == 2\n"
+        f"open(os.path.join({str(tmp_path)!r}, f'ready.{{r}}.pid'), "
+        "'w').write(str(os.getpid()))\n"
+        "print('READY', r, flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    logs = tmp_path / "logs"
+    launcher = subprocess.Popen(
+        [sys.executable, "bin/tpurun", "-np", "2",
+         "-H", "localhost:1,127.0.0.1:1",
+         "--output-filename", str(logs),
+         sys.executable, str(script)],
+        cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 120
+        ready = []
+        while time.time() < deadline:
+            ready = sorted(glob.glob(str(tmp_path / "ready.*.pid")))
+            if len(ready) == 2:
+                break
+            assert launcher.poll() is None, \
+                "launcher exited before workers became ready"
+            time.sleep(0.5)
+        assert len(ready) == 2, "workers never reached rendezvous"
+        pids = [int(open(f).read()) for f in ready]
+
+        launcher.send_signal(signal.SIGINT)
+        launcher.communicate(timeout=60)  # exits (rc nonzero: job killed)
+
+        # both workers must be gone — poll up to 30 s for kernel reaping
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            alive = []
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    alive.append(pid)
+                except ProcessLookupError:
+                    pass
+            if not alive:
+                break
+            time.sleep(0.5)
+        assert not alive, f"workers survived launcher SIGINT: {alive}"
+
+        # per-rank output capture tagging (reference gloo_run capture)
+        for r in (0, 1):
+            content = open(logs / f"rank.{r}.txt").read()
+            assert f"READY {r}" in content
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
+            launcher.communicate(timeout=30)
 
 
 def _worker_tensorflow():
@@ -309,11 +461,13 @@ def _worker_jax_distributed():
     # pod without the native controller, numeric reductions must ride the
     # process mesh (O(payload) XLA ops) — NEVER the pickled
     # allgather_object star.  Count pickle-path entries directly.
-    calls = {"n": 0}
+    calls = {"payload": 0, "meta": 0}
     orig_ag = eager.allgather_object
 
     def counting_ag(obj, *, name=None):
-        calls["n"] += 1
+        # (shape, dtype) transport-agreement tuples are tiny and allowed;
+        # an ndarray through pickle means the PAYLOAD took the star
+        calls["meta" if isinstance(obj, tuple) else "payload"] += 1
         return orig_ag(obj, name=name)
 
     eager.allgather_object = counting_ag
@@ -327,16 +481,26 @@ def _worker_jax_distributed():
             np.asarray([1.0 + r, -2.0, 0.5 * r], np.float32),
             op=hvd.Adasum, name="mesh.adasum")
         out["mesh_adasum"] = [float(v) for v in ad]
-        out["pickle_calls_allreduce"] = calls["n"]  # must be 0
+        out["pickle_calls_allreduce"] = calls["payload"]  # must be 0
         rows = np.full((r + 2, 3), float(r), np.float32)
         g = eager.process_allgather(rows, name="mesh.ag")
         out["mesh_gather_ok"] = bool(
             g.shape == (5, 3)
             and np.allclose(g[:2], 0.0) and np.allclose(g[2:], 1.0)
         )
-        # exactly one pickle entry: the tiny (shape, dtype) metadata
-        # gather every rank runs to agree on the transport
-        out["pickle_calls_allgather"] = calls["n"]
+        out["pickle_calls_allgather"] = calls["payload"]  # still 0
+        # one tiny (shape, dtype) metadata gather per collective above
+        out["pickle_calls_meta"] = calls["meta"]
+        # cross-rank validation: a dtype mismatch must RAISE on every
+        # rank, not send ranks down different transports (advisor
+        # round-4: process_allreduce branched on the LOCAL dtype)
+        try:
+            eager.process_allreduce(
+                np.asarray([1.0], np.float32 if r == 0 else np.complex64),
+                op=hvd.Sum, name="mesh.mismatch")
+            out["mismatch_raised"] = False
+        except ValueError as e:
+            out["mismatch_raised"] = "dtype mismatch" in str(e)
     finally:
         eager.allgather_object = orig_ag
     return out
@@ -391,8 +555,13 @@ def test_two_process_jax_distributed_plane():
         assert res["mesh_gather_ok"]
         assert res["pickle_calls_allreduce"] == 0, \
             "gradient allreduce took the pickled star, not the mesh"
-        assert res["pickle_calls_allgather"] == 1, \
-            "payload allgather should pickle only the metadata tuple"
+        assert res["pickle_calls_allgather"] == 0, \
+            "payload allgather took the pickled star, not the mesh"
+        # one (shape, dtype) agreement gather per collective: sum, min,
+        # adasum, allgather
+        assert res["pickle_calls_meta"] == 4
+        assert res["mismatch_raised"] is True, \
+            "cross-rank dtype mismatch must raise on every rank"
     from horovod_tpu.ops.adasum import numpy_adasum
 
     expected_adasum = numpy_adasum([
