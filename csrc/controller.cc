@@ -283,17 +283,6 @@ std::vector<double> AdasumPair(const std::vector<double>& a,
 bool AdasumReduce(uint8_t dtype, const std::vector<std::string>& payloads,
                   std::string* result, std::string* err) {
   int n = static_cast<int>(payloads.size());
-  if (n & (n - 1)) {
-    // Deliberate reference parity, NOT a gap: the reference refuses
-    // non-power-of-two Adasum at the binding level (reference
-    // horovod/torch/mpi_ops.py:117-118 "Running Adasum with non-power
-    // of 2 ranks is not supported yet"); its VHDD comm setup also
-    // clamps to nearest_power_2 (adasum/adasum_mpi.cc:45-52).
-    *err = "host-plane Adasum requires a power-of-two world size, got " +
-           std::to_string(n) +
-           " (same restriction as the reference: torch/mpi_ops.py:118)";
-    return false;
-  }
   std::vector<std::vector<double>> vals(n);
   for (int r = 0; r < n; ++r) {
     if (!PayloadToF64(dtype, payloads[r], &vals[r])) {
@@ -305,6 +294,17 @@ bool AdasumReduce(uint8_t dtype, const std::vector<std::string>& payloads,
       return false;
     }
   }
+  // Non-power-of-two world sizes: remainder folding (the reference clamps
+  // its VHDD comm setup to nearest_power_2, adasum.h:209-217, but then
+  // refuses such sizes at the binding — torch/mpi_ops.py:117-118; we fold
+  // instead, matching numpy_adasum in ops/adasum.py): rank p+i merges
+  // into rank i via the same scale-invariant pair rule, then the VHDD
+  // tree runs over the p survivors.
+  int p = 1;
+  while (p * 2 <= n) p *= 2;
+  for (int r = p; r < n; ++r) vals[r - p] = AdasumPair(vals[r - p], vals[r]);
+  vals.resize(p);
+  n = p;
   for (int level = 1; level < n; level *= 2) {
     std::vector<std::vector<double>> nxt(n);
     for (int r = 0; r < n; ++r) {

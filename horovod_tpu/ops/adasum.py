@@ -264,10 +264,26 @@ def numpy_hierarchical_adasum(tensors, local_size: int) -> np.ndarray:
 
 def numpy_adasum(tensors) -> np.ndarray:
     """Tree-reduce a list of per-rank arrays with the same pairing order the
-    device implementation uses (rank XOR distance)."""
+    device implementation uses (rank XOR distance).
+
+    Non-power-of-two counts use remainder folding, the classic
+    recursive-doubling remainder trick (the reference clamps its VHDD comm
+    setup to nearest_power_2 the same way, adasum.h:209-217 /
+    adasum_mpi.cc:45-52, but its bindings then refuse such world sizes —
+    torch/mpi_ops.py:117-118): with p = largest power of two <= n, each
+    rank p+i first merges into rank i via the same scale-invariant pair
+    rule, then the standard VHDD tree runs over the p survivors.  The
+    merge being Adasum (not a plain sum) keeps the defining invariants at
+    every count: identical inputs -> that input, orthogonal inputs -> sum."""
     vals = [np.asarray(t) for t in tensors]
     n = len(vals)
-    assert n & (n - 1) == 0, "power-of-two rank count required"
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    for r in range(p, n):
+        vals[r - p] = numpy_adasum_pair(vals[r - p], vals[r])
+    vals = vals[:p]
+    n = p
     level = 1
     while level < n:
         nxt = list(vals)

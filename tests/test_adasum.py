@@ -23,6 +23,30 @@ def test_numpy_pair_parallel_averages():
     np.testing.assert_allclose(numpy_adasum_pair(a, a), a)
 
 
+@pytest.mark.parametrize("n", [3, 5, 6, 7])
+def test_numpy_adasum_non_power_of_two_invariants(n):
+    """Remainder folding keeps Adasum's defining invariants at every world
+    size (the reference refuses these sizes — torch/mpi_ops.py:117-118;
+    we fold the remainder into the power-of-two group instead)."""
+    # identical inputs: scale invariance -> the input itself
+    a = np.array([2.0, -3.0, 0.5], np.float64)
+    np.testing.assert_allclose(numpy_adasum([a] * n), a, rtol=1e-12)
+    # mutually orthogonal inputs: plain sum
+    basis = [np.eye(8, dtype=np.float64)[i] * (i + 1.0) for i in range(n)]
+    np.testing.assert_allclose(
+        numpy_adasum(basis), np.sum(basis, axis=0), rtol=1e-12)
+
+
+def test_numpy_adasum_remainder_fold_order():
+    """n=3 folds rank 2 into rank 0 (pair rule), then pairs with rank 1 —
+    the same order the host plane (csrc AdasumReduce) uses."""
+    rng = np.random.default_rng(7)
+    xs = [rng.normal(size=16) for _ in range(3)]
+    expected = numpy_adasum_pair(numpy_adasum_pair(xs[0], xs[2]), xs[1])
+    # the level-1 pairing computes pair(lo, hi) with lo = folded rank 0
+    np.testing.assert_allclose(numpy_adasum(xs), expected, rtol=1e-12)
+
+
 @pytest.mark.parametrize("dim", [1, 2])
 def test_adasum_allreduce_matches_numpy(hvd_init, rng, dim):
     shape = (64,) if dim == 1 else (8, 8)

@@ -165,20 +165,27 @@ def _worker_adasum_np3():
     hvd.init(devices=jax.devices("cpu"))
     import torch
 
-    try:
-        hvd_torch.allreduce(torch.ones(4), op=hvd_torch.Adasum)
-        return "no-error"
-    except RuntimeError as e:
-        return f"error: {e}"
+    r = hvd.process_rank()
+    out = hvd_torch.allreduce(
+        torch.tensor([1.0 + r, -2.0, 0.5 * r, 4.0]), op=hvd_torch.Adasum)
+    return [float(v) for v in out]
 
 
-def test_adasum_non_power_of_two_raises():
-    """No silent fallback: 3 ranks cannot VHDD — every rank must see the
-    coordinator's error, not a sum."""
+def test_adasum_non_power_of_two_folds_remainder():
+    """3 ranks VHDD via remainder folding (round 5 — the reference
+    refuses these sizes, torch/mpi_ops.py:117-118; csrc AdasumReduce
+    folds rank 2 into rank 0 with the pair rule, then runs the tree);
+    every rank sees the numpy oracle's result through the torch
+    binding."""
+    from horovod_tpu.ops.adasum import numpy_adasum
+
     results = run(_worker_adasum_np3, np=3, extra_env=_env())
+    expected = numpy_adasum([
+        np.asarray([1.0 + r, -2.0, 0.5 * r, 4.0], np.float32)
+        for r in range(3)
+    ])
     for res in results:
-        assert res.startswith("error:"), res
-        assert "power-of-two" in res
+        np.testing.assert_allclose(res, expected, rtol=1e-5)
 
 
 def _worker_concurrent_ring():
