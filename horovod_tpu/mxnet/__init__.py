@@ -138,17 +138,51 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
 
 class DistributedTrainer(mx.gluon.Trainer):
     """gluon Trainer whose gradient aggregation crosses processes
-    (reference mxnet/__init__.py:92-134; the fork wires a Recorder into
-    it — here the framework recorder (timeline/recorder.py) observes the
-    jitted path, and this trainer records through the timeline spans)."""
+    (reference mxnet/__init__.py:92-134).  The fork wires a Recorder into
+    the trainer itself — mandatory, zero user code (reference
+    mxnet/__init__.py:92-134 + mxnet/recorder.py:187-302 builds the DAG
+    from symbol.debug_str()); here the first ``_allreduce_grads`` dumps
+    the gradient manifest, shapes, and the aggregation dataflow DAG to
+    ``HVD_TRACE_DIR`` the same way."""
 
     def __init__(self, params, optimizer, optimizer_params=None, **kwargs):
         # reference scales LR handling by size in the optimizer; keep the
         # reference's rescale_grad convention: divide by local batch only
         super().__init__(params, optimizer, optimizer_params,
                          kvstore=None, **kwargs)
+        self._hvd_recorded = False
+
+    def _record_once(self) -> None:
+        if self._hvd_recorded:
+            return
+        self._hvd_recorded = True
+        try:
+            from ..timeline.recorder import (
+                Recorder, structure_dag, write_gml,
+                write_gradient_manifest,
+            )
+
+            rec = Recorder()
+            if not rec.enabled:
+                return
+            live = [p for p in self._params if p.grad_req != "null"]
+            names = [f"gradients/{p.name}" for p in live]
+            shapes = {
+                f"gradients/{p.name}": list(p.shape)
+                for p in live if p.shape is not None
+            }
+            write_gradient_manifest(rec, names, shapes)
+            nodes, edges = structure_dag([p.name for p in live])
+            write_gml(nodes, edges, rec._path("dag.gml"))
+            rec.dump_metadata(framework="mxnet",
+                              num_gradients=len(names))
+        except Exception:  # noqa: BLE001 — tracing must never kill a step
+            from ..utils.logging import get_logger
+
+            get_logger(__name__).exception("recorder: mxnet dump failed")
 
     def _allreduce_grads(self):
+        self._record_once()
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 for grad in param.list_grad():

@@ -175,15 +175,21 @@ def _allreduce_grads(grads, *, op, compression, sparse_as_dense):
 
 class DistributedGradientTape:
     """Wrap tf.GradientTape so .gradient() returns globally-reduced
-    gradients (reference tensorflow/__init__.py:483-539)."""
+    gradients (reference tensorflow/__init__.py:483-539).  With
+    ``HVD_TRACE_DIR`` set, the first ``.gradient()`` call dumps the
+    per-rank trace artifacts with no manual Recorder calls — the fork's
+    in-optimizer wiring (reference tensorflow/__init__.py:282,295)."""
 
     def __init__(self, gradtape, device_dense="", device_sparse="",
                  compression=Compression.none, sparse_as_dense=False,
                  op=Average):
+        from .recorder import GradientRecorder
+
         self._tape = gradtape
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
         self._op = op
+        self._recorder = GradientRecorder()
 
     def __enter__(self):
         self._tape.__enter__()
@@ -197,6 +203,7 @@ class DistributedGradientTape:
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
+        self._recorder.record(grads, sources)
         return _allreduce_grads(
             grads, op=self._op, compression=self._compression,
             sparse_as_dense=self._sparse_as_dense,
@@ -225,9 +232,13 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
             "(DistributedOptimizer applied twice)"
         )
     base = optimizer.__class__
+    from .recorder import GradientRecorder
+
+    recorder = GradientRecorder()  # fork wiring: first pass auto-dumps
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
         gv = list(grads_and_vars)
+        recorder.record([g for g, _ in gv], [v for _, v in gv])
         grads = _allreduce_grads(
             [g for g, _ in gv], op=op, compression=compression,
             sparse_as_dense=sparse_as_dense,
@@ -243,6 +254,7 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
         # stateful-optimizer slots consistent with what was applied.
         gv = list(grads_and_vars)
         variables = [v for _, v in gv]
+        recorder.record([g for g, _ in gv], variables)
         starts = [tf.identity(v) for v in variables]
         result = base.apply_gradients(self, gv, *args, **kwargs)
         for i, (v, s) in enumerate(zip(variables, starts)):

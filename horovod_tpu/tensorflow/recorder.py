@@ -1,0 +1,120 @@
+"""In-optimizer Recorder wiring for the TF binding.
+
+The fork's whole point is ZERO-EFFORT per-rank tracing: wrapping an
+optimizer is enough to produce the trace artifacts — the reference's
+``DistributedOptimizer.compute_gradients`` itself registers every gradient
+tensor with the Recorder (reference horovod/tensorflow/__init__.py:282,295;
+recorder.py:176-193 register_tensors, :339-521 TimelineHook), no manual
+Recorder calls in user code.
+
+This module is the TPU-native analog: ``GradientRecorder.record(grads,
+vars)`` is invoked from inside ``DistributedGradientTape.gradient`` and
+``DistributedOptimizer.apply_gradients`` on their first call.  When
+``HVD_TRACE_DIR`` is set it dumps, per rank, into ``<dir>/<rank>/``:
+
+* ``dag.gml`` — inside a ``tf.function`` trace, the live FuncGraph's op
+  graph (the TF2 analog of the reference's partition GraphDefs: the first
+  ``apply_gradients`` runs during tracing, when forward + gradient ops are
+  already recorded in the graph); in pure eager mode, the gradient→
+  allreduce→variable dataflow of the aggregation step itself.
+* ``tensor_shapes.json`` — per-gradient shapes keyed by manifest name.
+* ``gradient_name_list.json`` — ``gradients/<var name>`` manifest
+  (reference recorder.py gradient name registration).
+* ``metadata.json`` — rank/size/framework.
+
+The framework-neutral jaxpr-based Recorder stays in
+``horovod_tpu/timeline/recorder.py``; this file only adds the TF hook.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import tensorflow as tf
+
+from ..timeline.recorder import (  # noqa: F401
+    Recorder, TimelineHook, structure_dag, write_gml,
+    write_gradient_manifest,
+)
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _var_name(v, i: int) -> str:
+    name = getattr(v, "name", None) or f"var_{i}"
+    return name.split(":")[0]
+
+
+def _funcgraph_dag(graph) -> tuple:
+    """(nodes, edges) from a live FuncGraph — op type + name + output
+    shape, edges following tensor producers (same node vocabulary as the
+    jaxpr DAG in timeline/recorder.py so dag.gml consumers see one
+    format)."""
+    nodes, edges = [], []
+    op_id = {}
+    for op in graph.get_operations():
+        nid = len(nodes)
+        node = {"id": nid, "label": op.name, "kind": op.type}
+        if op.outputs:
+            shape = op.outputs[0].shape
+            if shape.rank is not None:
+                node["shape"] = [d if d is not None else -1
+                                 for d in shape.as_list()]
+            node["dtype"] = op.outputs[0].dtype.name
+        nodes.append(node)
+        op_id[op.name] = nid
+    for op in graph.get_operations():
+        for inp in op.inputs:
+            src = op_id.get(inp.op.name)
+            if src is not None:
+                edges.append((src, op_id[op.name]))
+    return nodes, edges
+
+
+class GradientRecorder:
+    """One per wrapped optimizer/tape; dumps once, on the first gradient
+    pass, and is a no-op forever after (and entirely when HVD_TRACE_DIR
+    is unset — zero overhead on the untraced path)."""
+
+    def __init__(self, trace_dir: Optional[str] = None):
+        self._trace_dir = trace_dir
+        self._done = False
+
+    def record(self, grads, variables=None) -> None:
+        if self._done:
+            return
+        self._done = True  # even on failure: never retry per-step
+        try:
+            rec = Recorder(self._trace_dir)
+            if not rec.enabled:
+                return
+            gv = list(zip(grads, variables)) if variables is not None \
+                else [(g, None) for g in grads]
+            names, shapes = [], {}
+            for i, (g, v) in enumerate(gv):
+                if g is None:
+                    continue
+                name = _var_name(v, i) if v is not None else f"grad_{i}"
+                names.append("gradients/" + name)
+                t = g.values if isinstance(g, tf.IndexedSlices) else g
+                shape = getattr(t, "shape", None)
+                if shape is not None and shape.rank is not None:
+                    shapes["gradients/" + name] = [
+                        d if d is not None else -1 for d in shape.as_list()
+                    ]
+            write_gradient_manifest(rec, names, shapes)
+            graph = tf.compat.v1.get_default_graph() \
+                if tf.inside_function() else None
+            if graph is not None and graph.get_operations():
+                nodes, edges = _funcgraph_dag(graph)
+            else:
+                nodes, edges = structure_dag(
+                    [n[len("gradients/"):] for n in names])
+            write_gml(nodes, edges, rec._path("dag.gml"))
+            rec.dump_metadata(framework="tensorflow",
+                              num_gradients=len(names),
+                              in_function=bool(graph is not None))
+            log.info("recorder: dumped TF trace artifacts to %s", rec.dir)
+        except Exception:  # noqa: BLE001 — tracing must never kill a step
+            log.exception("recorder: TF artifact dump failed")

@@ -101,6 +101,35 @@ def write_gml(nodes: Sequence[dict], edges: Sequence[tuple], path: str) -> None:
         f.write("]\n")
 
 
+def structure_dag(names: Sequence[str]) -> tuple:
+    """(nodes, edges) for the aggregation step's own dataflow —
+    grad_i → allreduce_i → var_i.  The eager-binding fallback DAG when
+    no traced graph is available (TF eager mode, the mxnet fake); same
+    node vocabulary as ``jaxpr_dag`` so dag.gml consumers see one
+    format."""
+    nodes, edges = [], []
+    for name in names:
+        g = len(nodes)
+        nodes.append({"id": g, "label": f"grad/{name}", "kind": "input"})
+        a = len(nodes)
+        nodes.append({"id": a, "label": f"allreduce/{name}", "kind": "op"})
+        v = len(nodes)
+        nodes.append({"id": v, "label": name, "kind": "output"})
+        edges.extend([(g, a), (a, v)])
+    return nodes, edges
+
+
+def write_gradient_manifest(rec: "Recorder", names: Sequence[str],
+                            shapes: Dict[str, list]) -> None:
+    """gradient_name_list.json + tensor_shapes.json — the shared artifact
+    format both eager bindings dump (reference recorder.py:176-193
+    gradient name registration)."""
+    with open(rec._path("gradient_name_list.json"), "w") as f:
+        json.dump(list(names), f, indent=1)
+    with open(rec._path("tensor_shapes.json"), "w") as f:
+        json.dump(shapes, f, indent=1)
+
+
 class Recorder:
     """Capture and dump the model/step structure.
 

@@ -77,7 +77,9 @@ def test_broadcast_parameters_gluon_style(binding):
     mx, hvd_mx = binding
     from mxnet.gluon.parameter import Parameter
 
-    p = Parameter("w", np.full((2,), 3.0))
+    p = Parameter("w", shape=(2,))
+    p.initialize()
+    p.set_data(np.full((2,), 3.0))
     hvd_mx.broadcast_parameters({"w": p}, root_rank=0)
     assert p.data().asnumpy().tolist() == [3.0, 3.0]
 
@@ -89,8 +91,10 @@ def test_distributed_trainer_steps(binding):
     mx, hvd_mx = binding
     from mxnet.gluon.parameter import Parameter
 
-    p = Parameter("w", np.asarray([1.0, 1.0]))
-    p._grad[:] = np.asarray([0.5, 1.0], np.float32)
+    p = Parameter("w", shape=(2,))
+    p.initialize()
+    p.set_data(np.asarray([1.0, 1.0]))
+    p.list_grad()[0][:] = np.asarray([0.5, 1.0], np.float32)
     trainer = hvd_mx.DistributedTrainer(
         [p], "sgd", {"learning_rate": 0.1},
     )
@@ -126,3 +130,34 @@ def test_broadcast_parameters_deferred_init(binding):
     # injected hook must broadcast right after
     p._init_impl(np.asarray([7.0, 8.0], np.float32))
     assert p.data().asnumpy().tolist() == [7.0, 8.0]
+
+
+def test_distributed_trainer_auto_recorder(binding, tmp_path, monkeypatch):
+    """Fork parity: the trainer's Recorder wiring is MANDATORY — two
+    steps with HVD_TRACE_DIR set produce the gradient manifest, shapes,
+    and dag.gml with no manual Recorder calls (reference
+    mxnet/__init__.py:92-134 + mxnet/recorder.py:187-302)."""
+    import json
+    import os
+
+    mx, hvd_mx = binding
+    from mxnet.gluon.parameter import Parameter
+
+    monkeypatch.setenv("HVD_TRACE_DIR", str(tmp_path))
+    p = Parameter("dense0_weight", shape=(3,))
+    p.initialize()
+    p.set_data(np.asarray([1.0, 2.0, 3.0]))
+    p.list_grad()[0][:] = np.asarray([0.1, 0.2, 0.3], np.float32)
+    trainer = hvd_mx.DistributedTrainer([p], "sgd", {"learning_rate": 0.1})
+    for _ in range(2):
+        trainer.step(batch_size=1)
+    d = os.path.join(str(tmp_path), "0")
+    for fname in ("dag.gml", "tensor_shapes.json",
+                  "gradient_name_list.json", "metadata.json"):
+        assert os.path.exists(os.path.join(d, fname)), fname
+    names = json.load(open(os.path.join(d, "gradient_name_list.json")))
+    assert names == ["gradients/dense0_weight"]
+    shapes = json.load(open(os.path.join(d, "tensor_shapes.json")))
+    assert shapes["gradients/dense0_weight"] == [3]
+    assert json.load(
+        open(os.path.join(d, "metadata.json")))["framework"] == "mxnet"
