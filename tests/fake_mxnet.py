@@ -78,7 +78,7 @@ class NDArray:
     def context(self):
         return "cpu(0)"
 
-    def as_in_context(self, ctx):
+    def as_in_context(self, context):
         return self
 
     def __setitem__(self, key, value):
@@ -88,8 +88,8 @@ class NDArray:
         return f"FakeNDArray({self._a!r})"
 
 
-def _array(arr, dtype=None, ctx=None):
-    return NDArray(arr, dtype=dtype)
+def _array(source_array, ctx=None, dtype=None):
+    return NDArray(source_array, dtype=dtype)
 
 
 def _ones(shape, dtype=None):
@@ -105,31 +105,58 @@ class DeferredInitializationError(Exception):
 
 
 class Parameter:
-    """Gluon parameter: data/grad pair (reference mxnet gluon surface).
+    """Gluon parameter: data/grad pair (reference mxnet gluon surface,
+    REAL constructor order — mxnet/gluon/parameter.py
+    ``Parameter(name, grad_req='write', shape=None, dtype=...)``; test
+    code written against this fake runs against real gluon unchanged).
 
-    ``arr=None`` models a SHAPE-DEFERRED parameter: ``data()`` raises
+    ``shape=None`` models a SHAPE-DEFERRED parameter: ``data()`` raises
     ``DeferredInitializationError`` until ``_init_impl`` runs (the hook
     the reference binding wraps to broadcast-after-init, reference
     mxnet/__init__.py:138-145)."""
 
-    def __init__(self, name, arr=None, grad_req="write"):
+    def __init__(self, name, grad_req="write", shape=None,
+                 dtype=np.float32):
         self.name = name
         self.grad_req = grad_req
-        if arr is None:
-            self._data = None
-            self._grad = None
-        else:
-            self._data = NDArray(arr)
-            self._grad = NDArray(np.zeros_like(np.asarray(arr, np.float32)))
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype
+        self._data = None
+        self._grad = None
 
-    def data(self):
+    @property
+    def shape(self):
+        # real gluon Parameter.shape: the declared shape, or None while
+        # shape-deferred (mxnet/gluon/parameter.py Parameter.shape)
+        return self._shape
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Real gluon signature; allocates data/grad when the shape is
+        known, stays deferred otherwise (allow_deferred_init path)."""
+        if self._shape is None:
+            return
+        if self._data is None or force_reinit:
+            self._init_impl(np.zeros(self._shape, self._dtype), ctx)
+
+    def set_data(self, data):
+        """Real gluon Parameter.set_data(data)."""
+        arr = data.asnumpy() if isinstance(data, NDArray) \
+            else np.asarray(data, self._dtype)
+        if self._data is None:
+            self._shape = tuple(arr.shape)
+            self._init_impl(arr, None)
+        else:
+            self._data._a[...] = arr
+
+    def data(self, ctx=None):
         if self._data is None:
             raise DeferredInitializationError(
                 f"Parameter {self.name} has not been initialized yet"
             )
         return self._data
 
-    def grad(self):
+    def grad(self, ctx=None):
         return self._grad
 
     def list_grad(self):
@@ -138,7 +165,8 @@ class Parameter:
     def _init_impl(self, data, ctx_list=None):
         """Deferred initialization firing (real gluon signature:
         ``_init_impl(self, data, ctx_list)``)."""
-        self._data = NDArray(data)
+        self._data = data if isinstance(data, NDArray) else NDArray(data)
+        self._shape = tuple(self._data._a.shape)
         self._grad = NDArray(np.zeros_like(self._data._a))
 
 

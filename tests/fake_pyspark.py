@@ -45,8 +45,8 @@ class _BarrierRDD:
     def __init__(self, n):
         self._n = n
 
-    def mapPartitions(self, fn):
-        self._fn = fn
+    def mapPartitions(self, f, preservesPartitioning=False):
+        self._fn = f
         return self
 
     def collect(self):
@@ -78,13 +78,15 @@ class SparkContext:
     _instance = None
 
     @classmethod
-    def getOrCreate(cls):
+    def getOrCreate(cls, conf=None):
         if cls._instance is None:
             cls._instance = cls()
         return cls._instance
 
-    def parallelize(self, seq, numSlices):
-        return _RDD(numSlices)
+    def parallelize(self, c, numSlices=None):
+        n = numSlices if numSlices is not None \
+            else self.defaultParallelism
+        return _RDD(n)
 
 
 class Row:
@@ -93,7 +95,7 @@ class Row:
     def __init__(self, **fields):
         self._fields = dict(fields)
 
-    def asDict(self):
+    def asDict(self, recursive=False):
         return dict(self._fields)
 
     def __getitem__(self, key):
@@ -107,8 +109,8 @@ class Row:
 class DenseVector:
     """pyspark.ml.linalg.DenseVector stand-in (toArray + len)."""
 
-    def __init__(self, values):
-        self.array = np.asarray(values, np.float64)
+    def __init__(self, ar):
+        self.array = np.asarray(ar, np.float64)
 
     def toArray(self):
         return self.array
@@ -141,10 +143,10 @@ class SparkSession:
     _instance = None
 
     class _Builder:
-        def appName(self, _name):
+        def appName(self, name):
             return self
 
-        def master(self, _url):
+        def master(self, master):
             return self
 
         def getOrCreate(self):
@@ -158,7 +160,8 @@ class SparkSession:
     def sparkContext(self):
         return SparkContext.getOrCreate()
 
-    def createDataFrame(self, data, schema=None):
+    def createDataFrame(self, data, schema=None, samplingRatio=None,
+                        verifySchema=True):
         """Rows from list-of-dicts, list-of-Rows, or list-of-tuples +
         schema names (the subset of real createDataFrame the tests and
         estimators use)."""

