@@ -130,11 +130,50 @@ def _link_volume(op: str, nbytes: int, n: int) -> float:
     return float(nbytes)  # permute / all-to-all: one shard hop
 
 
+def _ring_hops(op: str, n: int) -> int:
+    """Serialized neighbor exchanges in a 1-D ring execution of ``op`` —
+    the latency (α) term's multiplier."""
+    if n <= 1:
+        return 0
+    if op == "all-reduce":
+        return 2 * (n - 1)          # reduce-scatter + all-gather phases
+    if op in ("all-gather", "reduce-scatter"):
+        return n - 1
+    return 1                        # permute / all-to-all: one exchange
+
+
+def model_scaling(
+    cols: Dict[str, Dict[str, int]],
+    t_compute: Optional[float],
+    *,
+    sizes=(8, 16, 32, 64),
+    ici_bytes_per_sec: float = 186e9,
+    ici_hop_latency: float = 1e-6,
+):
+    """The pure α-β curve: ({n: t_comm_seconds}, {n: efficiency}) from a
+    collective profile (``hlo_collectives`` output) and a per-step
+    single-chip compute time."""
+    comm_seconds, scaling = {}, {}
+    for n in sizes:
+        t_comm = sum(
+            _link_volume(op, d["bytes"], n) / ici_bytes_per_sec
+            + d["count"] * _ring_hops(op, n) * ici_hop_latency
+            for op, d in cols.items()
+        )
+        comm_seconds[n] = round(t_comm, 6)
+        scaling[n] = (
+            round(t_compute / (t_compute + t_comm), 4)
+            if t_compute else None
+        )
+    return comm_seconds, scaling
+
+
 def collective_report(
     step_fn,
     *args,
     peak_flops: float = 197e12,
     ici_bytes_per_sec: float = 186e9,   # v5e: ~186 GB/s per ICI direction
+    ici_hop_latency: float = 1e-6,      # ~1 µs per ICI neighbor hop
     sizes=(8, 16, 32, 64),
     measured_step_seconds: Optional[float] = None,
     **kwargs,
@@ -142,13 +181,18 @@ def collective_report(
     """Compile ``step_fn`` (a jitted/spmd-wrapped callable) on the current
     mesh and report its collective traffic plus a roofline scaling model.
 
-    The model: per-step compute time = measured single-chip step time when
-    given (the honest base — pass the bench number), else flops/peak;
-    per-step comm time at world size n = Σ link_volume(op, bytes, n)/
-    ici_bw; efficiency(n) = t_compute / (t_compute + t_comm(n)) — the
-    no-overlap bound (XLA overlaps some collectives, so the real curve
-    sits between this and 1.0; the reference's 90%-at-512,
-    README.rst:75-77, is the same quantity measured)."""
+    The α-β model: per-step compute time = measured single-chip step time
+    when given (the honest base — pass the bench number), else
+    flops/peak; per-step comm time at world size n =
+    Σ_ops [ link_volume(op, bytes, n) / ici_bw            (β, bandwidth)
+          + count(op) · ring_hops(op, n) · hop_latency ]  (α, latency);
+    efficiency(n) = t_compute / (t_compute + t_comm(n)) — the no-overlap
+    bound (XLA overlaps some collectives, so the real curve sits between
+    this and 1.0; the reference's 90%-at-512, README.rst:75-77, is the
+    same quantity measured).  The α term is why per-tensor collective
+    streams (the hierarchical path's one-RS/AG-per-gradient) scale worse
+    than fused buckets even at equal bytes — the reference's whole fusion
+    rationale (SURVEY §2.1)."""
     import jax
 
     lowered = step_fn.lower(*args, **kwargs) if hasattr(step_fn, "lower") \
@@ -164,15 +208,11 @@ def collective_report(
 
     t_compute = measured_step_seconds if measured_step_seconds \
         else (flops / peak_flops if flops else None)
-    scaling = {}
-    for n in sizes:
-        t_comm = sum(
-            _link_volume(op, d["bytes"], n) for op, d in cols.items()
-        ) / ici_bytes_per_sec
-        scaling[n] = (
-            round(t_compute / (t_compute + t_comm), 4)
-            if t_compute else None
-        )
+    comm_seconds, scaling = model_scaling(
+        cols, t_compute, sizes=sizes,
+        ici_bytes_per_sec=ici_bytes_per_sec,
+        ici_hop_latency=ici_hop_latency,
+    )
     return {
         "collectives": cols,
         "total_collective_bytes": sum(d["bytes"] for d in cols.values()),
@@ -180,11 +220,14 @@ def collective_report(
         "assumptions": {
             "peak_flops": peak_flops,
             "ici_bytes_per_sec": ici_bytes_per_sec,
+            "ici_hop_latency": ici_hop_latency,
             "t_compute_seconds": t_compute,
             "t_compute_source": "measured" if measured_step_seconds
             else "flops/peak",
-            "model": "efficiency = t_compute / (t_compute + t_comm), "
-                     "ring collectives, no overlap",
+            "model": "efficiency = t_compute / (t_compute + t_comm); "
+                     "t_comm = bytes-on-busiest-link/bw + "
+                     "count*ring_hops*hop_latency; 1-D ring, no overlap",
         },
+        "modeled_comm_seconds": comm_seconds,
         "scaling_model": scaling,
     }

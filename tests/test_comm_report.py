@@ -69,7 +69,12 @@ def test_report_finds_gradient_allreduce(hvd_init, rng):
     total = report["total_collective_bytes"]
     assert param_bytes <= total <= param_bytes + 1024
     assert report["scaling_model"][8] is not None
-    assert 0 < report["scaling_model"][64] <= 1
+    # a TOY model's t_compute is microseconds, so the α (latency) term
+    # legitimately drives 64-chip efficiency toward 0 — only bounds and
+    # monotonicity are meaningful here; realistic curves are asserted in
+    # test_latency_term_separates_fused_from_per_tensor below
+    assert 0 <= report["scaling_model"][64] <= 1
+    assert report["modeled_comm_seconds"][64] > 0
     # more chips -> monotonically no-better efficiency in the ring model
     effs = [report["scaling_model"][n] for n in (8, 16, 32, 64)]
     assert all(a >= b for a, b in zip(effs, effs[1:]))
@@ -107,3 +112,24 @@ def test_hlo_parser_multidim_async_start():
 """
     cols = hlo_collectives(txt)
     assert cols["collective-permute"]["bytes"] == 128 * 256 * 4
+
+
+def test_latency_term_separates_fused_from_per_tensor():
+    """The α (per-collective latency) term: one fused 100 MB allreduce
+    beats 160 per-tensor allreduces of the same total bytes — the
+    reference's fusion-buffer rationale, now visible in the model
+    (SURVEY §2.1; reference fusion_buffer docs)."""
+    from horovod_tpu.timeline.comm_report import model_scaling
+
+    t_compute = 0.05  # a ResNet-50-class 50 ms step
+    fused = {"all-reduce": {"count": 1, "bytes": 100_000_000}}
+    per_tensor = {"all-reduce": {"count": 160, "bytes": 100_000_000}}
+    _, eff_fused = model_scaling(fused, t_compute)
+    _, eff_split = model_scaling(per_tensor, t_compute)
+    for n in (8, 16, 32, 64):
+        assert eff_fused[n] > eff_split[n]
+    # realistic fused ResNet-50 stays in the reference's published band
+    assert eff_fused[64] > 0.85
+    # β term alone is ~size-independent for a ring: t_comm grows with
+    # (n-1)/n; the split curve must degrade faster with n than fused
+    assert (eff_fused[8] - eff_fused[64]) < (eff_split[8] - eff_split[64])
