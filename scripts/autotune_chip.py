@@ -1,0 +1,174 @@
+"""Autotuner convergence session on the real chip (+ mesh phase).
+
+Drives the GP autotuner (optim/autotune.py over csrc/autotune.cc) on a
+live ResNet-50 training loop until it freezes, then grid-searches the
+fusion threshold with every grid point interleaved round-robin
+(min-of-rounds — the shared chip drifts ~2x between windows) and checks
+the converged knob lands within noise of the grid best.  The per-sample
+scores stream to the CSV log exactly as the reference's
+--autotune-log-file does (reference parameter_manager.cc LogParameters).
+
+Phase B (run with --platform cpu under
+XLA_FLAGS=--xla_force_host_platform_device_count=8) repeats on the
+8-device mesh with ResNet-18, where the hierarchical flag changes the
+compiled program (the 1-chip phase can only tune the threshold knob —
+its collectives collapse on a single device).
+
+Writes scripts/out/autotune_chip.json (or autotune_mesh.json for
+--platform cpu) + the CSV log at scripts/out/autotune_{chip,mesh}_log.csv.
+
+Usage:  python scripts/autotune_chip.py                 # real chip
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+          python scripts/autotune_chip.py --platform cpu  # mesh phase
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "out")
+
+
+def _timed_call(step, state, x, y):
+    import jax
+    import numpy as np
+
+    t0 = time.perf_counter()
+    state, loss = step(state, x, y)
+    np.asarray(jax.device_get(loss))
+    return state, time.perf_counter() - t0
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", default=None,
+                        help="None = real chip; cpu = 8-device mesh phase")
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--max-samples", type=int, default=12)
+    parser.add_argument("--steps-per-sample", type=int, default=5)
+    parser.add_argument("--rounds", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    os.environ["HVD_AUTOTUNE_STEPS_PER_SAMPLE"] = str(args.steps_per_sample)
+    os.environ["HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = str(args.max_samples)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import MODELS
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    hvd.init(platform=args.platform)
+    on_chip = jax.devices()[0].platform != "cpu"
+    tag = "chip" if on_chip else "mesh"
+    model_name = "ResNet50" if on_chip else "ResNet18"
+    batch = args.batch_size or (128 if on_chip else 8)
+    image = 224 if on_chip else 64
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    log_csv = os.path.join(OUT_DIR, f"autotune_{tag}_log.csv")
+    if os.path.exists(log_csv):
+        os.remove(log_csv)
+
+    model = MODELS[model_name](num_classes=1000, dtype=jnp.bfloat16)
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    def build(threshold=None, hierarchical=False, autotune=None):
+        return make_train_step(
+            apply_fn=model.apply, loss_fn=loss_fn, optimizer=opt,
+            has_batch_stats=True, threshold_bytes=threshold,
+            hierarchical=hierarchical, autotune=autotune,
+            autotune_log_file=log_csv if autotune else None,
+        )
+
+    rng = np.random.default_rng(0)
+    x = shard_batch(rng.uniform(
+        size=(batch * hvd.size(), image, image, 3)).astype(np.float32))
+    y = shard_batch(rng.integers(
+        0, 1000, size=(batch * hvd.size(),)).astype(np.int32))
+    state = init_train_state(
+        model, opt, jnp.zeros((2, image, image, 3)), has_batch_stats=True)
+
+    # --- Phase 1: let the tuner run to convergence -----------------------
+    step = build(autotune=True)
+    pm = step.parameter_manager
+    calls = 0
+    budget = (3 + args.max_samples + 2) * args.steps_per_sample * \
+        len([False, True])
+    t_start = time.perf_counter()
+    while not pm.frozen and calls < budget:
+        state, _ = _timed_call(step, state, x, y)
+        calls += 1
+    tune_seconds = time.perf_counter() - t_start
+    converged = {
+        "frozen": pm.frozen,
+        "calls": calls,
+        "tune_seconds": round(tune_seconds, 1),
+        "threshold_bytes": int(pm.current.fusion_threshold_bytes),
+        "hierarchical": bool(pm.current.hierarchical_allreduce),
+    }
+    print(f"autotune[{tag}]: frozen={pm.frozen} after {calls} calls "
+          f"({tune_seconds:.0f}s): threshold="
+          f"{converged['threshold_bytes']} "
+          f"hierarchical={converged['hierarchical']}", flush=True)
+
+    # --- Phase 2: interleaved grid around the converged knobs ------------
+    grid = [
+        ("grid_1MB", 1 << 20, False),
+        ("grid_8MB", 8 << 20, False),
+        ("grid_64MB", 64 << 20, False),
+        ("grid_256MB", 256 << 20, False),
+        ("converged", converged["threshold_bytes"],
+         converged["hierarchical"]),
+    ]
+    if not on_chip:
+        grid.append(("grid_hier_8MB", 8 << 20, True))
+    steps = {}
+    for name, thr, hier in grid:
+        steps[name] = build(threshold=thr, hierarchical=hier)
+        state, _ = _timed_call(steps[name], state, x, y)  # compile+warm
+    best = {name: float("inf") for name, *_ in grid}
+    for r in range(args.rounds):
+        for name, *_ in grid:
+            state, dt = _timed_call(steps[name], state, x, y)
+            best[name] = min(best[name], dt)
+            print(f"round {r} {name}: {dt * 1e3:.2f} ms", flush=True)
+
+    grid_best = min(best, key=best.get)
+    result = {
+        "platform": tag,
+        "model": model_name,
+        "batch": batch,
+        "world_size": hvd.size(),
+        "converged": converged,
+        "grid_ms": {k: round(v * 1e3, 2) for k, v in best.items()},
+        "grid_best": grid_best,
+        "converged_within_pct_of_best": round(
+            (best["converged"] / best[grid_best] - 1) * 100, 1),
+        "log_csv": log_csv,
+    }
+    path = os.path.join(OUT_DIR, f"autotune_{tag}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1))
+    print("wrote", path)
+    return result
+
+
+if __name__ == "__main__":
+    main()

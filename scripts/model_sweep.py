@@ -1,0 +1,197 @@
+"""Real-chip batch sweep for the reference's published model table.
+
+The reference's scaling table is Inception V3 / ResNet / VGG-16
+(reference README.rst:75-77, docs/benchmarks.rst:12-13).  ResNet-50 has
+the full profile (docs/PERF.md); this script gives VGG-16 and
+Inception V3 the same treatment — batch sweep, img/s/chip, MFU against
+both the measured device ceiling and nameplate — in ONE process with
+every config interleaved round-robin and min-of-rounds taken, because
+the shared tunneled chip drifts ~2x between windows (docs/PERF.md
+methodology; an asymmetric schedule once mis-ranked a kernel).
+
+Methodology per config = the bench.py harness: k in-graph steps via
+lax.scan, wall-clock around the call, device_get sync (block_until_ready
+returns early on this tunnel).  Per-step FLOPs come from a k=1 lowering's
+cost_analysis (a scan body is counted ONCE regardless of trip count) and
+from the analytic 3x-forward count.
+
+Writes scripts/out/model_sweep.json.
+
+Usage: python scripts/model_sweep.py [--rounds 3] [--k 10] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MEASURED_CEILING_TFLOPS = 110.0   # bf16 matmul ceiling on this chip
+NAMEPLATE_TFLOPS = 197.0
+
+# analytic forward GFLOPs per image at the table's resolution (3x train)
+FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.73,
+              "ResNet18": 1.82}
+
+CONFIGS = [
+    # (model, image, batch) — ResNet50 b128 anchors against the headline
+    ("ResNet50", 224, 128),
+    ("VGG16", 224, 16),
+    ("VGG16", 224, 32),
+    ("VGG16", 224, 64),
+    ("VGG16", 224, 128),
+    ("InceptionV3", 299, 32),
+    ("InceptionV3", 299, 64),
+    ("InceptionV3", 299, 128),
+]
+QUICK = [("ResNet50", 224, 128), ("VGG16", 224, 32),
+         ("InceptionV3", 299, 64)]
+# plumbing smoke on CPU (wrong-MFU numbers by design; never published;
+# ResNet-18 only — ResNet-50/VGG compiles take >20 min on a 1-core host)
+SMOKE = [("ResNet18", 64, 4), ("ResNet18", 64, 8)]
+
+
+def build(model_name: str, image: int, batch: int, k: int,
+          shared_states: dict):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import MODELS
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model = MODELS[model_name](num_classes=1000, dtype=jnp.bfloat16)
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    def make(steps):
+        return make_train_step(
+            apply_fn=model.apply, loss_fn=loss_fn, optimizer=opt,
+            has_batch_stats=True, in_graph_steps=steps,
+        )
+
+    rng = np.random.default_rng(0)
+    x = shard_batch(rng.uniform(
+        size=(batch * hvd.size(), image, image, 3)).astype(np.float32))
+    y = shard_batch(rng.integers(
+        0, 1000, size=(batch * hvd.size(),)).astype(np.int32))
+    # ONE train state per MODEL, threaded through every batch config
+    # (steps donate their state; per-config states would hold ~4x VGG's
+    # 1.1 GB and can exhaust HBM — docs/PERF.md methodology notes)
+    if model_name not in shared_states:
+        shared_states[model_name] = init_train_state(
+            model, opt, jnp.zeros((2, image, image, 3)),
+            has_batch_stats=True)
+    state = shared_states[model_name]
+
+    step = make(k)
+    # XLA-issued FLOPs from a k=1 lowering (scan body counted once).
+    # One compile per MODEL — per-step FLOPs scale linearly with batch,
+    # so later batch configs scale the first measurement instead of
+    # paying another ~30 s chip compile each.
+    key = f"__flops_{model_name}"
+    if key not in shared_states:
+        one = make(1)
+        try:
+            compiled = jax.jit(lambda s, a, b: one(s, a, b)).lower(
+                state, x, y).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0] if cost else {}
+            shared_states[key] = (
+                float((cost or {}).get("flops", 0.0)), batch)
+        except Exception:  # noqa: BLE001 — cost analysis is advisory
+            shared_states[key] = (0.0, batch)
+    base_flops, base_batch = shared_states[key]
+    xla_flops = base_flops * batch / base_batch
+    return step, x, y, xla_flops
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--k", type=int, default=10,
+                        help="in-graph steps per timed call")
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny CPU plumbing check; output not valid")
+    args = parser.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert args.smoke or jax.devices()[0].platform != "cpu", \
+        "model_sweep measures the real chip (--smoke for CPU plumbing)"
+
+    configs = SMOKE if args.smoke else QUICK if args.quick else CONFIGS
+    built = {}
+    states = {}
+    for name, image, batch in configs:
+        print(f"compile {name} b{batch}@{image}...", flush=True)
+        built[(name, image, batch)] = build(name, image, batch, args.k,
+                                            states)
+        # warmup: one call, synced; thread the donated state back
+        step, x, y, _ = built[(name, image, batch)]
+        states[name], loss = step(states[name], x, y)
+        np.asarray(jax.device_get(loss))
+
+    best_ms = {c: float("inf") for c in configs}
+    for r in range(args.rounds):
+        for c in configs:
+            step, x, y, xla_flops = built[c]
+            t0 = time.perf_counter()
+            states[c[0]], loss = step(states[c[0]], x, y)
+            np.asarray(jax.device_get(loss))
+            dt = time.perf_counter() - t0
+            ms = dt / args.k * 1e3
+            best_ms[c] = min(best_ms[c], ms)
+            print(f"round {r} {c[0]} b{c[2]}: {ms:.2f} ms/step", flush=True)
+
+    out = {}
+    for (name, image, batch), (*_, xla_flops) in built.items():
+        ms = best_ms[(name, image, batch)]
+        img_s = batch / (ms / 1e3)
+        analytic = FWD_GFLOPS[name] * 3e9 * batch
+        entry = {
+            "batch": batch, "image": image,
+            "ms_per_step": round(ms, 2),
+            "img_sec_per_chip": round(img_s, 1),
+            "analytic_flops_per_step": analytic,
+            "xla_flops_per_step": xla_flops,
+            "mfu_vs_measured_ceiling": round(
+                analytic / (ms / 1e3) / (MEASURED_CEILING_TFLOPS * 1e12), 4),
+            "mfu_vs_nameplate": round(
+                analytic / (ms / 1e3) / (NAMEPLATE_TFLOPS * 1e12), 4),
+        }
+        out.setdefault(name, []).append(entry)
+        print(f"== {name} b{batch}: {ms:.2f} ms, {img_s:.0f} img/s, "
+              f"MFU {entry['mfu_vs_measured_ceiling']:.1%} of ceiling",
+              flush=True)
+
+    os.makedirs(os.path.join(os.path.dirname(__file__), "out"),
+                exist_ok=True)
+    path = os.path.join(
+        os.path.dirname(__file__), "out",
+        "model_sweep_smoke.json" if args.smoke else "model_sweep.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)
+    return out
+
+
+if __name__ == "__main__":
+    main()
