@@ -42,7 +42,11 @@ log = get_logger(__name__)
 
 
 def _var_name(v, i: int) -> str:
-    name = getattr(v, "name", None) or f"var_{i}"
+    # Keras 3 variables carry the layer-qualified name on .path
+    # ('sequential/head/kernel') and only the leaf on .name ('kernel');
+    # tf.Variable carries 'scope/name:0' on .name.
+    name = getattr(v, "path", None) or getattr(v, "name", None) \
+        or f"var_{i}"
     return name.split(":")[0]
 
 

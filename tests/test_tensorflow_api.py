@@ -325,3 +325,36 @@ def test_auto_recorder_disabled_without_trace_dir(tmp_path, monkeypatch):
         loss = tf.reduce_sum(v * v)
     tape.gradient(loss, [v])
     assert os.listdir(str(tmp_path)) == []
+
+
+def test_auto_recorder_through_keras_fit(tmp_path, monkeypatch):
+    """The zero-effort tracing contract holds through Keras model.fit:
+    compiling with the wrapped optimizer and HVD_TRACE_DIR set produces
+    the trace artifacts from inside fit's tf.function train step — the
+    fork's whole-workflow promise, no Recorder calls anywhere."""
+    import os
+
+    from horovod_tpu.tensorflow import keras as hvd_keras
+
+    monkeypatch.setenv("HVD_TRACE_DIR", str(tmp_path))
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(16,)).astype(np.int32)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(2, name="head"),
+    ])
+    model.compile(
+        optimizer=hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.05)),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+    )
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    d = os.path.join(str(tmp_path), "0")
+    for fname in ("dag.gml", "tensor_shapes.json",
+                  "gradient_name_list.json", "metadata.json"):
+        assert os.path.exists(os.path.join(d, fname)), fname
+    import json
+
+    names = json.load(open(os.path.join(d, "gradient_name_list.json")))
+    assert any("head" in n for n in names), names
