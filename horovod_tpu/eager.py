@@ -41,6 +41,7 @@ from .ops import collectives
 from .runtime import eager_controller
 from .runtime.stall_inspector import inspector
 from .timeline.timeline import timeline
+from .utils import env as env_util
 
 
 def _dispatch_guard(name: str, op: str, tensors):
@@ -310,8 +311,11 @@ def allgather_object(obj: Any, *, name: Optional[str] = None) -> List[Any]:
 
 # payloads at or above this ride the peer ring (flat per-rank wire volume,
 # csrc/ring.cc); below it the coordinator star wins on latency (1 RTT vs
-# the ring's negotiate + 2(n-1) hops).
-_RING_MIN_BYTES = 1 << 15
+# the ring's negotiate + 2(n-1) hops).  The 32 KB default was measured on
+# a core-bound CI host — deployments should calibrate on their own fabric
+# (scripts/host_plane_bench.py --crossover) and set HVD_RING_MIN_BYTES /
+# tpurun --ring-min-bytes / YAML params.ring_min_bytes.
+_RING_MIN_BYTES = env_util.get_int(env_util.HVD_RING_MIN_BYTES, 1 << 15)
 
 _WIRE_OPS = {Average: "allreduce", Sum: "allreduce", Min: "min",
              Max: "max", Adasum: "adasum"}

@@ -20,6 +20,7 @@ _CONFIG_SCHEMA = {
         "cache_capacity": "cache_capacity",
         "hierarchical_allreduce": "hierarchical_allreduce",
         "hierarchical_allgather": "hierarchical_allgather",
+        "ring_min_bytes": "ring_min_bytes",
     },
     "autotune": {
         "enabled": "autotune",
@@ -75,6 +76,8 @@ def env_from_args(args) -> Dict[str, str]:
         env[env_util.HVD_CYCLE_TIME] = str(args.cycle_time_ms)
     if getattr(args, "cache_capacity", None) is not None:
         env[env_util.HVD_CACHE_CAPACITY] = str(args.cache_capacity)
+    if getattr(args, "ring_min_bytes", None) is not None:
+        env[env_util.HVD_RING_MIN_BYTES] = str(args.ring_min_bytes)
     setb(env_util.HVD_HIERARCHICAL_ALLREDUCE,
          getattr(args, "hierarchical_allreduce", False))
     setb(env_util.HVD_HIERARCHICAL_ALLGATHER,

@@ -101,6 +101,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                               dest="hierarchical_allreduce")
     group_params.add_argument("--hierarchical-allgather", action="store_true",
                               dest="hierarchical_allgather")
+    group_params.add_argument("--ring-min-bytes", type=int,
+                              dest="ring_min_bytes",
+                              help="host-plane payloads at or above this "
+                                   "ride the peer ring; below it the "
+                                   "coordinator star wins on latency "
+                                   "(calibrate with scripts/"
+                                   "host_plane_bench.py --crossover)")
 
     group_at = parser.add_argument_group("autotune arguments")
     group_at.add_argument("--autotune", action="store_true")

@@ -36,6 +36,9 @@ HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
 HVD_HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
 HVD_HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
 HVD_CACHE_CAPACITY = "HVD_CACHE_CAPACITY"
+# host-plane ring/star crossover: payloads >= this ride the peer ring
+# (calibrate per fabric: scripts/host_plane_bench.py --crossover)
+HVD_RING_MIN_BYTES = "HVD_RING_MIN_BYTES"
 HVD_BATCH_D2D_MEMCOPIES = "HVD_BATCH_D2D_MEMCOPIES"
 HVD_NUM_NCCL_STREAMS = "HVD_NUM_NCCL_STREAMS"          # parity stub
 # comma list of NIC names the host data plane advertises on (reference

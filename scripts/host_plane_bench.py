@@ -150,6 +150,41 @@ def bench_allreduce(np_: int, payload_mb: float, iters: int, ring: bool):
     }
 
 
+def bench_crossover(np_: int, iters: int, sizes_kb):
+    """Ring-vs-star time per allreduce across payload sizes, with the
+    ring forced on for every size (HVD_RING_MIN_BYTES=1), yielding the
+    measured crossover — the recommended production HVD_RING_MIN_BYTES
+    for THIS host's fabric (eager.py's 32 KB default was measured on a
+    core-bound CI host)."""
+    rows = []
+    for kb in sizes_kb:
+        row = {"payload_kb": kb}
+        for ring in (True, False):
+            res = run(_allreduce_worker, args=(kb / 1024.0, iters),
+                      np=np_,
+                      extra_env={"HVD_RING": "1" if ring else "0",
+                                 "HVD_RING_MIN_BYTES": "1"})
+            assert all(r["ring"] == ring for r in res)
+            row["ring_s" if ring else "star_s"] = max(
+                r["seconds_per_allreduce"] for r in res)
+        row["ring_wins"] = row["ring_s"] < row["star_s"]
+        rows.append(row)
+        print(f"crossover np={np_} {kb:6d} KB: "
+              f"ring {row['ring_s'] * 1e3:8.2f} ms  "
+              f"star {row['star_s'] * 1e3:8.2f} ms  "
+              f"-> {'ring' if row['ring_wins'] else 'star'}")
+    # recommend the smallest payload from which ring wins CONTIGUOUSLY
+    # through the largest size (isolated small-payload wins are noise)
+    rec = None
+    for row in reversed(rows):
+        if row["ring_wins"]:
+            rec = row["payload_kb"] * 1024
+        else:
+            break
+    return {"np": np_, "iters": iters, "rows": rows,
+            "recommended_ring_min_bytes": rec}
+
+
 def bench_train(np_: int, batch: int, steps: int):
     res = run(_train_worker, args=(batch, steps), np=np_)
     total = sum(r["img_per_sec_per_rank"] for r in res)
@@ -167,10 +202,31 @@ def main() -> None:
                     help="smaller payloads / fewer iters")
     ap.add_argument("--payload-mb", type=float, default=None)
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--crossover", action="store_true",
+                    help="sweep ring vs star across payload sizes and "
+                         "recommend HVD_RING_MIN_BYTES for this host")
     args = ap.parse_args()
 
     payload = args.payload_mb or (16 if args.quick else 100)
     iters = args.iters or (3 if args.quick else 5)
+
+    if args.crossover:
+        sizes = [4, 16, 64, 256, 1024] if args.quick \
+            else [4, 16, 64, 256, 1024, 4096]
+        result = bench_crossover(2, iters, sizes)
+        rec = result["recommended_ring_min_bytes"]
+        print(f"recommended HVD_RING_MIN_BYTES for this host: {rec}"
+              if rec else
+              "star won at every size on this host; keep the ring off "
+              "for these payloads (HVD_RING=0) or raise the threshold")
+        dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "out")
+        os.makedirs(dest, exist_ok=True)
+        path = os.path.join(dest, "host_plane_crossover.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=1)
+        print("wrote", path)
+        return
 
     out = {"allreduce": [], "train": [], "config": {
         "payload_mb": payload, "iters": iters,

@@ -116,6 +116,7 @@ def test_yaml_config_override(tmp_path):
         params:
           fusion_threshold_mb: 16
           cycle_time_ms: 2.5
+          ring_min_bytes: 65536
         autotune:
           enabled: true
           warmup_samples: 7
@@ -128,6 +129,7 @@ def test_yaml_config_override(tmp_path):
     env = env_from_args(args)
     assert env["HVD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
     assert env["HVD_CYCLE_TIME"] == "2.5"
+    assert env["HVD_RING_MIN_BYTES"] == "65536"
     assert env["HVD_AUTOTUNE"] == "1"
     assert env["HVD_AUTOTUNE_WARMUP_SAMPLES"] == "7"
     assert env["HVD_TIMELINE"] == "/tmp/yaml_tl"
@@ -432,3 +434,30 @@ def test_package_level_run_export():
     from horovod_tpu.run.run import run as fn_module_path
 
     assert fn is fn_module_path
+
+
+def test_ring_min_bytes_flag_and_env():
+    """--ring-min-bytes reaches workers as HVD_RING_MIN_BYTES, and the
+    eager transport reads it (the ring/star crossover is fabric-specific:
+    calibrate with scripts/host_plane_bench.py --crossover)."""
+    import subprocess
+    import sys
+
+    from horovod_tpu.run.config_parser import env_from_args
+    from horovod_tpu.run.run import parse_args
+
+    args = parse_args(["--ring-min-bytes", "131072", "-np", "2", "cmd"])
+    assert env_from_args(args)["HVD_RING_MIN_BYTES"] == "131072"
+
+    # the runtime honors the env override (read at import)
+    import os
+
+    env = dict(os.environ)
+    env["HVD_RING_MIN_BYTES"] = "12345"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from horovod_tpu import eager; print(eager._RING_MIN_BYTES)"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.stdout.strip() == "12345", out.stderr[-500:]
