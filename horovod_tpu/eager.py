@@ -23,10 +23,8 @@ Two eager modes:
 
 from __future__ import annotations
 
-import io
 import pickle
-from functools import partial
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional
 
 import numpy as np
 

@@ -3,6 +3,8 @@ under mpirun as integration coverage (reference
 .buildkite/gen-pipeline.sh:127-174); here each example's ``run()`` is
 invoked tiny on the 8-device CPU mesh."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -143,3 +145,29 @@ def test_bert_benchmark_adasum(mesh8):
         "--dtype", "float32",
     ]))
     assert np.isfinite(r["final_loss"])
+
+
+def test_mxnet_mnist_example(mesh8):
+    """The gluon recipe end-to-end: DistributedTrainer + parameter
+    broadcast + metric allreduce (reference examples/mxnet_mnist.py),
+    against real mxnet when importable, else the audited fake."""
+    import subprocess
+    import sys
+
+    # subprocess: the example installs the fake mxnet into sys.modules,
+    # which must not leak into this test process's import state
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "examples/mxnet_mnist.py",
+         "--epochs", "3", "--num-samples", "256", "--batch-size", "8"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("epoch")]
+    assert len(lines) == 3
+    first = float(lines[0].rsplit(" ", 1)[1])
+    last = float(lines[-1].rsplit(" ", 1)[1])
+    assert np.isfinite(last) and last < first * 1.05
