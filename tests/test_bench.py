@@ -1,0 +1,95 @@
+"""bench.py outage handling — the driver-benchmark contract.
+
+Round-4's number was lost to a traceback when the TPU tunnel blipped at
+capture time (VERDICT r4 weak #1); these tests pin the hardened
+behavior: bounded retry, one structured JSON line on rc 0 whatever
+happens, CPU-fallback refusal, and the probe's hang/unavailable/
+cpu_only classification."""
+
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(_REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_probes_fail_emits_structured_skip(monkeypatch, capsys):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "RETRY_DELAY_S", 0)
+    monkeypatch.setattr(bench, "_probe", lambda: "hang")
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)  # ONE parseable JSON line, no traceback
+    assert out["metric"] == "resnet50_synthetic_img_sec_per_chip"
+    assert out["error"] == "tpu_unavailable"
+    assert out["value"] == 0.0
+    assert len(out["attempts"]) == 3
+    assert all("hang" in a for a in out["attempts"])
+
+
+def test_cpu_fallback_is_an_outage_not_a_number(monkeypatch, capsys):
+    """A CPU-only backend must read as an outage — publishing a CPU
+    throughput as the per-chip TPU metric would be a silent lie."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "RETRY_DELAY_S", 0)
+    monkeypatch.setattr(bench, "_probe", lambda: "cpu_only")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["error"] == "tpu_unavailable"
+    assert any("cpu_only" in a for a in out["attempts"])
+
+
+def test_probe_classifies_cpu_backend(monkeypatch):
+    """The real probe against this host's CPU backend says cpu_only
+    (subprocess inherits a CPU-pinned env)."""
+    bench = _load_bench()
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bench._probe() == "cpu_only"
+
+
+def test_successful_run_passes_result_through(monkeypatch, capsys):
+    """When the child run emits a RESULT line, main() prints exactly its
+    JSON payload and nothing else."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        returncode = 0
+        stdout = "noise\nRESULT " + json.dumps(payload) + "\n"
+        stderr = ""
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: FakeProc())
+    bench.main()
+    out = capsys.readouterr().out.strip()
+    assert json.loads(out) == payload
+
+
+def test_run_timeout_retries_then_skips(monkeypatch, capsys):
+    """A hung measurement child (tunnel died mid-run) burns the attempt
+    and the final line is still structured."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "RETRY_DELAY_S", 0)
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+
+    def raise_timeout(*a, **k):
+        raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", raise_timeout)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["error"] == "tpu_unavailable"
+    assert all("timeout" in a for a in out["attempts"])
