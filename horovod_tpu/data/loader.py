@@ -15,7 +15,6 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
-import jax
 
 from .. import core
 from ..training import shard_batch

@@ -19,8 +19,6 @@ DataLoader shim (data/loader.py): the last partial batch runs with
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 from jax import lax
 

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from .. import core
 from ..core import Average
 from ..ops.compression import Compression
-from ..training import TrainState, init_train_state, make_train_step
+from ..training import init_train_state, make_train_step
 from ..data.loader import ShardedLoader
 from ..utils.logging import get_logger
 from .store import Store

@@ -8,10 +8,8 @@ swap)."""
 
 from __future__ import annotations
 
-import json
 import os
 import pickle
-import shutil
 from typing import Any, Optional
 
 

@@ -27,7 +27,6 @@ map to ``axis_index_groups`` — the analog of Horovod's sub-communicator
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Optional, Sequence
 
 import jax

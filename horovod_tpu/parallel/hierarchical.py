@@ -20,8 +20,6 @@ parameter_manager.cc — ours is a candidate knob in optim/autotune.py).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 from jax import lax
 

@@ -23,7 +23,7 @@ Bubble fraction is the usual (S-1)/(M+S-1); pick M >> S.
 from __future__ import annotations
 
 import warnings
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

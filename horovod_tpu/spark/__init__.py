@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import socket
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import pyspark  # gate: module import fails cleanly without Spark
 

@@ -20,7 +20,7 @@ only if tensorflow itself is unavailable.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 
