@@ -1,0 +1,204 @@
+"""ResNet-50 ImageNet training — the reference's flagship full-recipe
+example (reference examples/keras_imagenet_resnet50.py), TPU-native.
+
+Brings together the same distributed-training concepts the reference's
+script demonstrates, each mapped to its horovod_tpu form:
+
+reference (keras/horovod)                  | here (TPU-native)
+------------------------------------------|----------------------------------
+hvd.init + GPU pinning per local rank      | hvd.init() builds the mesh
+checkpoint scan + broadcast resume epoch   | utils.checkpoint.latest_step on
+                                           |   rank 0, broadcast to all
+DistributedOptimizer(+fp16 compression)    | make_train_step fused-bucket
+                                           |   allreduce (+bf16 compression,
+                                           |   autotune, hierarchical ICI/DCN)
+LearningRateWarmupCallback + staircase     | optax schedule: linear warmup →
+  Schedule callbacks (Goyal et al. recipe) |   30/60/80-epoch staircase
+MetricAverageCallback                      | in-step cross-rank loss average
+rank-0 ModelCheckpoint                     | rank-0 Orbax save_checkpoint
+ImageDataGenerator directories             | ShardedLoader over npz/synthetic
+                                           |   shards (Join-safe tail)
+
+With no --train-dir the script runs on synthetic data (the reference's
+benchmark methodology) so the full recipe — warmup, schedule, resume,
+checkpointing — is exercisable on any mesh, e.g.:
+
+    tpurun -np 8 python examples/keras_imagenet_resnet50.py \
+        --epochs 2 --steps-per-epoch 20 --checkpoint-dir /tmp/ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="TPU-native Keras-ImageNet-ResNet50 recipe",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--train-dir", default=None,
+                   help="directory of .npz shards with arrays 'x' "
+                        "(NHWC float) and 'y' (int labels); synthetic "
+                        "data when unset")
+    p.add_argument("--checkpoint-dir", default="./checkpoints",
+                   help="Orbax checkpoint directory (rank 0 writes)")
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="bf16 wire compression for the gradient allreduce")
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   help="two-level ICI/DCN gradient reduction")
+    p.add_argument("--autotune", action="store_true",
+                   help="live GP autotuning of the fusion threshold")
+    # Goyal et al. (arXiv:1706.02677) hyperparameters, as the reference
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-chip batch size")
+    p.add_argument("--epochs", type=int, default=90)
+    p.add_argument("--steps-per-epoch", type=int, default=100,
+                   help="steps per epoch (synthetic mode)")
+    p.add_argument("--base-lr", type=float, default=0.0125,
+                   help="learning rate per chip (scaled by world size)")
+    p.add_argument("--warmup-epochs", type=float, default=5)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=0.00005)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--model", default="ResNet50",
+                   help="registry name; the recipe is ResNet-50, but CI "
+                        "smoke-runs it on ResNet-18 (CPU compiles of the "
+                        "full model take tens of minutes on a 1-core host)")
+    return p.parse_args(argv)
+
+
+def lr_schedule(args, size: int, steps_per_epoch: int):
+    """Linear warmup to base_lr*size over warmup_epochs, then the
+    reference's staircase: x1 until epoch 30, x0.1, x0.01, x0.001
+    (reference LearningRateScheduleCallback stack)."""
+    import optax
+
+    peak = args.base_lr * size
+    warm = int(args.warmup_epochs * steps_per_epoch)
+    # join_schedules rebases the second schedule's step count to the
+    # boundary, so absolute-epoch decay points must subtract the warmup
+    bounds = {int(e * steps_per_epoch) - warm: m
+              for e, m in ((30, 0.1), (60, 0.1), (80, 0.1))
+              if int(e * steps_per_epoch) > warm}
+    return optax.join_schedules(
+        [optax.linear_schedule(peak / size, peak, warm),
+         optax.piecewise_constant_schedule(peak, bounds)],
+        [warm])
+
+
+def run(args) -> dict:
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data.loader import ShardedLoader
+    from horovod_tpu.models import MODELS
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    hvd.init(platform=args.platform)
+    verbose = hvd.rank() == 0
+    g = args.batch_size * hvd.size()
+
+    data = None
+    if args.train_dir:
+        import glob
+
+        files = sorted(glob.glob(os.path.join(args.train_dir, "*.npz")))
+        assert files, f"no .npz shards under {args.train_dir}"
+        xs, ys = zip(*((d["x"], d["y"]) for d in map(np.load, files)))
+        data = (np.concatenate(xs).astype(np.float32),
+                np.concatenate(ys).astype(np.int32))
+
+    # LR boundaries are in real optimizer steps: with data, an epoch is
+    # what the loader yields, not the synthetic-mode flag
+    steps_per_epoch = (data[0].shape[0] // g if data is not None
+                       else args.steps_per_epoch)
+
+    model = MODELS[args.model](num_classes=args.num_classes,
+                               dtype=jnp.bfloat16)
+    sched = lr_schedule(args, hvd.size(), steps_per_epoch)
+    opt = optax.chain(
+        optax.add_decayed_weights(args.wd),
+        optax.sgd(sched, momentum=args.momentum),
+    )
+
+    step = make_train_step(
+        apply_fn=model.apply,
+        loss_fn=lambda logits, y:
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(),
+        optimizer=opt,
+        has_batch_stats=True,
+        compression=(hvd.Compression.fp16 if args.fp16_allreduce
+                     else hvd.Compression.none),
+        hierarchical=args.hierarchical_allreduce,
+        autotune=args.autotune or None,
+    )
+
+    state = init_train_state(
+        model, opt, jnp.zeros((2, args.image_size, args.image_size, 3)),
+        has_batch_stats=True)
+
+    # resume: rank 0 scans the checkpoint dir, everyone agrees via
+    # broadcast (reference: resume_from_epoch hvd.broadcast)
+    start_epoch = 0
+    have = ckpt.latest_step(args.checkpoint_dir) if verbose else None
+    if hvd.process_size() > 1:
+        from horovod_tpu import eager
+
+        have = eager.broadcast_object(have)
+    if have is not None:
+        state = ckpt.restore_checkpoint(args.checkpoint_dir, state,
+                                        step=have)
+        start_epoch = have + 1
+        if verbose:
+            print(f"resumed from epoch {have}", flush=True)
+
+    rng = np.random.default_rng(1)
+
+    def epoch_batches(epoch: int):
+        """Yield (x_sharded, y_sharded) global batches."""
+        if data is not None:
+            loader = ShardedLoader(*data, batch_size=args.batch_size,
+                                   shuffle=True, seed=epoch,
+                                   drop_remainder=True)
+            for xb, yb, _active in loader:
+                yield xb, yb
+        else:
+            for _ in range(args.steps_per_epoch):
+                x = rng.uniform(
+                    size=(g, args.image_size, args.image_size, 3)
+                ).astype(np.float32)
+                y = rng.integers(0, args.num_classes, size=(g,)
+                                 ).astype(np.int32)
+                yield shard_batch(x), shard_batch(y)
+
+    last_loss = float("nan")
+    for epoch in range(start_epoch, args.epochs):
+        loss = None
+        for x, y in epoch_batches(epoch):
+            state, loss = step(state, x, y)
+        if loss is None:
+            raise ValueError(
+                f"epoch {epoch} yielded no batches: need at least "
+                f"{g} rows (batch_size x world size)")
+        last_loss = float(np.asarray(loss))
+        if verbose:
+            print(f"epoch {epoch}: loss {last_loss:.4f}", flush=True)
+        ckpt.save_checkpoint(args.checkpoint_dir, state, step=epoch)
+    return {"last_loss": last_loss, "epochs_run": args.epochs - start_epoch}
+
+
+if __name__ == "__main__":
+    run(parse_args())

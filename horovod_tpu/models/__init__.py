@@ -12,6 +12,7 @@ from .resnet import (  # noqa: F401
     ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
 )
 from .vgg import VGG11, VGG16, VGG19  # noqa: F401
+from .vit import ViT, ViT_S16, ViT_B16, ViT_L16  # noqa: F401
 
 # the --model CLI registry; spread from resnet.MODELS (kept for
 # backwards compatibility) so the two can never diverge
@@ -21,4 +22,7 @@ MODELS = {
     "VGG16": VGG16,
     "VGG19": VGG19,
     "InceptionV3": InceptionV3,
+    "ViT-S16": ViT_S16,
+    "ViT-B16": ViT_B16,
+    "ViT-L16": ViT_L16,
 }

@@ -1,0 +1,83 @@
+"""Vision Transformer (flax/linen), TPU-first.
+
+Rounds out the image-model registry with the attention-based family the
+reference era predates: the scaling-table models (ResNet/VGG/Inception,
+reference README.rst:75-77) are all convolutional, while modern TPU
+image workloads are ViTs.  Reuses the shared Transformer encoder layer
+(models/bert.py EncoderLayer), so the same ``attention_fn`` plug-in used
+for sequence parallelism works here too.
+
+TPU-first choices: bf16 compute / f32 params; patchify as a single
+strided conv (one MXU-friendly matmul per patch grid); learnable class
+token + position embeddings; pre-LN encoder; no dropout (the synthetic
+benchmarks measure compute, and deterministic forward keeps the
+``train`` flag shape-stable for XLA).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .bert import EncoderLayer
+
+
+class ViT(nn.Module):
+    """ViT over NHWC images -> logits ``[b, num_classes]``.
+
+    Matches the image-registry call convention
+    (``model.apply(vars, x, train=...)``); ``train`` is accepted for
+    interface parity and ignored (no BN, no dropout).
+    """
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        p = self.patch_size
+        assert h % p == 0 and w % p == 0, \
+            f"image {h}x{w} not divisible by patch {p}"
+        x = nn.Conv(self.hidden_dim, kernel_size=(p, p), strides=(p, p),
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x.astype(self.dtype))
+        x = x.reshape(b, -1, self.hidden_dim)          # [b, hw/p^2, d]
+
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.hidden_dim), self.param_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.hidden_dim)).astype(
+                self.dtype), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.hidden_dim), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+
+        for _ in range(self.num_layers):
+            x = EncoderLayer(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                attention_fn=self.attention_fn,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="head")(x[:, 0])
+
+
+# standard variants (Dosovitskiy et al. table 1 shapes)
+ViT_S16 = partial(ViT, patch_size=16, hidden_dim=384, num_layers=12,
+                  num_heads=6, mlp_dim=1536)
+ViT_B16 = partial(ViT, patch_size=16, hidden_dim=768, num_layers=12,
+                  num_heads=12, mlp_dim=3072)
+ViT_L16 = partial(ViT, patch_size=16, hidden_dim=1024, num_layers=24,
+                  num_heads=16, mlp_dim=4096)

@@ -35,7 +35,7 @@ NAMEPLATE_TFLOPS = 197.0
 
 # analytic forward GFLOPs per image at the table's resolution (3x train)
 FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.73,
-              "ResNet18": 1.82}
+              "ResNet18": 1.82, "ViT-B16": 17.58}
 
 CONFIGS = [
     # (model, image, batch) — ResNet50 b128 anchors against the headline
@@ -50,6 +50,10 @@ CONFIGS = [
 ]
 QUICK = [("ResNet50", 224, 128), ("VGG16", 224, 32),
          ("InceptionV3", 299, 64)]
+# the attention image family (--set vit): ResNet-50 b128 anchors the
+# window against the published-table sweep above
+VIT = [("ResNet50", 224, 128), ("ViT-B16", 224, 64),
+       ("ViT-B16", 224, 128), ("ViT-B16", 224, 256)]
 # plumbing smoke on CPU (wrong-MFU numbers by design; never published;
 # ResNet-18 only — ResNet-50/VGG compiles take >20 min on a 1-core host)
 SMOKE = [("ResNet18", 64, 4), ("ResNet18", 64, 8)]
@@ -70,6 +74,7 @@ def build(model_name: str, image: int, batch: int, k: int,
 
     model = MODELS[model_name](num_classes=1000, dtype=jnp.bfloat16)
     opt = optax.sgd(0.01, momentum=0.9)
+    bn = not model_name.startswith("ViT")   # ViT carries no batch stats
 
     def loss_fn(logits, labels):
         return optax.softmax_cross_entropy_with_integer_labels(
@@ -78,7 +83,7 @@ def build(model_name: str, image: int, batch: int, k: int,
     def make(steps):
         return make_train_step(
             apply_fn=model.apply, loss_fn=loss_fn, optimizer=opt,
-            has_batch_stats=True, in_graph_steps=steps,
+            has_batch_stats=bn, in_graph_steps=steps,
         )
 
     rng = np.random.default_rng(0)
@@ -89,18 +94,19 @@ def build(model_name: str, image: int, batch: int, k: int,
     # ONE train state per MODEL, threaded through every batch config
     # (steps donate their state; per-config states would hold ~4x VGG's
     # 1.1 GB and can exhaust HBM — docs/PERF.md methodology notes)
-    if model_name not in shared_states:
-        shared_states[model_name] = init_train_state(
+    skey = (model_name, image)   # ViT params depend on image (pos_embed)
+    if skey not in shared_states:
+        shared_states[skey] = init_train_state(
             model, opt, jnp.zeros((2, image, image, 3)),
-            has_batch_stats=True)
-    state = shared_states[model_name]
+            has_batch_stats=bn)
+    state = shared_states[skey]
 
     step = make(k)
     # XLA-issued FLOPs from a k=1 lowering (scan body counted once).
     # One compile per MODEL — per-step FLOPs scale linearly with batch,
     # so later batch configs scale the first measurement instead of
     # paying another ~30 s chip compile each.
-    key = f"__flops_{model_name}"
+    key = f"__flops_{model_name}_{image}"
     if key not in shared_states:
         one = make(1)
         try:
@@ -126,6 +132,10 @@ def main(argv=None) -> dict:
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--smoke", action="store_true",
                         help="tiny CPU plumbing check; output not valid")
+    parser.add_argument("--set", dest="config_set", default="table",
+                        choices=("table", "vit"),
+                        help="'table' = the reference's published models; "
+                             "'vit' = ViT-B16 sweep with a ResNet anchor")
     args = parser.parse_args(argv)
 
     import jax
@@ -137,7 +147,12 @@ def main(argv=None) -> dict:
     assert args.smoke or jax.devices()[0].platform != "cpu", \
         "model_sweep measures the real chip (--smoke for CPU plumbing)"
 
-    configs = SMOKE if args.smoke else QUICK if args.quick else CONFIGS
+    if args.smoke:
+        configs = SMOKE
+    elif args.config_set == "vit":
+        configs = VIT[:2] if args.quick else VIT
+    else:
+        configs = QUICK if args.quick else CONFIGS
     built = {}
     states = {}
     for name, image, batch in configs:
@@ -146,7 +161,7 @@ def main(argv=None) -> dict:
                                             states)
         # warmup: one call, synced; thread the donated state back
         step, x, y, _ = built[(name, image, batch)]
-        states[name], loss = step(states[name], x, y)
+        states[(name, image)], loss = step(states[(name, image)], x, y)
         np.asarray(jax.device_get(loss))
 
     best_ms = {c: float("inf") for c in configs}
@@ -154,7 +169,7 @@ def main(argv=None) -> dict:
         for c in configs:
             step, x, y, xla_flops = built[c]
             t0 = time.perf_counter()
-            states[c[0]], loss = step(states[c[0]], x, y)
+            states[c[:2]], loss = step(states[c[:2]], x, y)
             np.asarray(jax.device_get(loss))
             dt = time.perf_counter() - t0
             ms = dt / args.k * 1e3
@@ -186,7 +201,9 @@ def main(argv=None) -> dict:
                 exist_ok=True)
     path = os.path.join(
         os.path.dirname(__file__), "out",
-        "model_sweep_smoke.json" if args.smoke else "model_sweep.json")
+        "model_sweep_smoke.json" if args.smoke
+        else f"model_sweep_{args.config_set}.json"
+        if args.config_set != "table" else "model_sweep.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print("wrote", path)

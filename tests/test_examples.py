@@ -171,3 +171,20 @@ def test_mxnet_mnist_example(mesh8):
     first = float(lines[0].rsplit(" ", 1)[1])
     last = float(lines[-1].rsplit(" ", 1)[1])
     assert np.isfinite(last) and last < first * 1.05
+
+
+def test_keras_imagenet_resnet50_recipe_with_resume(mesh8, tmp_path):
+    """The reference's flagship full-recipe example: warmup+staircase
+    LR, rank-0 checkpointing, and resume-from-latest with the epoch
+    broadcast (reference examples/keras_imagenet_resnet50.py)."""
+    from examples.keras_imagenet_resnet50 import parse_args, run
+
+    common = ["--batch-size", "2", "--image-size", "32",
+              "--num-classes", "4", "--steps-per-epoch", "2",
+              "--checkpoint-dir", str(tmp_path / "ckpt")]
+    r1 = run(parse_args(common + ["--epochs", "1", "--model", "ResNet18"]))
+    assert np.isfinite(r1["last_loss"]) and r1["epochs_run"] == 1
+
+    # second invocation resumes after epoch 0 and runs only epoch 1
+    r2 = run(parse_args(common + ["--epochs", "2", "--model", "ResNet18"]))
+    assert r2["epochs_run"] == 1

@@ -106,3 +106,48 @@ def test_inception_train_step():
     y = shard_batch(rng.integers(0, 4, size=(2,)).astype(np.int32))
     state, loss = step(state, x, y)
     assert np.isfinite(float(np.asarray(jax.device_get(loss))))
+
+
+def test_vit_shapes_and_params():
+    from horovod_tpu.models import ViT_B16
+
+    # tiny image keeps CPU compile cheap; params depend on the patch
+    # grid only through pos_embed
+    model = ViT_B16(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    # ViT-B/16 published trunk ~85.8M at 224^2/1000-way; with a 10-way
+    # head and a 4x4+1 patch grid: 12 layers x (4d^2 attn + 8d^2 mlp)
+    # + embeddings ~ 85.2M
+    total = _param_count(variables["params"])
+    assert 84.0e6 < total < 87.0e6, total
+    # the head must be the only num_classes-dependent piece
+    assert variables["params"]["head"]["kernel"].shape == (768, 10)
+
+
+def test_vit_train_step_and_registry():
+    from horovod_tpu.models import ViT
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    assert "ViT-B16" in MODELS and "ViT-S16" in MODELS
+    model = ViT(num_classes=4, patch_size=8, hidden_dim=64, num_layers=2,
+                num_heads=4, mlp_dim=128, dtype=jnp.float32)
+    opt = optax.sgd(0.01)
+    step = make_train_step(
+        apply_fn=model.apply,
+        loss_fn=lambda logits, y:
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(),
+        optimizer=opt,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 32, 32, 3)))
+    rng = np.random.default_rng(0)
+    x = shard_batch(rng.uniform(size=(2, 32, 32, 3)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 4, size=(2,)).astype(np.int32))
+    state, loss = step(state, x, y)
+    assert np.isfinite(float(np.asarray(jax.device_get(loss))))
