@@ -108,7 +108,11 @@ def _like(tensor, arr: np.ndarray):
     if hasattr(tensor, "detach"):
         import torch as th
 
-        return th.from_numpy(np.ascontiguousarray(arr)).to(tensor.dtype)
+        # ascontiguousarray promotes 0-d to 1-d (ndmin=1); reshape to the
+        # wire array's own shape so scalars (e.g. BN num_batches_tracked)
+        # round-trip — allgather outputs keep their grown dim 0
+        return th.from_numpy(
+            np.ascontiguousarray(arr)).reshape(arr.shape).to(tensor.dtype)
     return arr
 
 
@@ -277,10 +281,7 @@ class _DistributedOptimizer:
 
     def _copy_into(self, g, red) -> None:
         if hasattr(g, "copy_"):
-            import torch as th
-
-            g.copy_(th.from_numpy(
-                np.ascontiguousarray(np.asarray(red))).to(g.dtype))
+            g.copy_(_like(g, np.asarray(red)))
         else:
             g[...] = red
 

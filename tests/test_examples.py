@@ -188,3 +188,24 @@ def test_keras_imagenet_resnet50_recipe_with_resume(mesh8, tmp_path):
     # second invocation resumes after epoch 0 and runs only epoch 1
     r2 = run(parse_args(common + ["--epochs", "2", "--model", "ResNet18"]))
     assert r2["epochs_run"] == 1
+
+
+def test_pytorch_imagenet_resnet50_recipe_with_resume(mesh8, tmp_path):
+    """The reference's torch full-recipe example: warmup LR, grad
+    accumulation, metric averaging, rank-0 checkpoints, resume with the
+    epoch broadcast (reference examples/pytorch_imagenet_resnet50.py)."""
+    pytest.importorskip("torch")
+    from examples.pytorch_imagenet_resnet50 import parse_args, run
+
+    fmt = str(tmp_path / "checkpoint-{epoch}.pt")
+    common = ["--batch-size", "4", "--image-size", "64",
+              "--num-classes", "4", "--steps-per-epoch", "2",
+              "--batches-per-allreduce", "2",
+              "--checkpoint-format", fmt]
+    r1 = run(parse_args(common + ["--epochs", "1"]))
+    assert np.isfinite(r1["last_loss"]) and r1["epochs_run"] == 1
+    assert (tmp_path / "checkpoint-1.pt").exists()
+
+    # resumes after epoch 1's checkpoint and runs only epoch 2
+    r2 = run(parse_args(common + ["--epochs", "2"]))
+    assert r2["epochs_run"] == 1

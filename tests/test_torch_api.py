@@ -121,3 +121,40 @@ def test_compression_roundtrip(torch_init):
     t = torch.randn(16)
     out = hvd_torch.allreduce(t, compression=hvd_torch.Compression.fp16)
     assert out.dtype == t.dtype
+
+
+def test_zero_dim_tensors_roundtrip(torch_init):
+    """0-d tensors (e.g. batch-norm's num_batches_tracked in a
+    state_dict broadcast) must keep their shape through every op —
+    np.ascontiguousarray silently promotes 0-d to 1-d (round-5 fix)."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    t = torch.tensor(7)
+    out = hvd.broadcast(t, 0)
+    assert out.shape == t.shape == torch.Size([])
+    assert int(out) == 7
+    a = hvd.allreduce(torch.tensor(3.0), op=hvd.Sum)
+    assert a.shape == torch.Size([]) and float(a) == 3.0
+    t2 = torch.tensor(1)
+    hvd.broadcast_(t2, 0)
+    assert t2.shape == torch.Size([]) and int(t2) == 1
+
+
+def test_zero_dim_parameter_gradient(torch_init):
+    """A scalar nn.Parameter (learnable temperature / logit_scale) must
+    survive DistributedOptimizer.step(): the reduced 0-d grad flows
+    through _copy_into, which shares _like's reshape fix."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    scale = torch.nn.Parameter(torch.tensor(2.0))
+    opt = torch.optim.SGD([scale], lr=0.1)
+    opt = hvd.DistributedOptimizer(opt, named_parameters=[("scale", scale)])
+    loss = (scale * torch.ones(3)).sum()
+    loss.backward()
+    opt.step()
+    assert scale.shape == torch.Size([])
+    assert float(scale) == pytest.approx(2.0 - 0.1 * 3.0)
