@@ -11,11 +11,11 @@ our img/sec/chip ÷ 103.55.
 
 Configuration (from the round-2 profiling study, docs/PERF.md): batch 128
 (measured sweet spot on the v5e: the 56x56-stage activations are HBM-
-bound, smaller batch wins), bf16 compute, 50 optimizer steps compiled
-into one program via lax.scan.  Round 4 re-measured the in-graph step
-count interleaved on a quiet chip: k=50 beats k=10 by ~15% (2645/2611 vs
-2300/2204 img/s across two windows each) — at k=10 the tunnel's per-call
-dispatch+sync overhead still costs a double-digit share of the step.
+bound, smaller batch wins), bf16 compute, 100 optimizer steps compiled
+into one program via lax.scan.  Round 4 measured k=50 over k=10 (+15%);
+round 5 re-measured interleaved: k=100 beats k=50 by +2.6% (47.47 vs
+48.72 ms/step, min-of-4 in one process) and k=200 adds only ~0.5% —
+below the tunnel's window drift — so 100 is the knee.
 
 MFU accounting: ResNet-50 training ≈ 3 x 4.09 GFLOPs forward = 12.27
 GFLOPs/image of model math (the usual analytic count; XLA's own
@@ -63,7 +63,7 @@ def _measure() -> None:
 
     args = parse_args([
         "--batch-size", "128",
-        "--num-in-graph-steps", "50",
+        "--num-in-graph-steps", "100",
         "--num-warmup-batches", "1",
         "--num-batches-per-iter", "1",
         "--num-iters", "3",
