@@ -26,3 +26,8 @@ MODELS = {
     "ViT-B16": ViT_B16,
     "ViT-L16": ViT_L16,
 }
+
+# registry names whose init() carries no "batch_stats" collection —
+# harnesses pass has_batch_stats accordingly (single site: update here
+# when adding a BN-free model)
+BATCH_STATS_FREE = frozenset({"ViT-S16", "ViT-B16", "ViT-L16"})

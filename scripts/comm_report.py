@@ -46,6 +46,9 @@ def main(argv=None) -> dict:
 
     model = MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16)
     opt = optax.sgd(0.01, momentum=0.9)
+    from horovod_tpu.models import BATCH_STATS_FREE
+
+    bn = args.model not in BATCH_STATS_FREE
 
     def loss_fn(logits, labels):
         return optax.softmax_cross_entropy_with_integer_labels(
@@ -54,7 +57,7 @@ def main(argv=None) -> dict:
 
     step = make_train_step(
         apply_fn=model.apply, loss_fn=loss_fn, optimizer=opt,
-        has_batch_stats=True, hierarchical=args.hierarchical,
+        has_batch_stats=bn, hierarchical=args.hierarchical,
         compression=hvd.Compression.fp16 if args.fp16_allreduce
         else hvd.Compression.none,
         donate=False,
@@ -69,7 +72,7 @@ def main(argv=None) -> dict:
         0, 1000, size=(args.batch_size * hvd.size(),)).astype(np.int32))
     state = init_train_state(
         model, opt, jnp.zeros((2, args.image_size, args.image_size, 3)),
-        has_batch_stats=True,
+        has_batch_stats=bn,
     )
 
     report = collective_report(

@@ -74,7 +74,9 @@ def build(model_name: str, image: int, batch: int, k: int,
 
     model = MODELS[model_name](num_classes=1000, dtype=jnp.bfloat16)
     opt = optax.sgd(0.01, momentum=0.9)
-    bn = not model_name.startswith("ViT")   # ViT carries no batch stats
+    from horovod_tpu.models import BATCH_STATS_FREE
+
+    bn = model_name not in BATCH_STATS_FREE
 
     def loss_fn(logits, labels):
         return optax.softmax_cross_entropy_with_integer_labels(

@@ -2,6 +2,7 @@
 
 For each model in the reference's published scaling table (Inception V3,
 ResNet, VGG-16 — reference README.rst:75-77, docs/benchmarks.rst:12-13),
+plus ViT-B16 (beyond the reference's table, same methodology),
 compile the FULL hierarchical-DP training step on the 8-device virtual
 mesh, read the collective traffic out of the optimized HLO
 (timeline/comm_report.py), and model the 8→64-chip v5e scaling-efficiency
@@ -27,17 +28,22 @@ import sys
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 # ms per optimizer step on ONE v5e chip, from real-chip sessions
-# (docs/PERF.md): ResNet-50 b128 = 48.4 (round-4 k=50 session; the
-# round-3 driver-verified 2474.8 img/s = 51.7 is the conservative
-# anchor), VGG-16 b32 = 73.2 (437 img/s, round-4 single point).
+# (docs/PERF.md round-5 captures: the driver-path bench for ResNet-50,
+# the interleaved min-of-rounds sweeps for the rest).
 MEASURED_STEP_MS = {
-    "ResNet50": {"batch": 128, "ms": 51.7, "source": "driver r3 2474.8 img/s"},
-    "VGG16": {"batch": 32, "ms": 73.2, "source": "builder r4 437 img/s"},
-    # InceptionV3: no chip session yet (round-4 tunnel outage) — estimated
+    "ResNet50": {"batch": 128, "ms": 47.7,
+                 "source": "driver r5 2683.55 img/s (bench.py k=100)"},
+    "VGG16": {"batch": 128, "ms": 95.15,
+              "source": "r5 interleaved sweep 1345 img/s"},
+    "InceptionV3": {"batch": 128, "ms": 71.3,
+                    "source": "r5 interleaved sweep 1795 img/s"},
+    "ViT-B16": {"batch": 64, "ms": 80.36,
+                "source": "r5 interleaved sweep 796 img/s"},
 }
 
 # analytic forward GFLOPs per image at 224 (299 for Inception); train ≈ 3x
-FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.7}
+FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.7,
+              "ViT-B16": 17.58}
 MEASURED_CEILING_TFLOPS = 110.0   # the tunnel chip's measured bf16 ceiling
 
 
@@ -56,7 +62,8 @@ def one_model(name: str, batch: int, image: int, step_ms, fused: bool):
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser()
     parser.add_argument("--models", nargs="*",
-                        default=["ResNet50", "VGG16", "InceptionV3"])
+                        default=["ResNet50", "VGG16", "InceptionV3",
+                                 "ViT-B16"])
     parser.add_argument("--step-ms", nargs="*", default=[],
                         metavar="MODEL=MS",
                         help="override measured step ms, e.g. ResNet50=48.4")
