@@ -35,7 +35,7 @@ NAMEPLATE_TFLOPS = 197.0
 
 # analytic forward GFLOPs per image at the table's resolution (3x train)
 FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.73,
-              "ResNet18": 1.82, "ViT-B16": 17.58}
+              "ResNet18": 1.82, "ViT-B16": 17.58, "ViT-L16": 61.6}
 
 CONFIGS = [
     # (model, image, batch) — ResNet50 b128 anchors against the headline
@@ -138,6 +138,10 @@ def main(argv=None) -> dict:
                         choices=("table", "vit"),
                         help="'table' = the reference's published models; "
                              "'vit' = ViT-B16 sweep with a ResNet anchor")
+    parser.add_argument("--configs", default=None,
+                        help="ad-hoc override: 'Model:image:batch,...' "
+                             "(e.g. 'VGG16:224:256,ViT-L16:224:32'); "
+                             "writes model_sweep_custom.json")
     args = parser.parse_args(argv)
 
     import jax
@@ -149,12 +153,43 @@ def main(argv=None) -> dict:
     assert args.smoke or jax.devices()[0].platform != "cpu", \
         "model_sweep measures the real chip (--smoke for CPU plumbing)"
 
-    if args.smoke:
+    if args.configs and args.smoke:
+        parser.error("--smoke and --configs are mutually exclusive: "
+                     "smoke numbers must never merge into a published "
+                     "artifact")
+    if args.configs:
+        configs = [(m, int(i), int(b)) for m, i, b in
+                   (c.split(":") for c in args.configs.split(","))]
+        unknown = [m for m, _, _ in configs if m not in FWD_GFLOPS]
+        if unknown:
+            parser.error(f"no FWD_GFLOPS entry for {unknown}; add the "
+                         "analytic count before burning chip time")
+    elif args.smoke:
         configs = SMOKE
     elif args.config_set == "vit":
         configs = VIT[:2] if args.quick else VIT
     else:
         configs = QUICK if args.quick else CONFIGS
+
+    # resolve the artifact path and read the prior sessions' rows NOW,
+    # before any chip time is spent — a corrupt artifact must fail fast,
+    # not after a multi-hour sweep
+    path = os.path.join(
+        os.path.dirname(__file__), "out",
+        "model_sweep_custom.json" if args.configs
+        else "model_sweep_smoke.json" if args.smoke
+        else f"model_sweep_{args.config_set}.json"
+        if args.config_set != "table" else "model_sweep.json")
+    prior = {}
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError) as e:
+        parser.error(f"existing artifact {path} is unreadable ({e}); "
+                     "move it aside before sweeping")
+
     built = {}
     states = {}
     for name, image, batch in configs:
@@ -185,6 +220,7 @@ def main(argv=None) -> dict:
         analytic = FWD_GFLOPS[name] * 3e9 * batch
         entry = {
             "batch": batch, "image": image,
+            "ceiling_tflops": MEASURED_CEILING_TFLOPS,
             "ms_per_step": round(ms, 2),
             "img_sec_per_chip": round(img_s, 1),
             "analytic_flops_per_step": analytic,
@@ -199,17 +235,31 @@ def main(argv=None) -> dict:
               f"MFU {entry['mfu_vs_measured_ceiling']:.1%} of ceiling",
               flush=True)
 
-    os.makedirs(os.path.join(os.path.dirname(__file__), "out"),
-                exist_ok=True)
-    path = os.path.join(
-        os.path.dirname(__file__), "out",
-        "model_sweep_smoke.json" if args.smoke
-        else f"model_sweep_{args.config_set}.json"
-        if args.config_set != "table" else "model_sweep.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # merge-on-write: successive sessions accumulate per-(model,batch)
+    # rows instead of clobbering earlier measurements (the gpt_mfu_sweep
+    # convention: prior artifact was pre-loaded before the sweep ran,
+    # and rows measured against a stale ceiling are dropped)
+    merged = {
+        name: [e for e in entries
+               if e.get("ceiling_tflops") == MEASURED_CEILING_TFLOPS]
+        for name, entries in prior.items()
+    }
+    for name, entries in out.items():
+        have = {(e["batch"], e["image"]): i
+                for i, e in enumerate(merged.get(name, []))}
+        for e in entries:
+            k = (e["batch"], e["image"])
+            if k in have:
+                merged[name][have[k]] = e
+            else:
+                merged.setdefault(name, []).append(e)
+        merged[name].sort(key=lambda e: (e["image"], e["batch"]))
+    merged = {k: v for k, v in merged.items() if v}
     with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+        json.dump(merged, f, indent=1)
     print("wrote", path)
-    return out
+    return merged
 
 
 if __name__ == "__main__":
