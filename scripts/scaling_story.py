@@ -33,10 +33,10 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 MEASURED_STEP_MS = {
     "ResNet50": {"batch": 128, "ms": 47.7,
                  "source": "driver r5 2683.55 img/s (bench.py k=100)"},
-    "VGG16": {"batch": 128, "ms": 95.15,
-              "source": "r5 interleaved sweep 1345 img/s"},
-    "InceptionV3": {"batch": 128, "ms": 71.3,
-                    "source": "r5 interleaved sweep 1795 img/s"},
+    "VGG16": {"batch": 256, "ms": 181.47,
+              "source": "r5 interleaved sweep 1411 img/s (b256 best)"},
+    "InceptionV3": {"batch": 256, "ms": 138.43,
+                    "source": "r5 interleaved sweep 1849 img/s (b256 best)"},
     "ViT-B16": {"batch": 64, "ms": 80.36,
                 "source": "r5 interleaved sweep 796 img/s"},
 }
