@@ -110,6 +110,10 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--configs", default=None,
                     help="comma list of BxS, e.g. 8x1024,16x1024")
+    ap.add_argument("--k", type=int, default=5,
+                    help="in-graph steps per timed call (the bench.py "
+                         "amortization knob; per-call overhead is ~2%% "
+                         "of a 571 ms call at K=5)")
     args = ap.parse_args()
 
     hvd.init()
@@ -143,7 +147,7 @@ def main() -> None:
 
     rows = []
     for batch, seq in configs:
-        r = run_config(batch, seq)
+        r = run_config(batch, seq, k_steps=args.k)
         r["ceiling_tflops"] = MEASURED_CEILING_TFLOPS
         rows.append(r)
         print(
