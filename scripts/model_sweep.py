@@ -33,9 +33,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 MEASURED_CEILING_TFLOPS = 110.0   # bf16 matmul ceiling on this chip
 NAMEPLATE_TFLOPS = 197.0
 
-# analytic forward GFLOPs per image at the table's resolution (3x train)
+# analytic forward GFLOPs per image (3x train).  Keyed by model at the
+# table's default resolution; "Model@image" entries override for other
+# resolutions (ViT FLOPs scale superlinearly with the patch-grid size)
 FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.73,
-              "ResNet18": 1.82, "ViT-B16": 17.58, "ViT-L16": 61.6}
+              "ResNet18": 1.82, "ViT-B16": 17.58, "ViT-L16": 61.6,
+              "ViT-B16@384": 55.4}
+
+
+def fwd_gflops(name: str, image: int) -> float:
+    return FWD_GFLOPS.get(f"{name}@{image}", FWD_GFLOPS[name])
 
 CONFIGS = [
     # (model, image, batch) — ResNet50 b128 anchors against the headline
@@ -217,7 +224,7 @@ def main(argv=None) -> dict:
     for (name, image, batch), (*_, xla_flops) in built.items():
         ms = best_ms[(name, image, batch)]
         img_s = batch / (ms / 1e3)
-        analytic = FWD_GFLOPS[name] * 3e9 * batch
+        analytic = fwd_gflops(name, image) * 3e9 * batch
         entry = {
             "batch": batch, "image": image,
             "ceiling_tflops": MEASURED_CEILING_TFLOPS,
