@@ -151,3 +151,19 @@ def test_vit_train_step_and_registry():
     y = shard_batch(rng.integers(0, 4, size=(2,)).astype(np.int32))
     state, loss = step(state, x, y)
     assert np.isfinite(float(np.asarray(jax.device_get(loss))))
+
+
+def test_vit_variant_param_counts():
+    """S16/L16 variants match the published trunk sizes (eval_shape
+    only — no compile)."""
+    from horovod_tpu.models import ViT_L16, ViT_S16
+
+    for make, lo, hi in ((ViT_S16, 21.5e6, 23.0e6),
+                         (ViT_L16, 302.0e6, 306.0e6)):
+        model = make(num_classes=10, dtype=jnp.float32)
+        v = jax.eval_shape(
+            lambda m=model: m.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 64, 64, 3)),
+                                   train=False))
+        total = _param_count(jax.tree_util.tree_leaves(v))
+        assert lo < total < hi, (make, total)
