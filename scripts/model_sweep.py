@@ -37,7 +37,7 @@ NAMEPLATE_TFLOPS = 197.0
 # table's default resolution; "Model@image" entries override for other
 # resolutions (ViT FLOPs scale superlinearly with the patch-grid size)
 FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.73,
-              "ResNet18": 1.82, "ViT-B16": 17.58, "ViT-L16": 61.6,
+              "ResNet18": 1.82, "ResNet101": 7.8, "ViT-B16": 17.58, "ViT-L16": 61.6,
               "ViT-B16@384": 55.4}
 
 
