@@ -33,6 +33,8 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 MEASURED_STEP_MS = {
     "ResNet50": {"batch": 128, "ms": 47.7,
                  "source": "driver r5 2683.55 img/s (bench.py k=100)"},
+    "ResNet101": {"batch": 128, "ms": 79.01,
+                  "source": "r5 interleaved sweep 1620 img/s"},
     "VGG16": {"batch": 256, "ms": 181.47,
               "source": "r5 interleaved sweep 1411 img/s (b256 best)"},
     "InceptionV3": {"batch": 256, "ms": 138.43,
@@ -42,8 +44,8 @@ MEASURED_STEP_MS = {
 }
 
 # analytic forward GFLOPs per image at 224 (299 for Inception); train ≈ 3x
-FWD_GFLOPS = {"ResNet50": 4.09, "VGG16": 15.5, "InceptionV3": 5.7,
-              "ViT-B16": 17.58}
+FWD_GFLOPS = {"ResNet50": 4.09, "ResNet101": 7.8, "VGG16": 15.5,
+              "InceptionV3": 5.7, "ViT-B16": 17.58}
 MEASURED_CEILING_TFLOPS = 110.0   # the tunnel chip's measured bf16 ceiling
 
 
@@ -62,8 +64,8 @@ def one_model(name: str, batch: int, image: int, step_ms, fused: bool):
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser()
     parser.add_argument("--models", nargs="*",
-                        default=["ResNet50", "VGG16", "InceptionV3",
-                                 "ViT-B16"])
+                        default=["ResNet50", "ResNet101", "VGG16",
+                                 "InceptionV3", "ViT-B16"])
     parser.add_argument("--step-ms", nargs="*", default=[],
                         metavar="MODEL=MS",
                         help="override measured step ms, e.g. ResNet50=48.4")
