@@ -254,6 +254,15 @@ def init(
         from .timeline.timeline import timeline
 
         timeline.initialize()
+        # Live metrics export: when the launcher stood up a rendezvous
+        # server and passed its address (HVD_METRICS_KV_*), start pushing
+        # this rank's snapshots so the launcher's GET /metrics sees us.
+        try:
+            from .metrics.push import start_pusher_from_env
+
+            start_pusher_from_env(_state.process_index)
+        except Exception as e:  # noqa: BLE001 — metrics must never
+            log.warning("metrics pusher setup failed: %s", e)  # block init
 
 
 def shutdown() -> None:
@@ -270,6 +279,12 @@ def shutdown() -> None:
         from .timeline.timeline import timeline
 
         timeline.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .metrics.push import stop_pusher
+
+        stop_pusher()  # flushes one final snapshot to the launcher
     except Exception:  # noqa: BLE001
         pass
     with _lock:

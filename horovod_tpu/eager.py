@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from . import core
+from . import core, metrics
 from .spmd import put_per_rank, get_per_rank, rank_context
 from .core import Average, Sum, Adasum, Min, Max
 from .ops import collectives
@@ -44,30 +44,68 @@ from .utils import env as env_util
 
 def _dispatch_guard(name: str, op: str, tensors):
     """Shared pre-dispatch path for eager collectives: stall watchdog +
-    timeline NEGOTIATE span + (in multi-controller jobs) the native
-    controller handshake that guarantees identical op ordering across
-    processes (see runtime/eager_controller.py)."""
+    timeline NEGOTIATE span + metrics (bytes/calls/latency per op) +
+    (in multi-controller jobs) the native controller handshake that
+    guarantees identical op ordering across processes (see
+    runtime/eager_controller.py)."""
     import contextlib
+    import time as _time
 
     @contextlib.contextmanager
     def ctx():
         sample = tensors[0] if _is_per_rank_list(tensors) else tensors
         shape = np.shape(sample)
         dtype = getattr(sample, "dtype", "float32")
+        mon = metrics.on()
+        t0 = _time.perf_counter() if mon else 0.0
+        t_neg = t0
         with inspector.watch(name):
             timeline.negotiate_start(name, op.upper())
             eager_controller.negotiate(
                 name, op=op, shape=shape, dtype=dtype
             )
             timeline.negotiate_end(name, op.upper())
-            with timeline.span(name, op.upper()):
-                yield
+            if mon:
+                t_neg = _time.perf_counter()
+            try:
+                with timeline.span(name, op.upper()):
+                    yield
+            finally:
+                if mon:
+                    metrics.record_eager(
+                        op, metrics.payload_bytes(shape, dtype),
+                        t_neg - t0, _time.perf_counter() - t0,
+                    )
 
     return ctx()
 
 
 def _is_per_rank_list(x) -> bool:
     return isinstance(x, (list, tuple))
+
+
+def _host_guard(name: str, activity: str, op: str, transport: str,
+                nbytes: int):
+    """Watchdog + timeline span + metrics for one host-plane collective
+    (the process_* transports: ring / coordinator star / XLA process
+    mesh)."""
+    import contextlib
+    import time as _time
+
+    @contextlib.contextmanager
+    def ctx():
+        mon = metrics.on()
+        t0 = _time.perf_counter() if mon else 0.0
+        try:
+            with inspector.watch(name), timeline.span(name, activity):
+                yield
+        finally:
+            if mon:
+                metrics.record_host(
+                    op, transport, nbytes, _time.perf_counter() - t0
+                )
+
+    return ctx()
 
 
 def _spmd_op(fn, *, out_sharded: bool):
@@ -373,7 +411,8 @@ def process_allreduce(arr, *, op: str = Average,
         # transport (the reference timelines its CPU-ops path the same
         # way — MPI_ALLREDUCE spans, timeline.cc activity vocabulary)
         activity = "RING_ALLREDUCE" if use_ring else "STAR_ALLREDUCE"
-        with inspector.watch(nm), timeline.span(nm, activity):
+        with _host_guard(nm, activity, "allreduce",
+                         "ring" if use_ring else "star", wire.nbytes):
             if use_ring:
                 # RingExecutor copies at submit; no defensive copy here
                 out = rx.allreduce(nm, wire, op=wire_op)
@@ -421,7 +460,7 @@ def process_allreduce(arr, *, op: str = Average,
             out = numpy_adasum(list(stacked))
         return out.astype(arr.dtype)
     wire = arr  # wire dtype guaranteed by the branch above
-    with inspector.watch(nm), timeline.span(nm, "MESH_ALLREDUCE"):
+    with _host_guard(nm, "MESH_ALLREDUCE", "allreduce", "mesh", wire.nbytes):
         if op in (Average, Sum):
             out = _mesh_sum_rows(wire)
             if op == Average:
@@ -471,14 +510,16 @@ def process_allgather(arr, *, name: Optional[str] = None) -> np.ndarray:
     equal = all(s == shapes[0] for s in shapes)
     if rx is not None and c is not None and wire_ok and equal \
             and arr.nbytes >= _RING_MIN_BYTES:
-        with inspector.watch(nm), timeline.span(nm, "RING_ALLGATHER"):
+        with _host_guard(nm, "RING_ALLGATHER", "allgather", "ring",
+                         arr.nbytes):
             return rx.allgather(nm, arr)
     if c is None and wire_ok and len(shapes[0]) >= 1:
         # jax.distributed pod without the native plane: rows ride the
         # process mesh (XLA gather), pickle stays for true objects only.
         # Varying first dims pad to the longest row, then slice back —
         # the allgatherv contract.
-        with inspector.watch(nm), timeline.span(nm, "MESH_ALLGATHER"):
+        with _host_guard(nm, "MESH_ALLGATHER", "allgather", "mesh",
+                         arr.nbytes):
             first = [s[0] for s in shapes]
             maxn = max(first)
             padded = np.zeros((maxn,) + shapes[0][1:], arr.dtype)
@@ -529,7 +570,7 @@ def process_broadcast(arr, root_rank: int = 0, *,
         else:
             dt = np.dtype(dtype_s)
         buf = np.zeros(shape, dt)
-    with inspector.watch(nm), timeline.span(nm, "RING_BROADCAST"):
+    with _host_guard(nm, "RING_BROADCAST", "broadcast", "ring", nbytes):
         return rx.broadcast(nm, buf, root_rank)
 
 

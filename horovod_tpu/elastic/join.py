@@ -65,6 +65,10 @@ def join() -> int:
     returns this process's rank immediately.
     """
     core._require_init()
+    from .. import metrics
+
+    if metrics.on():
+        metrics.JOIN_EVENTS.inc()
     if core.process_size() == 1:
         return core.process_rank()
     from jax.experimental import multihost_utils

@@ -1,0 +1,195 @@
+"""Per-rank metrics plane.
+
+The live counterpart of the timeline/ post-mortem traces: a process-wide
+registry of counters/gauges/histograms (registry.py), the instrument
+inventory every layer reports into (this module), and the pusher that
+ships JSON snapshots to the launcher's rendezvous server for cross-rank
+aggregation (push.py → run/http_server.py ``GET /metrics``).
+
+Metric families (all prefixed ``hvd_``; the launcher injects a ``rank``
+label when it aggregates):
+
+==============================  =========  ==================================
+name                            kind       meaning
+==============================  =========  ==================================
+hvd_eager_collective_calls_total counter   eager dispatches, by ``op``
+hvd_eager_collective_bytes_total counter   per-rank payload bytes, by ``op``
+hvd_eager_collective_seconds    histogram  dispatch wall time, by ``op``
+hvd_negotiation_seconds         histogram  controller negotiate(), by ``op``
+hvd_host_collective_calls_total counter    host-plane ops, by ``op``/``transport``
+hvd_host_collective_bytes_total counter    host-plane bytes, by ``op``/``transport``
+hvd_host_collective_seconds     histogram  host-plane wall time, by ``transport``
+hvd_collectives_traced_total    counter    collectives emitted at trace time
+hvd_collectives_traced_bytes_total counter traced payload bytes, by ``op``
+hvd_step_seconds                histogram  train-step cadence (dispatch-to-
+                                           dispatch interval — honest under
+                                           async dispatch, see training.py)
+hvd_steps_total                 counter    train steps dispatched
+hvd_samples_total               counter    global samples dispatched
+hvd_ring_ops_total              counter    ring-plane transfers, by ``op``
+hvd_ring_bytes_total            counter    ring-plane payload bytes
+hvd_ring_active                 gauge      1 when the peer ring is up
+hvd_inflight_ops                gauge      stall-inspector watchdog entries
+hvd_stalled_ops                 gauge      entries past the warning threshold
+hvd_stall_warnings_total        counter    cumulative stall warnings
+hvd_controller_cycles           gauge      coordinator negotiation cycles
+hvd_controller_cache_hits       gauge      coordinator response-cache hits
+hvd_controller_stall_warnings   gauge      coordinator-side stall warnings
+hvd_join_events_total           counter    elastic host-plane join() calls
+==============================  =========  ==================================
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import (  # noqa: F401
+    BYTES_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    exponential_buckets,
+    registry,
+    render_prometheus,
+)
+
+# -- instrument inventory ----------------------------------------------------
+EAGER_CALLS = registry.counter(
+    "hvd_eager_collective_calls_total",
+    "Eager collective dispatches by op type.", ("op",))
+EAGER_BYTES = registry.counter(
+    "hvd_eager_collective_bytes_total",
+    "Per-rank payload bytes moved by eager collectives.", ("op",))
+EAGER_SECONDS = registry.histogram(
+    "hvd_eager_collective_seconds",
+    "Eager collective dispatch wall time.", ("op",))
+NEGOTIATE_SECONDS = registry.histogram(
+    "hvd_negotiation_seconds",
+    "Controller negotiation (submit+wait) wall time.", ("op",))
+
+HOST_CALLS = registry.counter(
+    "hvd_host_collective_calls_total",
+    "Host-plane collective ops by transport (ring/star/mesh).",
+    ("op", "transport"))
+HOST_BYTES = registry.counter(
+    "hvd_host_collective_bytes_total",
+    "Host-plane collective payload bytes by transport.",
+    ("op", "transport"))
+HOST_SECONDS = registry.histogram(
+    "hvd_host_collective_seconds",
+    "Host-plane collective wall time by transport.", ("transport",))
+
+TRACED_CALLS = registry.counter(
+    "hvd_collectives_traced_total",
+    "Collective HLOs emitted during SPMD tracing (per compile, not per "
+    "step).", ("op",))
+TRACED_BYTES = registry.counter(
+    "hvd_collectives_traced_bytes_total",
+    "Per-rank payload bytes of traced collectives.", ("op",))
+
+STEP_SECONDS = registry.histogram(
+    "hvd_step_seconds",
+    "Train-step cadence: interval between successive step dispatches "
+    "(equals real step time in steady state under async dispatch).")
+STEPS_TOTAL = registry.counter(
+    "hvd_steps_total", "Train steps dispatched.")
+SAMPLES_TOTAL = registry.counter(
+    "hvd_samples_total", "Global samples dispatched into train steps.")
+
+RING_OPS = registry.counter(
+    "hvd_ring_ops_total", "Peer-ring transfers executed.", ("op",))
+RING_BYTES = registry.counter(
+    "hvd_ring_bytes_total", "Peer-ring payload bytes transferred.")
+RING_ACTIVE = registry.gauge(
+    "hvd_ring_active", "1 while the peer-ring data plane is established.")
+
+INFLIGHT_OPS = registry.gauge(
+    "hvd_inflight_ops", "Operations currently in the stall-inspector "
+    "watchdog table (negotiation/dispatch queue depth).")
+STALLED_OPS = registry.gauge(
+    "hvd_stalled_ops", "Watchdog entries past the warning threshold.")
+STALL_WARNINGS = registry.counter(
+    "hvd_stall_warnings_total", "Cumulative stall warnings emitted.")
+
+CONTROLLER_CYCLES = registry.gauge(
+    "hvd_controller_cycles", "Coordinator negotiation cycles completed.")
+CONTROLLER_CACHE_HITS = registry.gauge(
+    "hvd_controller_cache_hits", "Coordinator response-cache hits.")
+CONTROLLER_STALLS = registry.gauge(
+    "hvd_controller_stall_warnings", "Coordinator-side stall warnings.")
+
+JOIN_EVENTS = registry.counter(
+    "hvd_join_events_total", "Elastic host-plane join() barriers entered.")
+
+
+def on() -> bool:
+    """The hot-path gate: one attribute read."""
+    return registry.enabled
+
+
+def payload_bytes(shape, dtype) -> int:
+    """Best-effort byte count of one rank's payload; never raises (the
+    metrics plane must not take down a dispatch over an exotic dtype)."""
+    try:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n * np.dtype(dtype).itemsize
+    except Exception:  # noqa: BLE001
+        try:
+            import ml_dtypes  # bfloat16/fp8 names numpy doesn't know
+
+            n = 1
+            for d in shape:
+                n *= int(d)
+            return n * np.dtype(getattr(ml_dtypes, str(dtype))).itemsize
+        except Exception:  # noqa: BLE001
+            return 0
+
+
+def record_eager(op: str, nbytes: int, negotiate_s: float,
+                 total_s: float) -> None:
+    """One eager collective dispatch (eager._dispatch_guard)."""
+    EAGER_CALLS.labels(op).inc()
+    if nbytes:
+        EAGER_BYTES.labels(op).inc(nbytes)
+    EAGER_SECONDS.labels(op).observe(total_s)
+    NEGOTIATE_SECONDS.labels(op).observe(negotiate_s)
+
+
+def record_host(op: str, transport: str, nbytes: int, seconds: float) -> None:
+    """One host-plane collective (eager.process_* transports)."""
+    HOST_CALLS.labels(op, transport).inc()
+    if nbytes:
+        HOST_BYTES.labels(op, transport).inc(nbytes)
+    HOST_SECONDS.labels(transport).observe(seconds)
+
+
+def record_traced(op: str, tensor) -> None:
+    """A collective primitive emitted during SPMD tracing
+    (ops/collectives.py) — compile-time cost only, never per-step."""
+    if not registry.enabled:
+        return
+    try:
+        TRACED_CALLS.labels(op).inc()
+        nb = payload_bytes(getattr(tensor, "shape", ()),
+                           getattr(tensor, "dtype", "float32"))
+        if nb:
+            TRACED_BYTES.labels(op).inc(nb)
+    except Exception:  # noqa: BLE001 — tracing must never fail on metrics
+        pass
+
+
+def dump_metrics_json(path: str) -> None:
+    """Write the per-rank snapshot (called by timeline shutdown so
+    ``metrics.json`` lands next to ``comm.json``)."""
+    registry.dump(path)
+
+
+from .push import (  # noqa: E402,F401  (import after instruments exist)
+    start_pusher,
+    start_pusher_from_env,
+    stop_pusher,
+)

@@ -1,0 +1,119 @@
+"""Metrics pusher: ship per-rank JSON snapshots to the launcher.
+
+The launcher (tpurun / function-mode ``run()``) owns the rendezvous
+server (run/http_server.py); each worker pushes its registry snapshot to
+the ``metrics`` scope under its process id, and the server's signed
+``GET /metrics`` renders every rank's snapshot as one Prometheus page.
+Pull would need a per-rank listener and a port per worker; push rides the
+HTTP KV store that already exists for bootstrap — the same transport
+choice the reference made for rendezvous (run/http/http_server.py).
+
+Wired up in two places:
+
+* ``core.init()`` calls :func:`start_pusher_from_env` — active when the
+  launcher set ``HVD_METRICS_KV_ADDR``/``PORT``/``HVD_METRICS_SECRET``;
+  the interval comes from ``HVD_METRICS_PUSH_SECONDS`` (default 5).
+* ``run/task_fn.py`` pushes a final snapshot after the worker function
+  returns, so short function-mode jobs are captured even if no interval
+  ever elapsed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_pusher: Optional["MetricsPusher"] = None
+_lock = threading.Lock()
+
+
+def push_snapshot(addr: str, port: int, rank: int,
+                  secret: Optional[bytes] = None) -> bool:
+    """One snapshot PUT to the launcher KV store; returns success.
+    Never raises — losing a metrics sample must not fail the job."""
+    from .registry import registry
+
+    try:
+        from ..run.http_client import put_kv
+
+        payload = json.dumps(registry.snapshot()).encode()
+        put_kv(addr, port, "metrics", str(rank), payload, secret=secret)
+        return True
+    except Exception as e:  # noqa: BLE001
+        log.debug("metrics push failed: %s", e)
+        return False
+
+
+class MetricsPusher(threading.Thread):
+    def __init__(self, addr: str, port: int, rank: int,
+                 secret: Optional[bytes], interval: float) -> None:
+        super().__init__(daemon=True, name="hvd-metrics-pusher")
+        self.addr = addr
+        self.port = port
+        self.rank = rank
+        self.secret = secret
+        self.interval = max(float(interval), 0.5)
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval):
+            push_snapshot(self.addr, self.port, self.rank, self.secret)
+
+    def stop(self, final_push: bool = True) -> None:
+        self._stop.set()
+        if final_push:
+            push_snapshot(self.addr, self.port, self.rank, self.secret)
+
+
+_atexit_registered = False
+
+
+def start_pusher(addr: str, port: int, rank: int,
+                 secret: Optional[bytes] = None,
+                 interval: float = 5.0) -> MetricsPusher:
+    """Start (or replace) the process-wide pusher thread.  Registers an
+    atexit flush: a worker that exits without hvd.shutdown() — or before
+    the first interval elapses — must still land its final snapshot on
+    the launcher."""
+    global _pusher, _atexit_registered
+    with _lock:
+        if _pusher is not None:
+            _pusher.stop(final_push=False)
+        _pusher = MetricsPusher(addr, port, rank, secret, interval)
+        _pusher.start()
+        if not _atexit_registered:
+            import atexit
+
+            atexit.register(stop_pusher)
+            _atexit_registered = True
+        return _pusher
+
+
+def start_pusher_from_env(rank: int) -> Optional[MetricsPusher]:
+    """Launcher-driven activation (no-op unless tpurun/run() set the
+    ``HVD_METRICS_KV_*`` vars and the registry is enabled)."""
+    from .registry import registry
+
+    addr = env_util.get_str(env_util.HVD_METRICS_KV_ADDR)
+    port = env_util.get_int(env_util.HVD_METRICS_KV_PORT, 0)
+    if not addr or not port or not registry.enabled:
+        return None
+    secret_hex = env_util.get_str(env_util.HVD_METRICS_SECRET)
+    secret = bytes.fromhex(secret_hex) if secret_hex else None
+    interval = env_util.get_float(env_util.HVD_METRICS_PUSH_SECONDS, 5.0)
+    return start_pusher(addr, port, rank, secret, interval)
+
+
+def stop_pusher() -> None:
+    """Stop the pusher, flushing one final snapshot (core.shutdown)."""
+    global _pusher
+    with _lock:
+        if _pusher is not None:
+            _pusher.stop(final_push=True)
+            _pusher = None

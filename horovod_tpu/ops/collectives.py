@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import core
+from .. import metrics as _metrics
 from ..core import Average, Sum, Adasum, Min, Max
 from .compression import Compression
 
@@ -143,6 +144,9 @@ def allreduce(
     """
     axes = _axes()
     groups, group_size = _group_args(process_set)
+    # Executes once per compile (tracing), not per step: the traced-
+    # collective inventory a scrape can compare against the step cadence.
+    _metrics.record_traced("allreduce", tensor)
 
     if op == Adasum:
         from .adasum import adasum_allreduce
@@ -243,6 +247,7 @@ def allgather(tensor, *, name: Optional[str] = None,
     :func:`allgatherv`.
     """
     axes = _axes()
+    _metrics.record_traced("allgather", tensor)
     if len(axes) != 1:
         return lax.all_gather(tensor, axes, axis=0, tiled=True)
     if process_set is None:
@@ -313,6 +318,7 @@ def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
     payload once.
     """
     axes = _axes()
+    _metrics.record_traced("broadcast", tensor)
     groups, _ = _group_args(process_set)
     r = core.rank()
     masked = jnp.where(r == root_rank, tensor, jnp.zeros_like(tensor))
@@ -333,6 +339,7 @@ def alltoall(tensor, *, process_set: Optional[ProcessSet] = None):
     MoE expert dispatch are built on it.)
     """
     axes = _axes()
+    _metrics.record_traced("alltoall", tensor)
     if len(axes) != 1:
         raise NotImplementedError("alltoall over hierarchical mesh")
     n = core.size() if process_set is None else process_set.size()
@@ -381,6 +388,7 @@ def reducescatter(tensor, *, op: str = Sum,
     nccl_operations.cc:241-246 uses ncclReduceScatter for exactly this).
     """
     axes = _axes()
+    _metrics.record_traced("reducescatter", tensor)
     if len(axes) != 1:
         raise NotImplementedError("reducescatter over hierarchical mesh")
     if process_set is None:

@@ -43,6 +43,13 @@ def _vma_state(x, axis) -> str:
     ``axis`` (shard_map check_vma=True), 'off' when the checker is
     demonstrably disabled, 'unknown' when this JAX can't tell (no false
     alarms in that case)."""
+    from ..utils import jax_compat
+
+    if getattr(lax, "pvary", None) is jax_compat._compat_pvary:
+        # the compat identity shim means NO VMA machinery exists on this
+        # JAX: the backward psum→pbroadcast rewrite cannot happen
+        # (measured: gradients scale by the stage count) — warn loudly
+        return "off"
     if not hasattr(jax, "typeof"):
         return "unknown"
     try:

@@ -52,3 +52,13 @@ def delete_scope(addr: str, port: int, scope: str,
                  secret: Optional[bytes] = None) -> None:
     with _request("DELETE", addr, port, f"/{scope}", secret=secret):
         pass
+
+
+def get_metrics(addr: str, port: int, secret: Optional[bytes] = None,
+                json_form: bool = False) -> str:
+    """Scrape the launcher's aggregated metrics: Prometheus text from
+    ``GET /metrics`` (or the merged JSON snapshots from
+    ``GET /metrics.json``), signed like every other rendezvous request."""
+    path = "/metrics.json" if json_form else "/metrics"
+    with _request("GET", addr, port, path, secret=secret) as resp:
+        return resp.read().decode()

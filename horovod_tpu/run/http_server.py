@@ -13,12 +13,19 @@ takes over, and ``tpurun``'s function-mode ships pickled fns/results
 through it.  Requests carry an HMAC signature derived from the job secret
 (reference run/common/util/secret.py:26-30) — unauthenticated requests are
 rejected.
+
+It is also the job's metrics aggregation point: workers push JSON
+registry snapshots into the ``metrics`` scope (horovod_tpu/metrics/
+push.py), and a signed ``GET /metrics`` renders every rank's snapshot —
+plus the launcher's own registry — as one Prometheus text page
+(``GET /metrics.json`` serves the raw merged snapshots).
 """
 
 from __future__ import annotations
 
 import hashlib
 import hmac
+import json
 import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -29,6 +36,9 @@ from ..utils.logging import get_logger
 log = get_logger(__name__)
 
 SECRET_HEADER = "X-Hvd-Signature"
+
+METRICS_SCOPE = "metrics"
+_METRICS_PREFIX = f"/{METRICS_SCOPE}/"
 
 
 def sign(secret: bytes, path: str, body: bytes = b"") -> str:
@@ -50,16 +60,55 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         want = sign(secret, self.path, body)
         return hmac.compare_digest(got, want)
 
-    def _reply(self, code: int, body: bytes = b"") -> None:
+    def _reply(self, code: int, body: bytes = b"",
+               content_type: Optional[str] = None) -> None:
         self.send_response(code)
+        if content_type:
+            self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if body:
             self.wfile.write(body)
 
+    def _rank_snapshots(self):
+        """(extra_labels, snapshot) per pushed rank, rank-ordered, plus
+        the launcher's own in-process registry last."""
+        from ..metrics.registry import registry
+
+        store: Dict[str, bytes] = self.server.store  # type: ignore
+        with self.server.lock:  # type: ignore
+            pushed = {k[len(_METRICS_PREFIX):]: v for k, v in store.items()
+                      if k.startswith(_METRICS_PREFIX)}
+        snaps = []
+        for rank in sorted(pushed, key=lambda r: (not r.isdigit(), int(r)
+                                                  if r.isdigit() else 0, r)):
+            try:
+                snaps.append(({"rank": rank}, json.loads(pushed[rank])))
+            except (ValueError, TypeError):
+                log.warning("metrics: undecodable snapshot from rank %s",
+                            rank)
+        snaps.append(({"rank": "launcher"}, registry.snapshot()))
+        return snaps
+
     def do_GET(self) -> None:  # noqa: N802
         if not self._verify():
             self._reply(401)
+            return
+        path = self.path.rstrip("/")
+        # Aggregated metrics routes.  No key collision with the KV store:
+        # stored keys are always two-part /scope/key paths.
+        if path == "/metrics":
+            from ..metrics.registry import render_prometheus
+
+            body = render_prometheus(self._rank_snapshots()).encode()
+            self._reply(200, body,
+                        content_type="text/plain; version=0.0.4")
+            return
+        if path == "/metrics.json":
+            merged = {labels["rank"]: snap
+                      for labels, snap in self._rank_snapshots()}
+            self._reply(200, json.dumps(merged).encode(),
+                        content_type="application/json")
             return
         store: Dict[str, bytes] = self.server.store  # type: ignore
         with self.server.lock:  # type: ignore

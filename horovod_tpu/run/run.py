@@ -287,6 +287,55 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                    key=[s.hostname for s in slots].index)
     coordinator = f"{socket.gethostname()}:{env_util.get_int('HVD_COORD_PORT', 0) or _free_port()}"
 
+    # Metrics aggregation point: the launcher hosts a rendezvous server
+    # that ranks push registry snapshots to; GET /metrics (signed) on it
+    # serves the whole job's Prometheus page (docs/metrics.md).
+    metrics_server = None
+    metrics_on = env_util.parse_bool(
+        env.get(env_util.HVD_METRICS, os.environ.get(env_util.HVD_METRICS)),
+        True,
+    )
+    # An operator-provided HVD_METRICS_KV_ADDR means an external
+    # aggregation server: forward the operator's values untouched.
+    external_sink = env.get(
+        env_util.HVD_METRICS_KV_ADDR,
+        os.environ.get(env_util.HVD_METRICS_KV_ADDR),
+    )
+    if not getattr(args, "dry_run", False) and metrics_on \
+            and not external_sink:
+        # operator-provided secret (hex) wins so their tooling can sign
+        # scrapes; otherwise generate one and LOG it — a secret nobody
+        # knows makes the advertised endpoint unusable
+        secret_hex = env.get(env_util.HVD_METRICS_SECRET,
+                             os.environ.get(env_util.HVD_METRICS_SECRET))
+        try:
+            metrics_secret = bytes.fromhex(secret_hex) if secret_hex \
+                else _secrets.token_bytes(16)
+        except ValueError:
+            raise ValueError(
+                f"{env_util.HVD_METRICS_SECRET} must be hex, got "
+                f"{secret_hex!r}"
+            )
+        metrics_server = RendezvousServer(secret=metrics_secret)
+        metrics_port = metrics_server.start()
+        metrics_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
+            else socket.gethostname()
+        env = dict(env)
+        env[env_util.HVD_METRICS_KV_ADDR] = metrics_host
+        env[env_util.HVD_METRICS_KV_PORT] = str(metrics_port)
+        env[env_util.HVD_METRICS_SECRET] = metrics_secret.hex()
+        # never echo an operator-provided credential into job logs; a
+        # generated one must be printed or the endpoint is unusable
+        secret_expr = "bytes.fromhex(os.environ['HVD_METRICS_SECRET'])" \
+            if secret_hex else f"bytes.fromhex('{metrics_secret.hex()}')"
+        log.info(
+            "metrics: signed GET http://%s:%d/metrics aggregates all "
+            "ranks — e.g. horovod_tpu.run.http_client.get_metrics("
+            "'%s', %d, secret=%s)",
+            metrics_host, metrics_port, metrics_host, metrics_port,
+            secret_expr,
+        )
+
     controller = getattr(args, "controller", "auto") or "auto"
     if controller == "auto":
         controller = "native" if len(hosts) > 1 else "xla"
@@ -376,6 +425,8 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                 ctrl_server.stall_warnings,
             )
             ctrl_server.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
 
 
 def _pump_output(proc: subprocess.Popen, pid: int,
@@ -496,6 +547,12 @@ def run(fn, args=(), kwargs=None, np: int = 1,
         extra_env[env_util.HVD_CONTROLLER] = "native"
         extra_env["HVD_CONTROLLER_ADDR"] = f"127.0.0.1:{ctrl_server.port}"
         extra_env["HVD_CONTROLLER_SERVER"] = "external"
+    # Live metrics: point workers' pushers at this server, so a scrape of
+    # GET /metrics here aggregates every rank while fn runs (the final
+    # snapshot is pushed by task_fn regardless).
+    extra_env.setdefault(env_util.HVD_METRICS_KV_ADDR, "127.0.0.1")
+    extra_env.setdefault(env_util.HVD_METRICS_KV_PORT, str(port))
+    extra_env.setdefault(env_util.HVD_METRICS_SECRET, secret.hex())
     # cloudpickle so lambdas/closures ship (reference run/common/util/codec.py
     # uses base64-cloudpickle for the same purpose)
     server.put("job", "fn", cloudpickle.dumps((fn, args, kwargs)))

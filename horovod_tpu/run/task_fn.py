@@ -28,6 +28,14 @@ def main() -> int:
     except Exception:  # noqa: BLE001
         payload = {"value": None, "error": traceback.format_exc()}
         rc = 1
+    # final metrics snapshot: short function-mode jobs end before any
+    # push interval elapses, so the worker flushes its registry here and
+    # the parent's GET /metrics sees every rank
+    from ..metrics.push import push_snapshot
+    from ..metrics.registry import registry
+
+    if registry.enabled:
+        push_snapshot(addr, port, int(pid), secret)
     put_kv(addr, port, "result", pid, pickle.dumps(payload), secret=secret)
     return rc
 

@@ -61,6 +61,40 @@ class ControllerServer:
         )
         if not self._h:
             raise RuntimeError("failed to start controller server")
+        # Coordinator counters ride the metrics plane as polled gauges —
+        # the scrape-time analog of the reference's rank-0-only stats
+        # (controller.cc:164-193), now visible wherever the server lives.
+        # _handle_lock orders collect() against stop(): a scrape-thread
+        # collector passing an unguarded handle check while stop() frees
+        # the native object would call into freed memory.  The collector
+        # holds only a WEAK reference (a strong closure would pin the
+        # server forever in the global registry and disable the __del__
+        # safety net), and its key is per-instance so two servers in one
+        # process never clobber each other's registration.
+        import threading
+        import weakref
+
+        self._handle_lock = threading.Lock()
+        self._collector_key = f"controller_server:{id(self)}"
+        from ..metrics import (
+            CONTROLLER_CACHE_HITS, CONTROLLER_CYCLES, CONTROLLER_STALLS,
+            registry,
+        )
+
+        ref = weakref.ref(self)
+
+        def collect() -> None:
+            srv = ref()
+            if srv is None:
+                return
+            with srv._handle_lock:
+                if not srv._h:
+                    return
+                CONTROLLER_CYCLES.set(srv.cycles)
+                CONTROLLER_CACHE_HITS.set(srv.cache_hits)
+                CONTROLLER_STALLS.set(srv.stall_warnings)
+
+        registry.register_collector(self._collector_key, collect)
 
     @property
     def port(self) -> int:
@@ -80,8 +114,12 @@ class ControllerServer:
 
     def stop(self) -> None:
         if self._h:
-            self._lib.hvd_server_stop(self._h)
-            self._h = None
+            from ..metrics import registry
+
+            registry.unregister_collector(self._collector_key)
+            with self._handle_lock:
+                self._lib.hvd_server_stop(self._h)
+                self._h = None
 
     def __del__(self):
         try:

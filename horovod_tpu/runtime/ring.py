@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import metrics as _metrics
 from ..utils import env as env_util
 from ..utils.logging import get_logger
 from . import native
@@ -203,6 +204,7 @@ class RingExecutor:
         wedged mid-op we deliberately leak the native object instead."""
         self._stopping = True
         self._thread.join(timeout=10)
+        _metrics.RING_ACTIVE.set(0)
         if not self._thread.is_alive():
             self._ring.close()
         else:
@@ -341,6 +343,9 @@ class RingExecutor:
                     )
             flat = np.concatenate([a.ravel() for a, _, _ in parts])
             out = self._ring.allreduce(flat, op=op)
+            if _metrics.on():
+                _metrics.RING_OPS.labels(op).inc()
+                _metrics.RING_BYTES.inc(flat.nbytes)
             off = 0
             for (arr, shape, _), fut in zip(parts, futs):
                 n = arr.size
@@ -399,6 +404,9 @@ class RingExecutor:
                 out = self._ring.allgather(arr)
             else:
                 out = self._ring.allreduce(arr, op=op)
+            if _metrics.on():
+                _metrics.RING_OPS.labels(op).inc()
+                _metrics.RING_BYTES.inc(arr.nbytes)
             if fut is not None:
                 fut.set_result(out)
         except BaseException as e:  # noqa: BLE001
@@ -512,7 +520,9 @@ def establish(client, rank: int, nranks: int, *,
             ring.close()
         log.warning("ring plane disabled: ranks not all connected; "
                     "host collectives stay on the coordinator star")
+        _metrics.RING_ACTIVE.set(0)
         return None
+    _metrics.RING_ACTIVE.set(1)
     return RingExecutor(client, ring)
 
 

@@ -110,6 +110,28 @@ class StallInspector:
 
         return ctx()
 
+    def register_metrics(self) -> None:
+        """Publish this inspector's state to the metrics plane: a queue-
+        depth gauge (in-flight watchdog entries), a stalled-ops gauge,
+        and the cumulative warning counter.  The gauges are collector-
+        driven (polled at scrape/snapshot time), so the begin/end hot
+        path stays untouched.  Keyed registration means re-calling (or a
+        fresh singleton across hvd.init cycles) replaces, not leaks."""
+        from ..metrics import INFLIGHT_OPS, STALLED_OPS, registry
+
+        def collect() -> None:
+            now = time.monotonic()
+            with self._lock:
+                inflight = len(self._entries)
+                stalled = sum(
+                    1 for e in self._entries.values()
+                    if now - e.start > self.warning_seconds
+                )
+            INFLIGHT_OPS.set(inflight)
+            STALLED_OPS.set(stalled)
+
+        registry.register_collector("stall_inspector", collect)
+
     # ------------------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.wait(self.check_interval):
@@ -130,6 +152,10 @@ class StallInspector:
                     stalled.append((e.name, waited))
         for name, waited in stalled:
             self.warnings.append((name, waited))
+            from ..metrics import STALL_WARNINGS, registry
+
+            if registry.enabled:
+                STALL_WARNINGS.inc()
             log.warning(
                 "One or more operations were submitted but have not "
                 "completed for %.0f seconds: [%s]. Possible causes: a hung "
@@ -150,3 +176,4 @@ class StallInspector:
 
 #: process-wide inspector used by the eager plane
 inspector = StallInspector()
+inspector.register_metrics()

@@ -37,6 +37,14 @@ _COLLECTIVES = (
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
     "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    # fp8 families (quantized-allreduce paths emit these) and c128: a
+    # missing entry silently counts the collective as 0 bytes, so the
+    # traffic report under-models exactly the payloads compression is
+    # supposed to shrink
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e3m4": 1,
+    "f8e4m3fnuz": 1, "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1, "f8e8m0fnu": 1,
+    "s4": 1, "u4": 1,  # int4 is byte-padded on the wire
+    "c128": 16,
 }
 
 # instruction result: one or more "dtype[d0,d1]{layout}" entries

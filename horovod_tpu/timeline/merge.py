@@ -1,0 +1,179 @@
+"""Cross-rank trace merge + straggler analysis.
+
+The fork's per-rank layout (``<dir>/<rank>/comm.json``, reference
+timeline.cc:205-228) deliberately gives every rank its own file — good
+for capture, bad for analysis: N disconnected traces can't answer the
+dPRO-style question "which rank is late?".  This module fuses them:
+
+* :func:`merge_traces` — one Chrome trace for the whole job, with each
+  event's ``pid`` forced to its rank and ``process_name`` metadata so
+  chrome://tracing / Perfetto shows one row group per rank;
+* :func:`straggler_report` — per-tensor negotiation-wait spread across
+  ranks.  A NEGOTIATE span measures how long a rank waited for the rest
+  of the job to reach the same collective (reference timeline.cc
+  NegotiateStart/End, controller.cc response assembly): the LAST rank to
+  arrive waits the least, so per tensor the rank with the minimum wait
+  is the straggler and ``spread = max - min`` is the time it cost the
+  others.
+
+``scripts/hvd_trace_merge.py`` is the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+NEGOTIATE_PREFIX = "NEGOTIATE_"
+
+
+def load_rank_events(path: str) -> List[dict]:
+    """Parse one comm.json leniently: a live (unfinalized) file has no
+    closing bracket and may end mid-stream (same contract as
+    scripts/trace_summary.py)."""
+    with open(path) as f:
+        txt = f.read().strip()
+    if txt.endswith(","):
+        txt = txt[:-1]
+    if not txt.endswith("]"):
+        txt += "]"
+    return json.loads(txt)
+
+
+def discover_ranks(trace_dir: str) -> Dict[int, str]:
+    """rank -> comm.json path for every per-rank subdir that has one."""
+    out: Dict[int, str] = {}
+    for entry in os.listdir(trace_dir):
+        if not entry.isdigit():
+            continue
+        p = os.path.join(trace_dir, entry, "comm.json")
+        if os.path.isfile(p):
+            out[int(entry)] = p
+    if not out:
+        raise FileNotFoundError(
+            f"no <rank>/comm.json under {trace_dir}"
+        )
+    return dict(sorted(out.items()))
+
+
+def merge_traces(trace_dir: str) -> dict:
+    """All ranks' events as ONE Chrome trace (object form, so viewers
+    accept it even though per-rank files use the array form): every
+    event's ``pid`` is its rank — regardless of what the recording
+    process wrote — plus ``process_name``/``process_sort_index``
+    metadata per rank."""
+    events: List[dict] = []
+    for rank, path in discover_ranks(trace_dir).items():
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "args": {"sort_index": rank}})
+        for ev in load_rank_events(path):
+            ev = dict(ev)
+            ev["pid"] = rank
+            events.append(ev)
+    return {"traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"source": "hvd_trace_merge",
+                          "trace_dir": os.path.abspath(trace_dir)}}
+
+
+def write_merged(trace_dir: str, out_path: str) -> dict:
+    merged = merge_traces(trace_dir)
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# straggler analysis
+# ---------------------------------------------------------------------------
+def negotiation_waits(events: List[dict]) -> Dict[str, Dict[str, float]]:
+    """tensor -> {op, wait_us} from one rank's events: the duration of
+    each NEGOTIATE_<OP> B/E pair (first pair per tensor wins; repeated
+    negotiations of the same name accumulate)."""
+    waits: Dict[str, Dict[str, float]] = {}
+    open_spans: Dict[tuple, float] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if not name.startswith(NEGOTIATE_PREFIX):
+            continue
+        tensor = ev.get("cat") or ev.get("tid") or ""
+        key = (name, tensor)
+        ph = ev.get("ph")
+        if ph == "B":
+            open_spans[key] = float(ev.get("ts", 0.0))
+        elif ph == "E" and key in open_spans:
+            dur = float(ev.get("ts", 0.0)) - open_spans.pop(key)
+            d = waits.setdefault(
+                tensor, {"op": name[len(NEGOTIATE_PREFIX):], "wait_us": 0.0}
+            )
+            d["wait_us"] += dur
+        elif ph == "X":
+            d = waits.setdefault(
+                tensor, {"op": name[len(NEGOTIATE_PREFIX):], "wait_us": 0.0}
+            )
+            d["wait_us"] += float(ev.get("dur", 0.0))
+    return waits
+
+
+def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
+    """Per-tensor negotiation-wait spread across ranks.
+
+    For each tensor negotiated on >= 2 ranks:
+
+    * ``per_rank_wait_us`` — each rank's cumulative negotiation wait;
+    * ``spread_us`` — max - min across ranks: the time the tensor's
+      slowest arrival cost the fastest;
+    * ``straggler_rank`` — the rank with the MINIMUM wait (it arrived
+      last, so everyone else waited on it);
+    * ``max_wait_rank`` — the rank that waited longest (arrived first).
+
+    ``ranks`` summarizes per-rank blame: how many tensors each rank
+    stragglered, and its total negotiation wait (a chronically low
+    total = chronically late rank).
+    """
+    per_rank = {rank: negotiation_waits(load_rank_events(path))
+                for rank, path in discover_ranks(trace_dir).items()}
+    tensors: Dict[str, dict] = {}
+    for rank, waits in per_rank.items():
+        for tensor, d in waits.items():
+            t = tensors.setdefault(tensor, {"op": d["op"], "waits": {}})
+            t["waits"][rank] = d["wait_us"]
+    rows = []
+    straggled = {r: 0 for r in per_rank}
+    for tensor, t in tensors.items():
+        waits = t["waits"]
+        if len(waits) < 2:
+            continue
+        mx = max(waits, key=waits.get)
+        mn = min(waits, key=waits.get)
+        spread = waits[mx] - waits[mn]
+        straggled[mn] += 1
+        rows.append({
+            "tensor": tensor,
+            "op": t["op"],
+            "per_rank_wait_us": {str(r): round(w, 1)
+                                 for r, w in sorted(waits.items())},
+            "spread_us": round(spread, 1),
+            "straggler_rank": mn,
+            "max_wait_rank": mx,
+        })
+    rows.sort(key=lambda r: -r["spread_us"])
+    if top:
+        rows = rows[:top]
+    return {
+        "tensors": rows,
+        "ranks": {
+            str(r): {
+                "times_straggler": straggled[r],
+                "total_negotiate_wait_us": round(
+                    sum(d["wait_us"] for d in per_rank[r].values()), 1),
+            }
+            for r in per_rank
+        },
+    }

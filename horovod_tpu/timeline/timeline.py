@@ -129,6 +129,7 @@ class Timeline:
 
     def __init__(self) -> None:
         self._writer: Optional[_Writer] = None
+        self._dir: Optional[str] = None
         self._lock = threading.Lock()
         self._step = 0
         self._stepper: Optional[str] = None
@@ -150,6 +151,7 @@ class Timeline:
         with self._lock:
             if self._writer is None:
                 self._writer = _make_writer(path)
+                self._dir = os.path.dirname(path)
                 # fresh trace file = fresh step window: an init() after a
                 # previous run's auto-close must not inherit its counter
                 # (else the new trace instantly re-closes empty)
@@ -175,6 +177,20 @@ class Timeline:
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None
+                # the live half's post-mortem artifact: a numeric snapshot
+                # next to comm.json, so one trace dir carries both the
+                # spans and the counters they aggregate into
+                if self._dir is not None:
+                    try:
+                        from ..metrics import dump_metrics_json, registry
+
+                        if registry.enabled:
+                            dump_metrics_json(
+                                os.path.join(self._dir, "metrics.json")
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        log.debug("metrics.json dump failed: %s", e)
+                    self._dir = None
 
     @property
     def active(self) -> bool:

@@ -166,7 +166,31 @@ def make_train_step(
     else:
         box["fn"] = _build(threshold_bytes, hierarchical)
 
+    from . import metrics
     from .timeline.timeline import timeline
+
+    import time as _time
+
+    # Step-cadence metrics: blocking on the result every step would
+    # serialize the async dispatch pipeline (the very thing the compiled
+    # plane buys), so the histogram records the interval between
+    # successive dispatches — in steady state the host is throttled by
+    # the device queue, making dispatch-to-dispatch time the real step
+    # time without a single synchronization.
+    last_dispatch = [0.0]
+
+    def _record_step_metrics(x):
+        now = _time.perf_counter()
+        if last_dispatch[0]:
+            metrics.STEP_SECONDS.observe(now - last_dispatch[0])
+        last_dispatch[0] = now
+        metrics.STEPS_TOTAL.inc(max(in_graph_steps, 1))
+        try:
+            metrics.SAMPLES_TOTAL.inc(
+                int(x.shape[0]) * max(in_graph_steps, 1)
+            )
+        except (AttributeError, IndexError, TypeError):
+            pass  # batch without a leading dim: samples stay uncounted
 
     def _invoke(state, x, y):
         # Host-side step record: advances the trace window (reference
@@ -180,6 +204,8 @@ def make_train_step(
             isinstance(leaf, jax.core.Tracer)
             for leaf in jax.tree_util.tree_leaves((state, x, y))
         )
+        if not under_trace and metrics.on():
+            _record_step_metrics(x)
         if timeline.active and not under_trace:
             timeline.record_step(owner="train_step")
             timeline.mark_cycle_start()
@@ -189,8 +215,6 @@ def make_train_step(
 
     if pm is None:
         return _invoke
-
-    import time as _time
 
     def step_autotuned(state, x, y):
         if pm.frozen:

@@ -57,6 +57,32 @@ HVD_NUM_PROCESSES = "HVD_NUM_PROCESSES"
 HVD_PROCESS_ID = "HVD_PROCESS_ID"
 HVD_CONTROLLER = "HVD_CONTROLLER"
 HVD_CPU_OPERATIONS = "HVD_CPU_OPERATIONS"
+# native controller wiring (set by the launcher; runtime/eager_controller.py)
+HVD_CONTROLLER_ADDR = "HVD_CONTROLLER_ADDR"            # host:port of the coordinator
+HVD_CONTROLLER_SERVER = "HVD_CONTROLLER_SERVER"        # "external" = launcher hosts it
+HVD_COORD_PORT = "HVD_COORD_PORT"                      # jax.distributed coordinator port
+# peer-ring data plane (runtime/ring.py)
+HVD_RING = "HVD_RING"                                  # 0 disables the ring (debug aid)
+HVD_RING_CHUNK_BYTES = "HVD_RING_CHUNK_BYTES"          # ring pipeline chunk size
+HVD_RING_HOST = "HVD_RING_HOST"                        # launcher-known address peers dial
+# function-mode plumbing (run/run.py run() ↔ run/task_fn.py)
+HVD_RUN_KV_ADDR = "HVD_RUN_KV_ADDR"
+HVD_RUN_KV_PORT = "HVD_RUN_KV_PORT"
+HVD_RUN_SECRET = "HVD_RUN_SECRET"
+HVD_RUN_PID = "HVD_RUN_PID"
+HVD_RUN_NP = "HVD_RUN_NP"
+# TPU pod host discovery (run/discovery.py)
+HVD_TPU_HOSTS = "HVD_TPU_HOSTS"
+HVD_TPU_SLOTS = "HVD_TPU_SLOTS"
+# force the pure-Python fallbacks over the native csrc paths
+HVD_TIMELINE_PYTHON = "HVD_TIMELINE_PYTHON"
+HVD_AUTOTUNE_PYTHON = "HVD_AUTOTUNE_PYTHON"
+# metrics plane (horovod_tpu/metrics/)
+HVD_METRICS = "HVD_METRICS"                            # 0 disables the registry
+HVD_METRICS_KV_ADDR = "HVD_METRICS_KV_ADDR"            # launcher rendezvous host
+HVD_METRICS_KV_PORT = "HVD_METRICS_KV_PORT"            # launcher rendezvous port
+HVD_METRICS_SECRET = "HVD_METRICS_SECRET"              # hex HMAC secret for pushes
+HVD_METRICS_PUSH_SECONDS = "HVD_METRICS_PUSH_SECONDS"  # push interval (default 5)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -84,11 +110,17 @@ def get_float(name: str, default: float) -> float:
         return default
 
 
-def get_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name)
-    if v is None or v == "":
+def parse_bool(value: Optional[str], default: bool = False) -> bool:
+    """The one truthiness rule for HVD_* flags — shared by the runtime
+    (get_bool) and the launcher (which parses worker-bound env dicts),
+    so both sides always agree on whether a knob is on."""
+    if value is None or value == "":
         return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    return parse_bool(os.environ.get(name), default)
 
 
 def get_str(name: str, default: Optional[str] = None) -> Optional[str]:

@@ -1,0 +1,61 @@
+"""Compatibility shims for the range of JAX versions the container may
+carry.
+
+The codebase (and its tests) target the public ``jax.shard_map`` API
+with the ``check_vma`` spelling.  Older releases (<= 0.4.x, the pinned
+container toolchain) only ship ``jax.experimental.shard_map.shard_map``
+with the ``check_rep`` spelling — same semantics, renamed when the API
+was promoted.  :func:`install` publishes a translating wrapper as
+``jax.shard_map`` when the public name is absent, so one spelling works
+everywhere.  On a JAX that already has the public API this is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _compat_shard_map(f=None, *, mesh, in_specs, out_specs,
+                      check_vma: bool = True, **kw):
+    """``jax.shard_map`` signature over the experimental implementation:
+    usable bare or as a decorator factory, translating ``check_vma`` to
+    the pre-promotion ``check_rep`` keyword."""
+    if f is None:
+        return functools.partial(
+            _compat_shard_map, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=check_vma, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as esm
+
+    try:
+        return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma, **kw)
+    except TypeError:  # a vintage without check_rep either
+        return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+
+
+def _compat_axis_size(axis_name):
+    """``lax.axis_size`` for releases that predate it: ``psum(1, axis)``
+    is the long-standing idiom and constant-folds to the static size."""
+    return jax.lax.psum(1, axis_name)
+
+
+def _compat_pvary(x, axis_name):
+    """``lax.pvary`` predecessor: on pre-VMA releases replication typing
+    is tracked by shard_map's check_rep machinery and there is nothing
+    to annotate — the data-level meaning of pvary is identity."""
+    del axis_name
+    return x
+
+
+def install() -> None:
+    """Idempotent: publish missing public-API names onto ``jax``."""
+    if getattr(jax, "shard_map", None) is None:
+        jax.shard_map = _compat_shard_map
+    if getattr(jax.lax, "axis_size", None) is None:
+        jax.lax.axis_size = _compat_axis_size
+    if getattr(jax.lax, "pvary", None) is None:
+        jax.lax.pvary = _compat_pvary

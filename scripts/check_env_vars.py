@@ -1,0 +1,100 @@
+"""Lint: every ``HVD_*`` knob referenced under ``horovod_tpu/`` must be
+declared in ``horovod_tpu/utils/env.py``.
+
+The env system is a three-layer contract (env vars ↔ tpurun flags ↔ YAML;
+see utils/env.py): a knob read via a bare string literal that never made
+it into the inventory is invisible to ``tpurun --help``, the YAML schema,
+and the docs — the reference centralizes its HOROVOD_* inventory in
+common.h:62-87 for the same reason.  This lint makes an undeclared knob a
+tier-1 test failure (tests/test_env_lint.py) instead of a silent drift.
+
+Run::
+
+    python scripts/check_env_vars.py            # exit 1 on undeclared knobs
+    python scripts/check_env_vars.py --list     # dump the declared inventory
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "horovod_tpu")
+ENV_PY = os.path.join(PKG, "utils", "env.py")
+
+_TOKEN = re.compile(r"\bHVD_[A-Z0-9_]+\b")
+_DECL = re.compile(r"^(HVD_[A-Z0-9_]+)\s*=", re.M)
+
+
+def declared_knobs(env_path: str = ENV_PY) -> Set[str]:
+    """Module-level ``HVD_X = ...`` assignments in utils/env.py."""
+    with open(env_path) as f:
+        return set(_DECL.findall(f.read()))
+
+
+def referenced_knobs(pkg_dir: str = PKG) -> Dict[str, List[Tuple[str, int]]]:
+    """Every HVD_* token in the package (string literals AND attribute
+    references — both resolve to the same declared name), mapped to its
+    (file, line) sites.  utils/env.py itself is the inventory, not a
+    reference site."""
+    refs: Dict[str, List[Tuple[str, int]]] = {}
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            if os.path.abspath(path) == os.path.abspath(ENV_PY):
+                continue
+            rel = os.path.relpath(path, REPO)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    for tok in _TOKEN.findall(line):
+                        refs.setdefault(tok, []).append((rel, lineno))
+    return refs
+
+
+def undeclared(pkg_dir: str = PKG,
+               env_path: str = ENV_PY) -> Dict[str, List[Tuple[str, int]]]:
+    decl = declared_knobs(env_path)
+    out = {}
+    for tok, sites in referenced_knobs(pkg_dir).items():
+        if tok in decl:
+            continue
+        # Prose globs ("HVD_METRICS_KV_*") tokenize to an
+        # underscore-terminated prefix of a declared family; ONLY that
+        # shape is allowed — a bare prefix ("HVD_METRICS_KV", a typo'd
+        # env read) must still trip the lint.
+        if tok.endswith("_") and any(d.startswith(tok) for d in decl):
+            continue
+        out[tok] = sites
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--list", action="store_true",
+                   help="print the declared knob inventory and exit")
+    args = p.parse_args(argv)
+    if args.list:
+        for name in sorted(declared_knobs()):
+            print(name)
+        return 0
+    bad = undeclared()
+    if not bad:
+        print(f"check_env_vars: OK — {len(declared_knobs())} knobs "
+              "declared, no undeclared references")
+        return 0
+    for tok in sorted(bad):
+        sites = ", ".join(f"{f}:{ln}" for f, ln in bad[tok][:5])
+        print(f"UNDECLARED {tok}  (referenced at {sites})", file=sys.stderr)
+    print(f"check_env_vars: {len(bad)} HVD_* knob(s) referenced under "
+          f"horovod_tpu/ but not declared in utils/env.py", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,14 @@ import pytest  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long compile-heavy drives excluded from the tier-1 budget "
+        "(run explicitly or without -m 'not slow')",
+    )
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devs = jax.devices("cpu")

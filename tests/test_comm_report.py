@@ -80,6 +80,31 @@ def test_report_finds_gradient_allreduce(hvd_init, rng):
     assert all(a >= b for a, b in zip(effs, effs[1:]))
 
 
+def test_hlo_parser_fp8_and_c128_dtypes():
+    """Regression: fp8 (f8e4m3fn / f8e5m2) and c128 collectives were
+    missing from _DTYPE_BYTES, so quantized-allreduce traffic silently
+    counted as 0 bytes in the report."""
+    txt = """
+  %q = f8e4m3fn[4096,256]{1,0} all-reduce(%x), replica_groups={}
+  %q2 = f8e5m2[1024]{0} all-gather(%y), dimensions={0}
+  %c = c128[32,8]{1,0} all-reduce(%z), replica_groups={}
+"""
+    cols = hlo_collectives(txt)
+    assert cols["all-reduce"]["count"] == 2
+    assert cols["all-reduce"]["bytes"] == 4096 * 256 * 1 + 32 * 8 * 16
+    assert cols["all-gather"] == {"count": 1, "bytes": 1024 * 1}
+
+
+def test_hlo_parser_fp8_async_start():
+    """fp8 payloads must also survive the async -start tuple path (the
+    form the TPU scheduler actually emits)."""
+    txt = """
+  %ars = (f8e4m3fn[8192]{0}, f8e4m3fn[8192]{0}, u32[]) all-reduce-start(%a), ...
+"""
+    cols = hlo_collectives(txt)
+    assert cols["all-reduce"] == {"count": 1, "bytes": 8192}
+
+
 def test_hlo_parser_async_start_forms():
     """Async -start shapes carry the payload twice; -done is skipped;
     multi-operand nested-tuple starts must parse (real-TPU HLO form)."""

@@ -1,0 +1,78 @@
+"""scripts/check_env_vars.py: the HVD_* knob inventory lint, run from
+tier-1 so an undeclared knob fails fast (the env system is a three-layer
+contract — see utils/env.py — and a knob outside the inventory is
+invisible to tpurun/YAML/docs)."""
+
+import importlib.util as _ilu
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_env_vars.py")
+
+
+def _load():
+    spec = _ilu.spec_from_file_location("check_env_vars", SCRIPT)
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_has_no_undeclared_knobs():
+    mod = _load()
+    bad = mod.undeclared()
+    assert not bad, (
+        "HVD_* knobs referenced under horovod_tpu/ but not declared in "
+        f"utils/env.py: {sorted(bad)} — add them to the inventory"
+    )
+
+
+def test_lint_detects_an_undeclared_knob(tmp_path):
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\nx = os.environ.get("HVD_TOTALLY_NEW_KNOB")\n'
+    )
+    env_py = tmp_path / "env.py"
+    env_py.write_text('HVD_DECLARED = "HVD_DECLARED"\n')
+    bad = mod.undeclared(pkg_dir=str(pkg), env_path=str(env_py))
+    assert set(bad) == {"HVD_TOTALLY_NEW_KNOB"}
+    (site,) = bad["HVD_TOTALLY_NEW_KNOB"]
+    assert site[1] == 2  # file:line points at the reference
+
+
+def test_lint_accepts_prose_glob_prefixes(tmp_path):
+    """Comments like 'HVD_METRICS_KV_*' tokenize to a declared-name
+    prefix and must not trip the lint."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("# set the HVD_FOO_* family\n")
+    env_py = tmp_path / "env.py"
+    env_py.write_text('HVD_FOO_BAR = "HVD_FOO_BAR"\n')
+    assert not mod.undeclared(pkg_dir=str(pkg), env_path=str(env_py))
+
+
+def test_lint_rejects_truncated_knob_reads(tmp_path):
+    """A typo'd env read that happens to be a PREFIX of a declared knob
+    ('HVD_FOO' vs declared HVD_FOO_BAR) is exactly the drift the lint
+    exists to catch — only underscore-terminated prose globs pass."""
+    mod = _load()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\nx = os.environ.get("HVD_FOO")\n'
+    )
+    env_py = tmp_path / "env.py"
+    env_py.write_text('HVD_FOO_BAR = "HVD_FOO_BAR"\n')
+    assert set(mod.undeclared(pkg_dir=str(pkg),
+                              env_path=str(env_py))) == {"HVD_FOO"}
+
+
+def test_cli_exit_codes():
+    ok = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                        text=True, timeout=120)
+    assert ok.returncode == 0, ok.stderr
+    assert "OK" in ok.stdout

@@ -67,6 +67,7 @@ def test_bert_benchmark_dp(mesh8):
     assert r["sent_sec_total"] > 0
 
 
+@pytest.mark.slow  # interpreter-mode pallas ring on CPU — tier-1 budget
 def test_bert_benchmark_ring_pallas(mesh8):
     from examples.bert_synthetic_benchmark import parse_args, run
 
@@ -122,6 +123,7 @@ def test_gpt_benchmark_causal_flash(mesh8):
     assert r["seq_sec_per_chip"] > 0
 
 
+@pytest.mark.slow  # sequence-parallel GPT compile on CPU — tier-1 budget
 def test_gpt_benchmark_ring_sp(mesh8):
     from examples.gpt_synthetic_benchmark import parse_args, run
 
@@ -134,6 +136,7 @@ def test_gpt_benchmark_ring_sp(mesh8):
     assert np.isfinite(r["final_loss"])
 
 
+@pytest.mark.slow  # ~60 s BERT compile on CPU — outside the tier-1 budget
 def test_bert_benchmark_adasum(mesh8):
     """BASELINE.json config 4: Adasum allreduce on BERT."""
     from examples.bert_synthetic_benchmark import parse_args, run
@@ -173,6 +176,7 @@ def test_mxnet_mnist_example(mesh8):
     assert np.isfinite(last) and last < first * 1.05
 
 
+@pytest.mark.slow  # ~55 s ResNet-50 compile on CPU — outside the tier-1 budget
 def test_keras_imagenet_resnet50_recipe_with_resume(mesh8, tmp_path):
     """The reference's flagship full-recipe example: warmup+staircase
     LR, rank-0 checkpointing, and resume-from-latest with the epoch

@@ -1,0 +1,396 @@
+"""Metrics plane: registry semantics, hot-path instrumentation, the
+signed ``GET /metrics`` aggregation on the rendezvous server, and the
+per-rank ``metrics.json`` shutdown artifact."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics
+from horovod_tpu.metrics.registry import (
+    MetricsRegistry, exponential_buckets, render_prometheus,
+)
+
+
+# -- registry semantics ------------------------------------------------------
+def test_counter_gauge_basics():
+    r = MetricsRegistry(enabled=True)
+    c = r.counter("c_total", "help", ("op",))
+    c.labels("allreduce").inc()
+    c.labels("allreduce").inc(2.5)
+    c.labels(op="broadcast").inc()
+    assert c.get("allreduce") == pytest.approx(3.5)
+    assert c.get(op="broadcast") == 1
+    g = r.gauge("g")
+    g.set(7)
+    g.dec(3)
+    assert g.get() == 4
+    # idempotent re-registration returns the same family
+    assert r.counter("c_total", "help", ("op",)) is c
+    # conflicting re-registration is an error, not a silent shadow
+    with pytest.raises(ValueError):
+        r.counter("c_total", "help", ("other",))
+    with pytest.raises(ValueError):
+        r.gauge("c_total")
+
+
+def test_exponential_buckets_and_histogram():
+    bs = exponential_buckets(1e-4, 2.0, 4)
+    assert bs == (1e-4, 2e-4, 4e-4, 8e-4)
+    r = MetricsRegistry(enabled=True)
+    h = r.histogram("h_seconds", buckets=bs)
+    for v in (5e-5, 3e-4, 1.0):  # under / mid / over the last bound
+        h.observe(v)
+    snap = r.snapshot()["metrics"]["h_seconds"]
+    (sample,) = snap["samples"]
+    assert sample["count"] == 3
+    assert sample["sum"] == pytest.approx(1.00035)
+    assert sample["buckets"] == [1, 0, 1, 0]  # non-cumulative internal form
+    text = r.to_prometheus()
+    # cumulative exposition + the implicit +Inf bucket
+    assert 'h_seconds_bucket{le="0.0004"} 2' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+
+
+def test_prometheus_exposition_format():
+    r = MetricsRegistry(enabled=True)
+    c = r.counter("x_total", "a help line", ("op",))
+    c.labels('all"re\\duce').inc()
+    text = r.to_prometheus(extra_labels={"rank": "3"})
+    assert "# HELP x_total a help line" in text
+    assert "# TYPE x_total counter" in text
+    # label escaping and the injected rank label
+    assert '{op="all\\"re\\\\duce",rank="3"} 1' in text
+
+
+def test_render_prometheus_merges_ranks_single_type_block():
+    r0, r1 = MetricsRegistry(enabled=True), MetricsRegistry(enabled=True)
+    r0.counter("m_total").inc(1)
+    r1.counter("m_total").inc(5)
+    text = render_prometheus([
+        ({"rank": "0"}, r0.snapshot()), ({"rank": "1"}, r1.snapshot()),
+    ])
+    assert text.count("# TYPE m_total counter") == 1
+    assert 'm_total{rank="0"} 1' in text
+    assert 'm_total{rank="1"} 5' in text
+
+
+def test_registry_thread_safety():
+    r = MetricsRegistry(enabled=True)
+    c = r.counter("t_total")
+    h = r.histogram("t_seconds")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels().get() == 8000
+    assert r.snapshot()["metrics"]["t_seconds"]["samples"][0]["count"] == 8000
+
+
+def test_collector_callbacks_and_dump(tmp_path):
+    r = MetricsRegistry(enabled=True)
+    g = r.gauge("depth")
+    r.register_collector("k", lambda: g.set(42))
+    assert r.snapshot()["metrics"]["depth"]["samples"][0]["value"] == 42
+    r.register_collector("k", lambda: g.set(7))  # keyed: replaces
+    p = tmp_path / "sub" / "metrics.json"
+    r.dump(str(p))
+    data = json.loads(p.read_text())
+    assert data["metrics"]["depth"]["samples"][0]["value"] == 7
+    # a broken collector must not break the scrape
+    r.register_collector("bad", lambda: 1 / 0)
+    r.snapshot()
+
+
+# -- instrumentation ---------------------------------------------------------
+@pytest.fixture()
+def fresh_metrics(monkeypatch):
+    """Isolate counter state without resetting the process-wide instrument
+    objects other modules hold references to."""
+    monkeypatch.setattr(metrics.registry, "enabled", True)
+    return {
+        name: {tuple(s["labels"].items()): s for s in entry["samples"]}
+        for name, entry in
+        metrics.registry.snapshot()["metrics"].items()
+    }
+
+
+def _counter_delta(before, name, **labels):
+    key = tuple(sorted(labels.items()))
+    now = 0.0
+    for entry in metrics.registry.snapshot()["metrics"].get(
+            name, {}).get("samples", []):
+        if tuple(sorted(entry["labels"].items())) == key:
+            now = entry.get("value", entry.get("count", 0.0))
+    prev = 0.0
+    for k, s in before.get(name, {}).items():
+        if tuple(sorted(k)) == key:
+            prev = s.get("value", s.get("count", 0.0))
+    return now - prev
+
+
+def test_eager_dispatch_updates_metrics(hvd_init, fresh_metrics, rng):
+    xs = [rng.normal(size=(16,)).astype(np.float32) for _ in range(8)]
+    hvd.eager_allreduce(xs, name="m.allreduce")
+    hvd.eager_broadcast(xs, name="m.bcast")
+    assert _counter_delta(fresh_metrics,
+                          "hvd_eager_collective_calls_total",
+                          op="allreduce") == 1
+    assert _counter_delta(fresh_metrics,
+                          "hvd_eager_collective_calls_total",
+                          op="broadcast") == 1
+    # per-rank payload: 16 f32 = 64 bytes per dispatch
+    assert _counter_delta(fresh_metrics,
+                          "hvd_eager_collective_bytes_total",
+                          op="allreduce") == 64
+    snap = metrics.registry.snapshot()["metrics"]
+    lat = [s for s in snap["hvd_eager_collective_seconds"]["samples"]
+           if s["labels"] == {"op": "allreduce"}]
+    assert lat and lat[0]["count"] >= 1 and lat[0]["sum"] > 0
+    neg = [s for s in snap["hvd_negotiation_seconds"]["samples"]
+           if s["labels"] == {"op": "allreduce"}]
+    assert neg and neg[0]["count"] >= 1
+
+
+def test_eager_dispatch_disabled_registry_is_silent(hvd_init, monkeypatch,
+                                                    rng):
+    monkeypatch.setattr(metrics.registry, "enabled", False)
+    before = metrics.registry.snapshot()
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(8)]
+    hvd.eager_allreduce(xs, name="m.off")
+    assert metrics.registry.snapshot()["metrics"] \
+        == before["metrics"]
+
+
+def test_traced_collective_counters(hvd_init, fresh_metrics):
+    import jax.numpy as jnp
+
+    @hvd.spmd
+    def step(x):
+        return hvd.allreduce(x, op=hvd.Sum)
+
+    g = hvd.put_per_rank([np.ones((4,), np.float32)] * 8)
+    step(g)
+    step(g)  # cache hit: traced counters must NOT advance per call
+    assert _counter_delta(fresh_metrics, "hvd_collectives_traced_total",
+                          op="allreduce") == 1
+    assert _counter_delta(fresh_metrics,
+                          "hvd_collectives_traced_bytes_total",
+                          op="allreduce") == 16
+
+
+def test_train_step_cadence_metrics(hvd_init, fresh_metrics, rng):
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.mlp import MLP
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model = MLP(features=(8, 4))
+    opt = optax.sgd(0.1)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    step = make_train_step(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=opt, donate=False,
+    )
+    state = init_train_state(model, opt, jnp.zeros((2, 16)))
+    x = shard_batch(rng.normal(size=(16, 16)).astype(np.float32))
+    y = shard_batch(rng.integers(0, 4, size=(16,)).astype(np.int32))
+    for _ in range(3):
+        state, loss = step(state, x, y)
+    assert _counter_delta(fresh_metrics, "hvd_steps_total") == 3
+    assert _counter_delta(fresh_metrics, "hvd_samples_total") == 48
+    snap = metrics.registry.snapshot()["metrics"]
+    # cadence histogram records dispatch-to-dispatch intervals: N-1 of them
+    (s,) = snap["hvd_step_seconds"]["samples"]
+    assert s["count"] >= 2
+
+
+def test_metrics_json_dumped_next_to_comm_json(hvd_init, tmp_path,
+                                               fresh_metrics, rng):
+    from horovod_tpu.timeline.timeline import Timeline
+
+    tl = Timeline()
+    tl.initialize(str(tmp_path))
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(8)]
+    hvd.eager_allreduce(xs, name="m.dump")
+    tl.shutdown()
+    assert (tmp_path / "0" / "comm.json").exists()
+    data = json.loads((tmp_path / "0" / "metrics.json").read_text())
+    ops = {s["labels"]["op"] for s in
+           data["metrics"]["hvd_eager_collective_calls_total"]["samples"]}
+    assert "allreduce" in ops
+
+
+# -- rendezvous-server aggregation -------------------------------------------
+def test_metrics_endpoint_signed_aggregation():
+    from horovod_tpu.run.http_client import get_metrics, put_kv
+    from horovod_tpu.run.http_server import RendezvousServer
+
+    secret = b"metrics-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    try:
+        for rank in (0, 1):
+            r = MetricsRegistry(enabled=True)
+            c = r.counter("hvd_eager_collective_bytes_total", "b", ("op",))
+            c.labels("allreduce").inc(1024 * (rank + 1))
+            h = r.histogram("hvd_step_seconds", "s")
+            h.observe(0.01 * (rank + 1))
+            put_kv("127.0.0.1", port, "metrics", str(rank),
+                   json.dumps(r.snapshot()).encode(), secret=secret)
+        text = get_metrics("127.0.0.1", port, secret=secret)
+        assert 'hvd_eager_collective_bytes_total{op="allreduce",rank="0"}' \
+            " 1024" in text
+        assert 'hvd_eager_collective_bytes_total{op="allreduce",rank="1"}' \
+            " 2048" in text
+        assert text.count("# TYPE hvd_step_seconds histogram") == 1
+        assert 'hvd_step_seconds_bucket{le="+Inf",rank="0"} 1' in text
+        merged = json.loads(
+            get_metrics("127.0.0.1", port, secret=secret, json_form=True)
+        )
+        assert {"0", "1", "launcher"} <= set(merged)
+        # unsigned scrape is rejected like any other route
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get_metrics("127.0.0.1", port, secret=None)
+        assert ei.value.code == 401
+    finally:
+        server.stop()
+
+
+def test_two_launcher_spawned_workers_scrape():
+    """Acceptance: 2 launcher-spawned workers run eager collectives and
+    train steps; GET /metrics on the rendezvous server shows per-op
+    byte/call counters and step-time histogram buckets from BOTH ranks.
+    Rank 0 performs the live scrape (the server is launcher-owned and
+    stops when run() returns) and hands the page back as its result."""
+    import importlib
+
+    tpurun = importlib.import_module("horovod_tpu.run.run")
+
+    # defined inside the test so cloudpickle ships it BY VALUE — workers
+    # cannot import the tests package (reference func-mode contract)
+    def _metrics_worker():
+        import os
+
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        import horovod_tpu as hvd
+        from horovod_tpu.models.mlp import MLP
+        from horovod_tpu.training import (
+            init_train_state, make_train_step, shard_batch,
+        )
+
+        hvd.init()
+        xs = [np.ones(16, np.float32)] * hvd.size()
+        hvd.eager_allreduce(xs, name="w.allreduce")
+
+        model = MLP(features=(8, 4))
+        opt = optax.sgd(0.1)
+        step = make_train_step(
+            apply_fn=lambda v, a, train=True: model.apply(v, a),
+            loss_fn=lambda lg, lb:
+                optax.softmax_cross_entropy_with_integer_labels(
+                    lg, lb).mean(),
+            optimizer=opt, donate=False,
+        )
+        state = init_train_state(model, opt, jnp.zeros((2, 16)))
+        rng = np.random.default_rng(0)
+        # 16 divides any simulated world size the inherited XLA_FLAGS set
+        x = shard_batch(rng.normal(size=(16, 16)).astype(np.float32))
+        y = shard_batch(rng.integers(0, 4, size=(16,)).astype(np.int32))
+        for _ in range(3):
+            state, _ = step(state, x, y)
+
+        pid = int(os.environ["HVD_RUN_PID"])
+        if pid != 0:
+            return (pid, None)
+        # rank 0 scrapes the launcher AFTER pushing its own snapshot, and
+        # waits for rank 1's final push so the page provably carries both
+        import json as _json
+        import time
+
+        from horovod_tpu.metrics.push import push_snapshot
+        from horovod_tpu.run.http_client import get_metrics
+
+        addr = os.environ["HVD_RUN_KV_ADDR"]
+        port = int(os.environ["HVD_RUN_KV_PORT"])
+        secret = bytes.fromhex(os.environ["HVD_RUN_SECRET"])
+        push_snapshot(addr, port, 0, secret)
+        deadline = time.monotonic() + 120
+
+        def _rank1_done(merged):
+            # mere presence is not enough: the interval pusher ships
+            # mid-training snapshots; wait for rank 1's FINAL state
+            snap = merged.get("1")
+            if not snap:
+                return False
+            samples = snap["metrics"].get(
+                "hvd_steps_total", {}).get("samples", [])
+            return any(s.get("value") == 3 for s in samples)
+
+        while time.monotonic() < deadline:
+            merged = _json.loads(
+                get_metrics(addr, port, secret=secret, json_form=True)
+            )
+            if _rank1_done(merged):
+                break
+            time.sleep(0.25)
+        return (0, get_metrics(addr, port, secret=secret))
+
+    results = tpurun.run(_metrics_worker, np=2)
+    by_pid = dict(results)
+    assert sorted(by_pid) == [0, 1]
+    text = by_pid[0]
+    for rank in ("0", "1"):
+        assert (f'hvd_eager_collective_calls_total{{op="allreduce",'
+                f'rank="{rank}"}}') in text
+        assert (f'hvd_eager_collective_bytes_total{{op="allreduce",'
+                f'rank="{rank}"}} 64') in text
+        assert f'hvd_step_seconds_bucket{{le="+Inf",rank="{rank}"}} 2' \
+            in text
+        assert f'hvd_steps_total{{rank="{rank}"}} 3' in text
+
+
+def test_launcher_sets_metrics_env(tmp_path):
+    """tpurun injects HVD_METRICS_KV_* so workers push to the launcher's
+    aggregation server."""
+    import sys
+
+    from horovod_tpu.run.run import run_commandline
+
+    marker = tmp_path / "env.txt"
+    script = (
+        "import os;"
+        "open(r'%s','w').write(os.environ.get('HVD_METRICS_KV_ADDR','')"
+        "+','+os.environ.get('HVD_METRICS_KV_PORT','')"
+        "+','+os.environ.get('HVD_METRICS_SECRET',''))" % marker
+    )
+    rc = run_commandline([
+        "-np", "1", "-H", "localhost:1", sys.executable, "-c", script,
+    ])
+    assert rc == 0
+    addr, port, secret = marker.read_text().split(",")
+    assert addr == "127.0.0.1" and int(port) > 0 and len(secret) == 32

@@ -47,6 +47,7 @@ def test_vgg16_shapes_and_params():
     assert abs(conv_params - 14_714_688) < 1000, conv_params
 
 
+@pytest.mark.slow  # ~30 s Inception compile on CPU — outside the tier-1 budget
 def test_inception_v3_shapes_and_params():
     model = InceptionV3(num_classes=1000, dtype=jnp.float32)
     # params are input-size independent (global mean pool before the
@@ -85,6 +86,7 @@ def test_vgg_train_step():
     assert np.isfinite(float(np.asarray(jax.device_get(loss))))
 
 
+@pytest.mark.slow  # ~45 s Inception train-step compile on CPU — outside the tier-1 budget
 def test_inception_train_step():
     from horovod_tpu.training import (
         init_train_state, make_train_step, shard_batch,

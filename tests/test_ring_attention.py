@@ -179,6 +179,7 @@ def test_sequence_parallel_composes_with_data_parallel(hvd_init, rng, attn):
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # ~35 s of CPU compile/compute — outside the tier-1 budget
 def test_ring_attention_32k_tokens_spot_oracle(hvd_init, rng):
     """Long-context at real scale: 8 ranks x 4096 local = 32768 global
     positions, causal.  A full numpy oracle would need the 32768^2

@@ -47,6 +47,62 @@ def test_stall_disabled():
     assert not insp.warnings
 
 
+def test_stall_shutdown_via_daemon_thread_injected_callback():
+    """The full shutdown path — daemon loop detects the over-threshold
+    entry and invokes on_shutdown — with an injected callback so
+    os._exit is never reachable from the test process."""
+    killed = []
+    done = __import__("threading").Event()
+
+    def on_shutdown(name):
+        killed.append(name)
+        done.set()
+
+    insp = StallInspector(enabled=True, warning_seconds=0.01,
+                          shutdown_seconds=0.03, check_interval=0.01,
+                          on_shutdown=on_shutdown)
+    insp.start()
+    try:
+        insp.begin("allreduce.wedged")
+        assert done.wait(timeout=5), "daemon loop never hit the shutdown path"
+        assert killed[0] == "allreduce.wedged"
+        # the warning fired on the way to the shutdown threshold or the
+        # entry went straight to dead — either way no os._exit happened
+    finally:
+        insp.end("allreduce.wedged")
+        insp.stop()
+
+
+def test_stall_metrics_wiring(monkeypatch):
+    """Warnings feed the cumulative counter; the queue-depth and
+    stalled-op gauges are collector-driven off the live entry table."""
+    from horovod_tpu import metrics
+
+    monkeypatch.setattr(metrics.registry, "enabled", True)
+    insp = StallInspector(enabled=True, warning_seconds=0.02,
+                          shutdown_seconds=0)
+    insp.register_metrics()  # replaces the singleton's collector for now
+    try:
+        before = metrics.STALL_WARNINGS.labels().get()
+        insp.begin("op.a")
+        insp.begin("op.b")
+        time.sleep(0.05)
+        insp.check_once()
+        assert metrics.STALL_WARNINGS.labels().get() == before + 2
+        metrics.registry.snapshot()  # runs the collector
+        assert metrics.INFLIGHT_OPS.get() == 2
+        assert metrics.STALLED_OPS.get() == 2
+        insp.end("op.a")
+        insp.end("op.b")
+        metrics.registry.snapshot()
+        assert metrics.INFLIGHT_OPS.get() == 0
+        assert metrics.STALLED_OPS.get() == 0
+    finally:
+        from horovod_tpu.runtime.stall_inspector import inspector
+
+        inspector.register_metrics()  # restore the singleton's collector
+
+
 # -- callbacks ---------------------------------------------------------------
 def test_warmup_callback_lr():
     from horovod_tpu.callbacks import LearningRateWarmupCallback

@@ -55,6 +55,63 @@ def test_timeline_step_window(hvd_init, tmp_path, monkeypatch):
     assert "step1" not in cats and "step4" not in cats and "step5" not in cats
 
 
+def test_record_step_owner_dedupe_two_steppers(hvd_init, tmp_path):
+    """Two composed steppers (a TimelineHook wrapping a make_train_step
+    loop — both call record_step) must advance the counter ONCE per real
+    step: the first owner claims it, the other's calls return without
+    advancing (timeline.record_step owner contract)."""
+    tl = Timeline()
+    tl.initialize(str(tmp_path))
+    for real_step in range(1, 4):
+        s1 = tl.record_step(owner="timeline_hook")
+        s2 = tl.record_step(owner="train_step")  # composed second stepper
+        assert s1 == real_step
+        assert s2 == real_step, "second owner must not double-advance"
+    assert tl._step == 3
+    tl.shutdown()
+
+
+def test_reinitialize_after_end_step_autoclose(hvd_init, tmp_path,
+                                               monkeypatch):
+    """After the end step auto-finalizes the trace, a fresh initialize()
+    must produce a NEW valid JSON file with a fresh step window — not
+    inherit the exhausted counter and instantly re-close empty."""
+    monkeypatch.setenv("HVD_TRACE_END_STEP", "1")
+    tl = Timeline()
+    tl.initialize(str(tmp_path / "first"))
+    tl.record_step()
+    with tl.span("s1", "ALLREDUCE"):
+        pass
+    tl.record_step()  # step 2 > end 1 → auto-close
+    assert not tl.active, "end-step must auto-finalize the writer"
+    first = _read(tmp_path / "first" / "0" / "comm.json")  # valid JSON
+    assert any(e.get("cat") == "s1" for e in first)
+
+    # new window, new dir: the re-init must start at step 0 again
+    monkeypatch.setenv("HVD_TRACE_END_STEP", "2")
+    tl.initialize(str(tmp_path / "second"))
+    assert tl.active
+    tl.record_step()
+    with tl.span("s2", "ALLREDUCE"):
+        pass
+    tl.shutdown()
+    second = _read(tmp_path / "second" / "0" / "comm.json")
+    assert any(e.get("cat") == "s2" for e in second)
+
+
+def test_reinitialize_resets_stepper_owner(hvd_init, tmp_path, monkeypatch):
+    """The owner claim must not leak across trace files: a second run
+    driven by a different component still gets to advance the window."""
+    monkeypatch.setenv("HVD_TRACE_END_STEP", "1")
+    tl = Timeline()
+    tl.initialize(str(tmp_path / "a"))
+    tl.record_step(owner="hook")
+    tl.record_step(owner="hook")  # auto-close
+    tl.initialize(str(tmp_path / "b"))
+    assert tl.record_step(owner="train_step") == 1
+    tl.shutdown()
+
+
 def test_timeline_disabled_without_dir(hvd_init):
     tl = Timeline()
     tl.initialize(None)

@@ -1,0 +1,157 @@
+"""Cross-rank trace merge + straggler report (timeline/merge.py and the
+scripts/hvd_trace_merge.py CLI)."""
+
+import importlib.util as _ilu
+import json
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.timeline import merge as merge_mod
+from horovod_tpu.timeline.timeline import Timeline
+
+
+def _write_rank(tmp_path, rank, events):
+    d = tmp_path / str(rank)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "comm.json").write_text(json.dumps(events))
+
+
+def _negotiate_events(tensor, op, start_us, wait_us, pid=0):
+    return [
+        {"name": f"NEGOTIATE_{op}", "cat": tensor, "ph": "B",
+         "ts": start_us, "pid": pid, "tid": tensor},
+        {"name": f"NEGOTIATE_{op}", "cat": tensor, "ph": "E",
+         "ts": start_us + wait_us, "pid": pid, "tid": tensor},
+        {"name": op, "cat": tensor, "ph": "X", "ts": start_us + wait_us,
+         "dur": 50.0, "pid": pid, "tid": tensor},
+    ]
+
+
+@pytest.fixture()
+def two_rank_dir(tmp_path):
+    """A synthetic 2-rank trace: on g0, rank 1 arrives LAST (waits only
+    40 us while rank 0 waits 400); on p0 the roles flip."""
+    _write_rank(tmp_path, 0,
+                _negotiate_events("g0", "ALLREDUCE", 100.0, 400.0)
+                + _negotiate_events("p0", "BROADCAST", 900.0, 30.0))
+    _write_rank(tmp_path, 1,
+                _negotiate_events("g0", "ALLREDUCE", 460.0, 40.0, pid=1)
+                + _negotiate_events("p0", "BROADCAST", 700.0, 230.0, pid=1))
+    return tmp_path
+
+
+def test_merge_single_valid_chrome_trace(two_rank_dir, tmp_path):
+    out = tmp_path / "out" / "merged_trace.json"
+    merged = merge_mod.write_merged(str(two_rank_dir), str(out))
+    data = json.loads(out.read_text())  # valid JSON on disk
+    assert data == merged
+    evs = data["traceEvents"]
+    # every event is pid-keyed by rank, with process_name metadata
+    names = {(e["pid"], e["name"]) for e in evs if e.get("ph") == "M"}
+    assert (0, "process_name") in names and (1, "process_name") in names
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1}
+    # rank dirs' events all present: 3 events + 2 metadata per rank
+    assert len(evs) == 2 * (6 + 2)
+
+
+def test_merge_overrides_recorded_pid(tmp_path):
+    """Events recorded with a wrong/stale pid (single-controller runs
+    write pid 0 everywhere) are re-keyed by their rank directory."""
+    _write_rank(tmp_path, 3,
+                [{"name": "ALLREDUCE", "cat": "t", "ph": "X", "ts": 1.0,
+                  "dur": 2.0, "pid": 0, "tid": "t"}])
+    merged = merge_mod.merge_traces(str(tmp_path))
+    evs = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert evs[0]["pid"] == 3
+
+
+def test_merge_accepts_live_unfinalized_trace(tmp_path):
+    d = tmp_path / "0"
+    d.mkdir()
+    (d / "comm.json").write_text(
+        '[\n{"name": "ALLREDUCE", "cat": "t", "ph": "X", "ts": 1.0, '
+        '"dur": 2.0, "pid": 0, "tid": "t"},'
+    )
+    merged = merge_mod.merge_traces(str(tmp_path))
+    assert any(e.get("name") == "ALLREDUCE"
+               for e in merged["traceEvents"])
+
+
+def test_merge_empty_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        merge_mod.merge_traces(str(tmp_path))
+
+
+def test_straggler_report(two_rank_dir):
+    report = merge_mod.straggler_report(str(two_rank_dir))
+    by_tensor = {r["tensor"]: r for r in report["tensors"]}
+    g0, p0 = by_tensor["g0"], by_tensor["p0"]
+    # rank 1 waited 40 us on g0 vs rank 0's 400: rank 1 arrived last
+    assert g0["straggler_rank"] == 1
+    assert g0["max_wait_rank"] == 0
+    assert g0["spread_us"] == pytest.approx(360.0)
+    assert g0["per_rank_wait_us"] == {"0": 400.0, "1": 40.0}
+    # roles flip on p0
+    assert p0["straggler_rank"] == 0
+    assert p0["spread_us"] == pytest.approx(200.0)
+    # widest spread sorts first
+    assert report["tensors"][0]["tensor"] == "g0"
+    # per-rank blame totals
+    assert report["ranks"]["0"]["times_straggler"] == 1
+    assert report["ranks"]["1"]["times_straggler"] == 1
+    assert report["ranks"]["0"]["total_negotiate_wait_us"] \
+        == pytest.approx(430.0)
+
+
+def test_merge_real_timeline_output(hvd_init, tmp_path, monkeypatch, rng):
+    """End-to-end with traces the Timeline actually writes: two
+    simulated ranks produce <dir>/<rank>/comm.json, the merge yields one
+    trace and the straggler report sees both ranks."""
+    from horovod_tpu import core
+
+    for rank in (0, 1):
+        monkeypatch.setattr(core._state, "process_index", rank)
+        tl = Timeline()
+        tl.initialize(str(tmp_path))
+        tl.negotiate_start("grad0", "ALLREDUCE")
+        tl.negotiate_end("grad0", "ALLREDUCE")
+        with tl.span("grad0", "ALLREDUCE"):
+            pass
+        tl.shutdown()
+    merged = merge_mod.merge_traces(str(tmp_path))
+    assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+    report = merge_mod.straggler_report(str(tmp_path))
+    assert set(report["ranks"]) == {"0", "1"}
+    assert {r["tensor"] for r in report["tensors"]} == {"grad0"}
+
+
+def _load_cli():
+    spec = _ilu.spec_from_file_location(
+        "hvd_trace_merge",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "hvd_trace_merge.py"),
+    )
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_writes_trace_and_report(two_rank_dir, tmp_path, capsys):
+    cli = _load_cli()
+    out = tmp_path / "m.json"
+    rep = tmp_path / "r.json"
+    result = cli.main([str(two_rank_dir), "--out", str(out),
+                       "--report", str(rep)])
+    assert json.loads(out.read_text())["traceEvents"]
+    on_disk = json.loads(rep.read_text())
+    assert on_disk == result
+    text = capsys.readouterr().out
+    assert "straggler" in text and "g0" in text
+    # default out path + machine-readable mode
+    result2 = cli.main([str(two_rank_dir), "--json"])
+    assert (two_rank_dir / "merged_trace.json").exists()
+    assert result2["tensors"][0]["tensor"] == "g0"
