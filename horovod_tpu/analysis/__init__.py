@@ -1,0 +1,32 @@
+"""Static + runtime collective-correctness analysis.
+
+Two halves of one story — catching "ranks disagree on which collective
+runs next" *before* it becomes a hang:
+
+* **hvd_lint** (findings.py / collective_api.py / visitor.py / rules.py /
+  cli.py): an AST pass over training code modelling the repo's collective
+  API surface.  Rule catalogue in rules.RULES, user docs in
+  docs/analysis.md, CLI at scripts/hvd_lint.py.
+* **the collective sanitizer** (sanitizer.py): ``HVD_SANITIZER=1`` makes
+  every eager dispatch fingerprint itself and cross-check against all
+  peers through the rendezvous KV store, raising a diagnostic that names
+  the diverging rank and both signatures instead of deadlocking.
+"""
+
+from .findings import (  # noqa: F401
+    Finding,
+    Suppressions,
+    render_json,
+    render_text,
+)
+from .rules import (  # noqa: F401
+    RULES,
+    declared_knobs,
+    iter_python_files,
+    lint_paths,
+    lint_sources,
+)
+from .sanitizer import (  # noqa: F401
+    CollectiveDivergenceError,
+    Sanitizer,
+)

@@ -1,0 +1,60 @@
+"""CLI driver for hvd_lint (scripts/hvd_lint.py is the entry point).
+
+Exit codes: 0 clean, 1 findings, 2 usage error — the shape CI expects
+from a linter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from .findings import render_json, render_text
+from .rules import RULES, lint_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvd_lint",
+        description="Collective-correctness linter for horovod_tpu "
+                    "training code: rank-divergent collectives, "
+                    "data-dependent collectives in traced regions, "
+                    "signature mismatches, host I/O under jit, and "
+                    "general hygiene.",
+    )
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to lint (default: cwd)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format (default: text)")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule IDs to skip (also honours "
+                        "the HVD_LINT_DISABLE env knob)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--warnings-ok", action="store_true",
+                   help="exit 0 when only warning-severity findings remain")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(RULES):
+            sev, summary = RULES[rule]
+            print(f"{rule}  [{sev:7s}]  {summary}")
+        return 0
+    paths = args.paths or ["."]
+    disable = {r.strip() for r in args.disable.split(",") if r.strip()}
+    try:
+        findings = lint_paths(paths, disable=disable)
+    except OSError as e:
+        print(f"hvd_lint: {e}", file=sys.stderr)
+        return 2
+    print(render_json(findings) if args.format == "json"
+          else render_text(findings))
+    if not findings:
+        return 0
+    if args.warnings_ok and all(f.severity == "warning" for f in findings):
+        return 0
+    return 1

@@ -1,0 +1,114 @@
+"""Static model of the collective API surface the linter reasons about.
+
+One place that knows which callables move data across ranks — the
+device-plane ops (ops/collectives.py), the eager wrappers (eager.py),
+the host-plane ``process_*`` bridges, the framework bindings' in-place
+broadcasts, and the raw ``jax.lax`` primitives they all lower to.  The
+linter matches call sites by the *final* attribute name (``hvd.allreduce``,
+``collectives.allreduce`` and a bare imported ``allreduce`` all resolve to
+``allreduce``): import-alias tracking would miss ``getattr`` indirection
+anyway, and collective names are distinctive enough that tail matching is
+the right precision/recall point for review-time linting.
+"""
+
+from __future__ import annotations
+
+#: device-plane collectives (ops/collectives.py public surface)
+DEVICE_COLLECTIVES = frozenset({
+    "allreduce", "grouped_allreduce", "allreduce_gradients",
+    "allgather", "allgatherv", "broadcast", "alltoall", "reducescatter",
+    "allreduce_indexed_slices",
+})
+
+#: eager per-rank-list wrappers (eager.py)
+EAGER_COLLECTIVES = frozenset({
+    "allreduce_", "allgather_", "broadcast_",
+})
+
+#: host-plane (process) collectives, incl. the controller data plane
+HOST_COLLECTIVES = frozenset({
+    "process_allreduce", "process_allgather", "process_broadcast",
+    "broadcast_object", "allgather_object",
+    "allreduce_data", "allgather_data", "broadcast_data",
+    "join_allreduce",
+})
+
+#: in-place / state-mutating collective helpers whose return value is
+#: legitimately discarded (torch/TF parameter sync, elastic join)
+MUTATING_COLLECTIVES = frozenset({
+    "broadcast_parameters", "broadcast_variables",
+    "broadcast_optimizer_state", "join",
+})
+
+#: raw XLA collective primitives (jax.lax)
+LAX_COLLECTIVES = frozenset({
+    "psum", "pmin", "pmax", "pmean", "all_gather", "psum_scatter",
+    "all_to_all", "ppermute", "pshuffle",
+})
+
+#: every name that counts as "a collective runs here"
+ALL_COLLECTIVES = (DEVICE_COLLECTIVES | EAGER_COLLECTIVES
+                   | HOST_COLLECTIVES | MUTATING_COLLECTIVES
+                   | LAX_COLLECTIVES)
+
+#: rank-query calls: an ``if`` keyed on one of these diverges per rank
+RANK_CALLS = frozenset({
+    "rank", "local_rank", "cross_rank", "process_rank",
+    "node_rank", "axis_index", "process_index",
+})
+
+#: decorators / wrappers that put a function on the compiled (traced) path
+TRACE_WRAPPERS = frozenset({
+    "spmd", "jit", "pjit", "shard_map", "pmap", "scan_steps",
+})
+
+#: call tails that block the host thread or touch the filesystem —
+#: poison inside traced code (each trace replays them at compile time and
+#: never at step time, which is almost never what the author meant)
+BLOCKING_BARE_CALLS = frozenset({"print", "open", "input", "breakpoint"})
+BLOCKING_DOTTED_CALLS = frozenset({
+    ("time", "sleep"), ("os", "system"), ("os", "popen"),
+    ("pickle", "dump"), ("pickle", "load"),
+    ("np", "save"), ("np", "load"), ("numpy", "save"), ("numpy", "load"),
+    ("json", "dump"), ("json", "load"),
+})
+#: any call whose base module is one of these is host I/O
+BLOCKING_BASE_MODULES = frozenset({"subprocess", "requests", "urllib"})
+#: debug-plane escapes that are legal inside traced code
+TRACE_SAFE_DOTTED = frozenset({
+    ("debug", "print"), ("debug", "callback"), ("debug", "breakpoint"),
+})
+
+#: keywords whose disagreement between two sites naming the same tensor
+#: is a cross-rank signature mismatch (the coordinator would reject or,
+#: worse, deadlock on it at runtime — controller.cc:377-610)
+SIGNATURE_KEYWORDS = ("op", "root_rank", "process_set", "dtype")
+
+
+#: tails too generic to match on name alone — only these attribute bases
+#: (or a bare imported name) count.  ``join`` collides with
+#: ``os.path.join`` / ``Thread.join`` / ``str.join``.
+AMBIGUOUS_TAILS = {"join": frozenset({"hvd", "horovod_tpu", "elastic"})}
+
+
+def is_collective(tail: str) -> bool:
+    return tail in ALL_COLLECTIVES
+
+
+def is_collective_call(dotted) -> bool:
+    """Whether a call target (its dotted-name tuple) is a collective.
+    Tail-name matching, except ambiguous tails require a known base."""
+    if not dotted or dotted[-1] not in ALL_COLLECTIVES:
+        return False
+    bases = AMBIGUOUS_TAILS.get(dotted[-1])
+    if bases is not None and len(dotted) > 1 and dotted[-2] not in bases:
+        return False
+    return True
+
+
+def is_rank_call(tail: str) -> bool:
+    return tail in RANK_CALLS
+
+
+def is_trace_wrapper(tail: str) -> bool:
+    return tail in TRACE_WRAPPERS

@@ -1,0 +1,119 @@
+"""Finding model + suppression comments for the collective linter.
+
+A finding is one rule violation anchored to a file:line.  Suppressions
+follow the pylint shape the repo already documents for its other lints:
+``# hvd-lint: disable=HVD001`` on the offending line silences that rule
+there; ``# hvd-lint: disable-file=HVD001,HVD004`` (or ``=all``) anywhere
+in a file silences rules for the whole file.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Set, Tuple
+
+#: severity vocabulary, ordered weakest → strongest
+SEVERITIES = ("warning", "error")
+
+_LINE_RE = re.compile(r"#.*?\bhvd-lint\s*:\s*disable=([A-Za-z0-9_,\s]+)")
+_FILE_RE = re.compile(r"#.*?\bhvd-lint\s*:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class Finding:
+    rule: str           # e.g. "HVD001"
+    message: str
+    file: str
+    line: int
+    col: int = 0
+    severity: str = "error"
+    related: str = ""   # optional "see also" site ("other.py:12")
+
+    def format(self) -> str:
+        rel = f"  (see {self.related})" if self.related else ""
+        return (f"{self.file}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}{rel}")
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "message": self.message, "file": self.file,
+            "line": self.line, "col": self.col, "severity": self.severity,
+            **({"related": self.related} if self.related else {}),
+        }
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression state parsed straight from source text."""
+
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)
+    whole_file: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def parse(cls, source: str) -> "Suppressions":
+        supp = cls()
+        for lineno, text in _comment_tokens(source):
+            m = _FILE_RE.search(text)
+            if m:
+                supp.whole_file |= _split_rules(m.group(1))
+                continue
+            m = _LINE_RE.search(text)
+            if m:
+                supp.by_line.setdefault(lineno, set()).update(
+                    _split_rules(m.group(1))
+                )
+        return supp
+
+    def hides(self, finding: Finding) -> bool:
+        if "all" in self.whole_file or finding.rule in self.whole_file:
+            return True
+        rules = self.by_line.get(finding.line, ())
+        return "all" in rules or finding.rule in rules
+
+
+def _split_rules(raw: str) -> Set[str]:
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+def _comment_tokens(source: str) -> List[Tuple[int, str]]:
+    """(line, text) per real COMMENT token — suppression syntax quoted in
+    a docstring or string literal must NOT disable rules.  Falls back to
+    a raw line scan when the file doesn't tokenize (it then carries an
+    HVD000 finding anyway, so best effort is fine)."""
+    try:
+        return [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError, ValueError):
+        return [(i, t) for i, t in enumerate(source.splitlines(), 1)
+                if "#" in t]
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    lines = [f.format() for f in findings]
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    lines.append(
+        f"hvd_lint: {len(findings)} finding(s) "
+        f"({n_err} error(s), {n_warn} warning(s))"
+        if findings else "hvd_lint: OK — no findings"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {"findings": [f.as_dict() for f in findings],
+         "count": len(findings)},
+        indent=1,
+    )
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.file, f.line, f.col, f.rule))

@@ -1,0 +1,325 @@
+"""The rule catalogue + the lint driver.
+
+Each rule is a pure function over :class:`~.visitor.FileFacts` (or, for
+the cross-file pairing rule, over every file's facts at once).  Rule IDs
+are stable API — docs/analysis.md is the user-facing catalogue and the
+fixture corpus under tests/lint_fixtures/ pins one known-bad snippet per
+rule.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, Suppressions, sort_findings
+from .visitor import CollectiveCall, FileFacts, collect_facts
+
+#: rule id → (severity, one-line summary) — the catalogue
+RULES: Dict[str, Tuple[str, str]] = {
+    "HVD001": ("error",
+               "collective inside rank-divergent control flow (deadlock: "
+               "other ranks never reach it)"),
+    "HVD002": ("error",
+               "collective under data-dependent if/while inside a traced "
+               "(spmd/jit) region — ranks may trace different programs"),
+    "HVD003": ("error",
+               "mismatched collective signature between call sites naming "
+               "the same tensor"),
+    "HVD004": ("error",
+               "blocking host I/O inside a traced (spmd/jit) region"),
+    "HVD005": ("warning", "mutable default argument"),
+    "HVD006": ("warning", "bare except swallows every error, including "
+                          "collective divergence diagnostics"),
+    "HVD007": ("warning", "undeclared HVD_* environment variable read "
+                          "(not in the utils/env.py inventory)"),
+    "HVD008": ("warning", "collective result discarded — the API is "
+                          "functional, the reduced value is the return"),
+}
+
+
+def _finding(rule: str, msg: str, path: str, line: int, col: int = 0,
+             related: str = "") -> Finding:
+    return Finding(rule=rule, message=msg, file=path, line=line, col=col,
+                   severity=RULES[rule][0], related=related)
+
+
+# ---------------------------------------------------------------------------
+# per-file rules
+# ---------------------------------------------------------------------------
+def rule_hvd001(facts: FileFacts) -> List[Finding]:
+    out = []
+    for br in facts.rank_branches:
+        body_kinds = sorted(c.tail for c in br.body)
+        orelse_kinds = sorted(c.tail for c in br.orelse)
+        if body_kinds == orelse_kinds:
+            continue  # both arms run the same collectives, in kind
+        # anchor on the collectives of the unbalanced arm(s)
+        seen: Set[str] = set()
+        for arm, other in ((br.body, orelse_kinds), (br.orelse, body_kinds)):
+            counts = dict()
+            for k in other:
+                counts[k] = counts.get(k, 0) + 1
+            for c in arm:
+                if counts.get(c.tail, 0) > 0:
+                    counts[c.tail] -= 1
+                    continue
+                key = f"{c.line}:{c.col}"
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(_finding(
+                    "HVD001",
+                    f"collective '{c.tail}' runs only when the "
+                    f"rank-dependent {br.kind} at line {br.line} takes this "
+                    "arm; other ranks block in it forever",
+                    facts.path, c.line, c.col,
+                ))
+    return out
+
+
+def rule_hvd002(facts: FileFacts) -> List[Finding]:
+    out = []
+    for br in facts.dynamic_branches:
+        for c in br.collectives:
+            out.append(_finding(
+                "HVD002",
+                f"collective '{c.tail}' guarded by a data-dependent "
+                f"{br.kind} (line {br.line}) inside a traced region; "
+                "per-rank data can trace divergent programs — use "
+                "jnp.where / lax.cond on replicated values instead",
+                facts.path, c.line, c.col,
+            ))
+    return out
+
+
+def rule_hvd003(all_facts: Sequence[FileFacts]) -> List[Finding]:
+    """Cross-file: call sites that name the same tensor must agree on the
+    collective kind and on every signature keyword both sites spell out."""
+    sites: Dict[str, List[Tuple[str, CollectiveCall]]] = {}
+    for facts in all_facts:
+        for c in facts.calls:
+            if c.name_kw:
+                sites.setdefault(c.name_kw, []).append((facts.path, c))
+    out = []
+    for name, group in sites.items():
+        if len(group) < 2:
+            continue
+        ref_path, ref = group[0]
+        ref_site = f"{ref_path}:{ref.line}"
+        for path, c in group[1:]:
+            if c.tail != ref.tail:
+                out.append(_finding(
+                    "HVD003",
+                    f"tensor '{name}' is a '{ref.tail}' at {ref_site} but "
+                    f"a '{c.tail}' here — ranks disagreeing on the op kind "
+                    "for one name deadlock at negotiation",
+                    path, c.line, c.col, related=ref_site,
+                ))
+                continue
+            for kw in sorted(set(ref.signature) & set(c.signature)):
+                if ref.signature[kw] != c.signature[kw]:
+                    out.append(_finding(
+                        "HVD003",
+                        f"tensor '{name}' called with {kw}="
+                        f"{c.signature[kw]} here but {kw}="
+                        f"{ref.signature[kw]} at {ref_site}",
+                        path, c.line, c.col, related=ref_site,
+                    ))
+    return out
+
+
+def rule_hvd004(facts: FileFacts) -> List[Finding]:
+    return [
+        _finding(
+            "HVD004",
+            f"blocking host call '{io.what}' inside a traced region: it "
+            "runs at trace time only (never per step) and stalls "
+            "compilation — use jax.debug.print/callback for debug output",
+            facts.path, io.line, io.col,
+        )
+        for io in facts.io_calls
+    ]
+
+
+def rule_hvd005(facts: FileFacts) -> List[Finding]:
+    return [
+        _finding(
+            "HVD005",
+            f"mutable default argument in '{fn}()' is shared across calls",
+            facts.path, line, col,
+        )
+        for line, col, fn in facts.mutable_defaults
+    ]
+
+
+def rule_hvd006(facts: FileFacts) -> List[Finding]:
+    return [
+        _finding(
+            "HVD006",
+            "bare 'except:' catches SystemExit/KeyboardInterrupt and hides "
+            "collective divergence diagnostics — name the exceptions",
+            facts.path, line, col,
+        )
+        for line, col in facts.bare_excepts
+    ]
+
+
+_DECL_RE = re.compile(r"^(HVD_[A-Z0-9_]+)\s*=", re.M)
+
+
+def declared_knobs() -> Set[str]:
+    """The HVD_* inventory: scripts/check_env_vars.py's ``declared_knobs``
+    when the script is present (source checkouts), else the same
+    module-level-assignment regex over utils/env.py directly."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(os.path.dirname(pkg_dir), "scripts",
+                          "check_env_vars.py")
+    if os.path.isfile(script):
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location("_hvd_check_env_vars", script)
+        mod = _ilu.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+            return set(mod.declared_knobs())
+        except Exception:  # noqa: BLE001 — fall through to the local parse
+            pass
+    try:
+        with open(os.path.join(pkg_dir, "utils", "env.py")) as f:
+            return set(_DECL_RE.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def rule_hvd007(facts: FileFacts,
+                knobs: Optional[Set[str]] = None) -> List[Finding]:
+    knobs = declared_knobs() if knobs is None else knobs
+    return [
+        _finding(
+            "HVD007",
+            f"env var '{er.var}' is read here but not declared in "
+            "horovod_tpu/utils/env.py — invisible to tpurun/YAML/docs "
+            "(see scripts/check_env_vars.py)",
+            facts.path, er.line, er.col,
+        )
+        for er in facts.env_reads if er.var not in knobs
+    ]
+
+
+def rule_hvd008(facts: FileFacts) -> List[Finding]:
+    from .collective_api import MUTATING_COLLECTIVES
+
+    return [
+        _finding(
+            "HVD008",
+            f"result of '{c.tail}' is discarded — collectives are "
+            "functional here (no in-place mutation); assign the return "
+            "value",
+            facts.path, c.line, c.col,
+        )
+        for c in facts.calls
+        if c.discarded and c.tail not in MUTATING_COLLECTIVES
+    ]
+
+
+_FILE_RULES = (rule_hvd001, rule_hvd002, rule_hvd004, rule_hvd005,
+               rule_hvd006, rule_hvd008)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def _disabled_from_env() -> Set[str]:
+    from ..utils import env as env_util
+
+    raw = env_util.get_str(env_util.HVD_LINT_DISABLE) or ""
+    return {r.strip() for r in raw.split(",") if r.strip()}
+
+
+def lint_sources(sources: Sequence[Tuple[str, str]],
+                 disable: Iterable[str] = ()) -> List[Finding]:
+    """Lint (path, source) pairs as one session (cross-file pairing sees
+    the whole set).  ``disable`` drops rule IDs on top of any set in the
+    HVD_LINT_DISABLE env knob."""
+    disabled = set(disable) | _disabled_from_env()
+    findings: List[Finding] = []
+    all_facts: List[FileFacts] = []
+    supp: Dict[str, Suppressions] = {}
+    for path, source in sources:
+        supp[path] = Suppressions.parse(source)
+        try:
+            all_facts.append(collect_facts(source, path))
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="HVD000", message=f"syntax error: {e.msg}",
+                file=path, line=e.lineno or 1, col=e.offset or 0,
+                severity="error",
+            ))
+    knobs = declared_knobs()  # once per session, not per file
+    for facts in all_facts:
+        for rule in _FILE_RULES:
+            findings.extend(rule(facts))
+        findings.extend(rule_hvd007(facts, knobs))
+    findings.extend(rule_hvd003(all_facts))
+    findings = [
+        f for f in findings
+        if f.rule not in disabled
+        and not (f.file in supp and supp[f.file].hides(f))
+    ]
+    return sort_findings(findings)
+
+
+#: the repo's own known-bad fixture corpus — the ONE lint_fixtures dir
+#: excluded from directory walks; a user dir that happens to share the
+#: name is still linted
+_OWN_FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "lint_fixtures")
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/dirs into a sorted .py file list, skipping hidden
+    dirs, conventional build output, and the linter's own known-bad
+    fixture corpus (that exact path only)."""
+    skip_dirs = {".git", "__pycache__", "build", "node_modules"}
+    out: List[str] = []
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd CI path must be exit 2, not a green "OK" over
+            # zero files (os.walk on a missing dir yields nothing)
+            raise OSError(f"no such file or directory: {p}")
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in skip_dirs and not d.startswith(".")
+                and os.path.abspath(os.path.join(root, d)) != _OWN_FIXTURES
+            )
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def lint_paths(paths: Sequence[str],
+               disable: Iterable[str] = ()) -> List[Finding]:
+    """Lint files/dirs.  Raises OSError on a nonexistent path (→ CLI
+    exit 2); an unreadable file becomes an HVD000 finding without
+    discarding the rest of the run."""
+    sources = []
+    unreadable: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                sources.append((path, f.read()))
+        except OSError as e:
+            unreadable.append(
+                Finding(rule="HVD000", message=f"unreadable: {e}",
+                        file=path, line=1, severity="error")
+            )
+    return sort_findings(
+        unreadable + lint_sources(sources, disable=disable)
+    )

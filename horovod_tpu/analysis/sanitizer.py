@@ -1,0 +1,207 @@
+"""Cross-rank collective sanitizer — the runtime half of hvd_lint.
+
+The failure mode the linter catches at review time (ranks disagreeing on
+which collective runs next) is, at runtime, a silent hang: every rank
+blocks in a different collective and only the stall inspector's 60-second
+post-mortem names the op.  With ``HVD_SANITIZER=1`` each eager dispatch
+is fingerprinted *before* it runs — (sequence number, op kind, tensor
+name, shape, dtype) — and cross-checked against every peer through the
+launcher's rendezvous KV store (run/http_server.py), the same transport
+the metrics pusher already rides.  A divergence raises
+:class:`CollectiveDivergenceError` on every rank that can see it, naming
+the diverging rank and both call signatures; a peer that never dispatches
+(the classic rank-guarded collective) surfaces as a timeout diagnostic
+instead of an infinite hang.
+
+This is a debug plane: every check is one KV PUT plus size-1 GET-polls
+per peer, so it multiplies eager-dispatch latency — leave it off in
+production and flip it on to turn a reproducible hang into a diagnosis.
+The compiled hot path (hvd.spmd steps) is untouched: XLA's static
+schedule already cannot reorder collectives per rank; divergence enters
+through the eager control plane this guards.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional, Sequence
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# The KV scope fingerprints live under is owned by the server
+# (run/http_server.py SANITIZER_SCOPE — the GET /sanitizer route and key
+# parsing derive from it); imported lazily in check() like the client.
+
+DEFAULT_TIMEOUT_SECONDS = 60.0
+
+#: how many verified sequence numbers each rank keeps published before
+#: garbage-collecting its own old fingerprints.  Completing sequence N
+#: proves every peer has *started* N (they all published it), so no peer
+#: can still need keys below N; the window keeps GET /sanitizer a useful
+#: recent view while bounding the launcher's store at O(window x ranks).
+GC_WINDOW = 64
+
+
+class CollectiveDivergenceError(RuntimeError):
+    """Ranks disagreed on which collective to run next (or one rank never
+    dispatched at all).  Raised instead of the hang the divergence would
+    otherwise become."""
+
+
+def fingerprint(seq: int, *, op: str, name: str, shape: Sequence[int],
+                dtype) -> dict:
+    return {
+        "seq": int(seq),
+        "op": str(op),
+        "name": str(name),
+        "shape": [int(d) for d in shape],
+        "dtype": str(dtype),
+    }
+
+
+def _sig(fp: dict) -> str:
+    return (f"{fp['op']}(name={fp['name']!r}, shape={tuple(fp['shape'])}, "
+            f"dtype={fp['dtype']})")
+
+
+class Sanitizer:
+    """One rank's sanitizer: publishes this rank's fingerprint for each
+    collective sequence number and verifies every peer published an
+    identical one before the dispatch proceeds."""
+
+    def __init__(self, rank: int, size: int, addr: str, port: int,
+                 secret: Optional[bytes] = None,
+                 timeout: float = DEFAULT_TIMEOUT_SECONDS):
+        self.rank = int(rank)
+        self.size = int(size)
+        self.addr = addr
+        self.port = int(port)
+        self.secret = secret
+        self.timeout = float(timeout)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def check(self, *, op: str, name: str, shape: Sequence[int],
+              dtype) -> int:
+        """Fingerprint + cross-check one collective dispatch.  Returns the
+        sequence number it verified; raises CollectiveDivergenceError on
+        signature divergence or a silent peer."""
+        from ..run.http_client import get_kv, put_kv
+        from ..run.http_server import SANITIZER_SCOPE
+
+        from .. import metrics
+
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        mine = fingerprint(seq, op=op, name=name, shape=shape, dtype=dtype)
+        put_kv(self.addr, self.port, SANITIZER_SCOPE,
+               f"{seq}.{self.rank}", json.dumps(mine).encode(), self.secret)
+        for peer in range(self.size):
+            if peer == self.rank:
+                continue
+            raw = get_kv(self.addr, self.port, SANITIZER_SCOPE,
+                         f"{seq}.{peer}", self.secret,
+                         wait=True, timeout=self.timeout)
+            if raw is None:
+                metrics.SANITIZER_MISMATCHES.inc()
+                raise CollectiveDivergenceError(
+                    f"collective sanitizer: rank {peer} published no "
+                    f"fingerprint for sequence {seq} within "
+                    f"{self.timeout:.0f}s while rank {self.rank} "
+                    f"dispatched {_sig(mine)} — rank {peer} is running a "
+                    "different collective schedule (rank-guarded "
+                    "collective, early exit, or a hang upstream)"
+                )
+            theirs = json.loads(raw)
+            if {k: theirs[k] for k in ("op", "name", "shape", "dtype")} != \
+                    {k: mine[k] for k in ("op", "name", "shape", "dtype")}:
+                metrics.SANITIZER_MISMATCHES.inc()
+                raise CollectiveDivergenceError(
+                    f"collective sanitizer: divergence at sequence {seq} — "
+                    f"rank {self.rank} dispatched {_sig(mine)} but rank "
+                    f"{peer} dispatched {_sig(theirs)}"
+                )
+        metrics.SANITIZER_CHECKS.inc()
+        if seq >= GC_WINDOW:
+            # best-effort GC of this rank's own stale fingerprint — a
+            # long job must not grow the launcher's store without bound
+            try:
+                from ..run.http_client import delete_kv
+
+                delete_kv(self.addr, self.port, SANITIZER_SCOPE,
+                          f"{seq - GC_WINDOW}.{self.rank}", self.secret)
+            except Exception:  # noqa: BLE001 — GC must never fail a check
+                pass
+        return seq
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring (hooked by eager._dispatch_guard)
+# ---------------------------------------------------------------------------
+_UNSET = object()
+_instance = _UNSET
+_instance_lock = threading.Lock()
+
+
+def _build_from_env():
+    """The process sanitizer, from launcher-provided env: enabled by
+    HVD_SANITIZER, carried by the metrics rendezvous (addr/port/secret
+    the launcher already exports for the pusher)."""
+    if not env_util.get_bool(env_util.HVD_SANITIZER, False):
+        return None
+    from .. import core
+
+    size = core.process_size()
+    if size <= 1:
+        return None  # nothing to cross-check
+    addr = env_util.get_str(env_util.HVD_METRICS_KV_ADDR)
+    port = env_util.get_int(env_util.HVD_METRICS_KV_PORT, 0)
+    if not addr or not port:
+        log.warning(
+            "HVD_SANITIZER=1 but no rendezvous address "
+            "(HVD_METRICS_KV_ADDR/PORT unset) — sanitizer disabled"
+        )
+        return None
+    secret_hex = env_util.get_str(env_util.HVD_METRICS_SECRET)
+    secret = bytes.fromhex(secret_hex) if secret_hex else None
+    timeout = env_util.get_float(env_util.HVD_SANITIZER_TIMEOUT_SECONDS,
+                                 DEFAULT_TIMEOUT_SECONDS)
+    s = Sanitizer(core.process_rank(), size, addr, port,
+                  secret=secret, timeout=timeout)
+    log.info("collective sanitizer active: rank %d/%d via %s:%d "
+             "(timeout %.0fs)", s.rank, s.size, addr, port, timeout)
+    return s
+
+
+def instance() -> Optional[Sanitizer]:
+    """The process sanitizer, built lazily on first dispatch (None when
+    disabled — the common case costs one identity comparison)."""
+    global _instance
+    if _instance is _UNSET:
+        with _instance_lock:
+            if _instance is _UNSET:
+                try:
+                    _instance = _build_from_env()
+                except Exception:  # noqa: BLE001 — a broken sanitizer
+                    log.exception("sanitizer setup failed; disabled")
+                    _instance = None
+    return _instance
+
+
+def reset() -> None:
+    """Drop the cached process sanitizer (tests / re-init)."""
+    global _instance
+    with _instance_lock:
+        _instance = _UNSET
+
+
+def maybe_check(*, op: str, name: str, shape: Sequence[int], dtype) -> None:
+    """The eager._dispatch_guard hook: no-op unless HVD_SANITIZER=1."""
+    s = instance()
+    if s is not None:
+        s.check(op=op, name=name, shape=shape, dtype=dtype)

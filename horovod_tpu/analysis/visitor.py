@@ -1,0 +1,410 @@
+"""AST walk extracting collective-relevant facts from one source file.
+
+One pass produces a :class:`FileFacts` bundle; the rules (rules.py) are
+pure functions over it.  The walk tracks three kinds of context:
+
+* **traced regions** — functions decorated with (or wrapped by) ``spmd`` /
+  ``jit`` / ``shard_map`` & co., where Python control flow executes at
+  trace time and host I/O is poison;
+* **rank-divergent branches** — ``if``/``while`` keyed on ``rank()``-family
+  calls (directly or through a tainted local like
+  ``verbose = hvd.rank() == 0``), where a collective in one arm only is a
+  deadlock;
+* **data-dependent branches inside traced code** — conditions derived from
+  the traced function's own parameters, where a guarded collective means
+  ranks can trace different programs.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import collective_api as api
+
+
+def _dotted(node) -> Tuple[str, ...]:
+    """The attribute chain of a Name/Attribute expression, else ()."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _tail(node) -> str:
+    """Final attribute name of a call target (``hvd.allreduce`` →
+    ``allreduce``); empty for computed targets."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    d = _dotted(node)
+    return d[-1] if d else ""
+
+
+def _sig_source(node) -> str:
+    """Comparable text for a signature keyword value.  Dotted names
+    normalize to their tail so ``op=hvd.Sum`` and ``op=Sum`` (the same
+    symbol imported two ways) don't read as a cross-site mismatch."""
+    d = _dotted(node)
+    if d:
+        return d[-1]
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — exotic node
+        return "<expr>"
+
+
+@dataclass
+class CollectiveCall:
+    tail: str
+    line: int
+    col: int
+    traced: bool
+    discarded: bool
+    name_kw: Optional[str]           # constant name= value, if any
+    signature: Dict[str, str]        # normalized SIGNATURE_KEYWORDS sources
+    depth: int = 0                   # function-frame depth at the call
+    claimed: bool = False            # already reported by an inner branch
+
+
+@dataclass
+class BranchInfo:
+    """A rank-divergent ``if``/``while``."""
+
+    line: int
+    col: int
+    kind: str                        # "if" | "while"
+    body: List[CollectiveCall]
+    orelse: List[CollectiveCall]
+
+
+@dataclass
+class DynamicBranch:
+    """A data-dependent ``if``/``while`` inside a traced region."""
+
+    line: int
+    col: int
+    kind: str
+    collectives: List[CollectiveCall]
+
+
+@dataclass
+class IOCall:
+    line: int
+    col: int
+    what: str
+
+
+@dataclass
+class EnvRead:
+    line: int
+    col: int
+    var: str
+
+
+@dataclass
+class FileFacts:
+    path: str
+    calls: List[CollectiveCall] = field(default_factory=list)
+    rank_branches: List[BranchInfo] = field(default_factory=list)
+    dynamic_branches: List[DynamicBranch] = field(default_factory=list)
+    io_calls: List[IOCall] = field(default_factory=list)
+    env_reads: List[EnvRead] = field(default_factory=list)
+    mutable_defaults: List[Tuple[int, int, str]] = field(default_factory=list)
+    bare_excepts: List[Tuple[int, int]] = field(default_factory=list)
+
+
+class _Frame:
+    __slots__ = ("traced", "params", "rank_tainted", "data_tainted")
+
+    def __init__(self, traced: bool, params: Set[str]):
+        self.traced = traced
+        self.params = params
+        self.rank_tainted: Set[str] = set()
+        self.data_tainted: Set[str] = set()
+
+
+_ENV_GETTERS = frozenset({"get_str", "get_int", "get_bool", "get_float",
+                          "getenv"})
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set)
+_MUTABLE_CTORS = frozenset({"list", "dict", "set", "defaultdict",
+                            "OrderedDict", "deque"})
+
+
+def _wrapped_function_names(tree: ast.AST) -> Set[str]:
+    """Functions put on the traced path by *call*, not decorator:
+    ``step = hvd.spmd(one_step, ...)`` / ``jax.jit(fn)`` — the first
+    positional bare-name argument of a trace-wrapper call."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and api.is_trace_wrapper(_tail(node.func)) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            names.add(node.args[0].id)
+    return names
+
+
+def _decorator_traced(dec) -> bool:
+    if api.is_trace_wrapper(_tail(dec)):
+        return True
+    if isinstance(dec, ast.Call):
+        if api.is_trace_wrapper(_tail(dec.func)):
+            return True
+        if _tail(dec.func) == "partial" and dec.args \
+                and api.is_trace_wrapper(_tail(dec.args[0])):
+            return True
+    return False
+
+
+class FactVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.AST):
+        self.facts = FileFacts(path=path)
+        self._frames: List[_Frame] = [_Frame(False, set())]  # module frame
+        self._wrapped = _wrapped_function_names(tree)
+        # A file defining its own ``def broadcast_(...)`` (the torch/mxnet
+        # in-place variants) shadows the API: bare calls to it aren't the
+        # framework collective and must not be matched by name.
+        self._local_defs = {
+            n.name for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self._discard_expr: Optional[ast.AST] = None
+
+    # -- context helpers -----------------------------------------------------
+    @property
+    def _frame(self) -> _Frame:
+        return self._frames[-1]
+
+    def _traced(self) -> bool:
+        return self._frame.traced
+
+    def _rank_dep(self, expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and api.is_rank_call(_tail(node)):
+                return True
+            if isinstance(node, ast.Name) \
+                    and any(node.id in f.rank_tainted for f in self._frames):
+                return True
+        return False
+
+    def _data_dep(self, expr) -> bool:
+        f = self._frame
+        if not f.traced:
+            return False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) \
+                    and (node.id in f.params or node.id in f.data_tainted):
+                return True
+        return False
+
+    # -- functions -----------------------------------------------------------
+    def _visit_func(self, node) -> None:
+        a = node.args
+        for default in list(a.defaults) + [d for d in a.kw_defaults if d]:
+            if isinstance(default, _MUTABLE_LITERALS) or (
+                isinstance(default, ast.Call)
+                and _tail(default.func) in _MUTABLE_CTORS
+            ):
+                self.facts.mutable_defaults.append(
+                    (default.lineno, default.col_offset, node.name)
+                )
+        traced = (
+            self._frame.traced
+            or node.name in self._wrapped
+            or any(_decorator_traced(d) for d in node.decorator_list)
+        )
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+        self._frames.append(_Frame(traced, params))
+        try:
+            for stmt in node.body:
+                self.visit(stmt)
+        finally:
+            self._frames.pop()
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        self._visit_func(node)
+
+    def visit_Lambda(self, node):  # noqa: N802
+        # a lambda body executes later, like a nested def — own frame so
+        # branch attribution (depth) and data-dep tracking see it right
+        a = node.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        self._frames.append(_Frame(self._frame.traced, params))
+        try:
+            self.visit(node.body)
+        finally:
+            self._frames.pop()
+
+    # -- taint tracking ------------------------------------------------------
+    def _taint_targets(self, targets, value) -> None:
+        rank = self._rank_dep(value)
+        data = self._data_dep(value)
+        if not (rank or data):
+            return
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    if rank:
+                        self._frame.rank_tainted.add(node.id)
+                    if data:
+                        self._frame.data_tainted.add(node.id)
+
+    def visit_Assign(self, node):  # noqa: N802
+        self._taint_targets(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):  # noqa: N802
+        if node.value is not None:
+            self._taint_targets([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):  # noqa: N802
+        self._taint_targets([node.target], node.value)
+        self.generic_visit(node)
+
+    # -- branches ------------------------------------------------------------
+    def _visit_arm(self, stmts) -> List[CollectiveCall]:
+        start = len(self.facts.calls)
+        for stmt in stmts:
+            self.visit(stmt)
+        return self.facts.calls[start:]
+
+    def _visit_branch(self, node, kind: str) -> None:
+        rank_dep = self._rank_dep(node.test)
+        data_dep = self._data_dep(node.test)
+        self.visit(node.test)
+        depth = len(self._frames)
+
+        def arm(stmts):
+            # A collective inside a nested def/lambda merely *defined* in
+            # the arm does not dispatch here (depth filter); one already
+            # reported by an inner rank-branch isn't re-reported by the
+            # enclosing one (claimed filter).
+            return [c for c in self._visit_arm(stmts)
+                    if c.depth == depth and not c.claimed]
+
+        body = arm(node.body)
+        orelse = arm(node.orelse)
+        if rank_dep:
+            for c in body + orelse:
+                c.claimed = True
+            self.facts.rank_branches.append(BranchInfo(
+                node.lineno, node.col_offset, kind, body, orelse,
+            ))
+        elif data_dep and (body or orelse):
+            for c in body + orelse:
+                c.claimed = True
+            self.facts.dynamic_branches.append(DynamicBranch(
+                node.lineno, node.col_offset, kind, body + orelse,
+            ))
+
+    def visit_If(self, node):  # noqa: N802
+        self._visit_branch(node, "if")
+
+    def visit_While(self, node):  # noqa: N802
+        self._visit_branch(node, "while")
+
+    # -- statements ----------------------------------------------------------
+    def visit_Expr(self, node):  # noqa: N802
+        self._discard_expr = node.value
+        try:
+            self.generic_visit(node)
+        finally:
+            self._discard_expr = None
+
+    def visit_ExceptHandler(self, node):  # noqa: N802
+        if node.type is None:
+            self.facts.bare_excepts.append((node.lineno, node.col_offset))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):  # noqa: N802
+        d = _dotted(node.value)
+        # Load context only: an environ[...] *assignment* is a launcher
+        # exporting a knob to children, not an undeclared read
+        if d and d[-1] == "environ" and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str) \
+                and node.slice.value.startswith("HVD_"):
+            self.facts.env_reads.append(EnvRead(
+                node.lineno, node.col_offset, node.slice.value,
+            ))
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------------
+    def visit_Call(self, node):  # noqa: N802
+        tail = _tail(node.func)
+        shadowed = (isinstance(node.func, ast.Name)
+                    and tail in self._local_defs)
+        if api.is_collective_call(_dotted(node.func)) and not shadowed:
+            sig: Dict[str, str] = {}
+            name_kw = None
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    name_kw = kw.value.value
+                elif kw.arg in api.SIGNATURE_KEYWORDS:
+                    sig[kw.arg] = _sig_source(kw.value)
+            self.facts.calls.append(CollectiveCall(
+                tail=tail, line=node.lineno, col=node.col_offset,
+                traced=self._traced(),
+                discarded=node is self._discard_expr,
+                name_kw=name_kw, signature=sig,
+                depth=len(self._frames),
+            ))
+        self._check_blocking(node, tail)
+        self._check_env_read(node, tail)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node, tail: str) -> None:
+        if not self._traced():
+            return
+        d = _dotted(node.func)
+        if d and len(d) >= 2 and (d[-2], d[-1]) in api.TRACE_SAFE_DOTTED:
+            return
+        what = None
+        if isinstance(node.func, ast.Name) \
+                and tail in api.BLOCKING_BARE_CALLS:
+            what = tail
+        elif len(d) >= 2 and (d[-2], d[-1]) in api.BLOCKING_DOTTED_CALLS:
+            what = ".".join(d[-2:])
+        elif d and d[0] in api.BLOCKING_BASE_MODULES:
+            what = ".".join(d)
+        if what:
+            self.facts.io_calls.append(
+                IOCall(node.lineno, node.col_offset, what)
+            )
+
+    def _check_env_read(self, node, tail: str) -> None:
+        d = _dotted(node.func)
+        is_environ_get = (tail == "get" and len(d) >= 2
+                          and d[-2] == "environ")
+        if not (is_environ_get or tail in _ENV_GETTERS):
+            return
+        if not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith("HVD_"):
+            self.facts.env_reads.append(
+                EnvRead(node.lineno, node.col_offset, arg.value)
+            )
+
+
+def collect_facts(source: str, path: str) -> FileFacts:
+    """Parse + walk one file.  Raises SyntaxError on unparsable input —
+    the caller turns that into a finding."""
+    tree = ast.parse(source, filename=path)
+    v = FactVisitor(path, tree)
+    v.visit(tree)
+    return v.facts

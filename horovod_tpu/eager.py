@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import core, metrics
+from .analysis import sanitizer as _sanitizer
 from .spmd import put_per_rank, get_per_rank, rank_context
 from .core import Average, Sum, Adasum, Min, Max
 from .ops import collectives
@@ -43,10 +44,11 @@ from .utils import env as env_util
 
 
 def _dispatch_guard(name: str, op: str, tensors):
-    """Shared pre-dispatch path for eager collectives: stall watchdog +
-    timeline NEGOTIATE span + metrics (bytes/calls/latency per op) +
-    (in multi-controller jobs) the native controller handshake that
-    guarantees identical op ordering across processes (see
+    """Shared pre-dispatch path for eager collectives: collective
+    sanitizer fingerprint check (HVD_SANITIZER=1; analysis/sanitizer.py) +
+    stall watchdog + timeline NEGOTIATE span + metrics (bytes/calls/
+    latency per op) + (in multi-controller jobs) the native controller
+    handshake that guarantees identical op ordering across processes (see
     runtime/eager_controller.py)."""
     import contextlib
     import time as _time
@@ -56,6 +58,9 @@ def _dispatch_guard(name: str, op: str, tensors):
         sample = tensors[0] if _is_per_rank_list(tensors) else tensors
         shape = np.shape(sample)
         dtype = getattr(sample, "dtype", "float32")
+        # Before the watchdog/negotiation: a divergence must raise the
+        # sanitizer's diagnostic, not mature into a stall warning first.
+        _sanitizer.maybe_check(op=op, name=name, shape=shape, dtype=dtype)
         mon = metrics.on()
         t0 = _time.perf_counter() if mon else 0.0
         t_neg = t0

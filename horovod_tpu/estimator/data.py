@@ -94,7 +94,7 @@ def materialize_with_barrier(store: Store, run_id: str,
         run_id = eager.broadcast_object(run_id)
         if core.process_rank() == 0:
             materialize_dataset(store, run_id, arrays)
-        eager.broadcast_object("materialized")  # barrier
+        eager.broadcast_object("materialized")  # barrier; hvd-lint: disable=HVD008
     else:
         materialize_dataset(store, run_id, arrays)
     return run_id

@@ -36,6 +36,8 @@ hvd_controller_cycles           gauge      coordinator negotiation cycles
 hvd_controller_cache_hits       gauge      coordinator response-cache hits
 hvd_controller_stall_warnings   gauge      coordinator-side stall warnings
 hvd_join_events_total           counter    elastic host-plane join() calls
+hvd_sanitizer_checks_total      counter    sanitizer fingerprints verified
+hvd_sanitizer_mismatches_total  counter    sanitizer divergences raised
 ==============================  =========  ==================================
 """
 
@@ -122,6 +124,14 @@ CONTROLLER_STALLS = registry.gauge(
 
 JOIN_EVENTS = registry.counter(
     "hvd_join_events_total", "Elastic host-plane join() barriers entered.")
+
+SANITIZER_CHECKS = registry.counter(
+    "hvd_sanitizer_checks_total",
+    "Collective-sanitizer fingerprint checks that verified clean.")
+SANITIZER_MISMATCHES = registry.counter(
+    "hvd_sanitizer_mismatches_total",
+    "Collective-sanitizer divergences detected (signature mismatch or "
+    "silent peer).")
 
 
 def on() -> bool:

@@ -54,6 +54,26 @@ def delete_scope(addr: str, port: int, scope: str,
         pass
 
 
+def delete_kv(addr: str, port: int, scope: str, key: str,
+              secret: Optional[bytes] = None) -> None:
+    """Delete one key (the server's DELETE matches exact paths as well as
+    scope prefixes) — used by the sanitizer to garbage-collect old
+    fingerprints."""
+    with _request("DELETE", addr, port, f"/{scope}/{key}", secret=secret):
+        pass
+
+
+def get_sanitizer(addr: str, port: int,
+                  secret: Optional[bytes] = None) -> dict:
+    """The collective-sanitizer fingerprint table from ``GET /sanitizer``:
+    published fingerprints grouped by sequence number, then rank — the
+    live who-is-ahead view while chasing a divergence."""
+    import json
+
+    with _request("GET", addr, port, "/sanitizer", secret=secret) as resp:
+        return json.loads(resp.read().decode())
+
+
 def get_metrics(addr: str, port: int, secret: Optional[bytes] = None,
                 json_form: bool = False) -> str:
     """Scrape the launcher's aggregated metrics: Prometheus text from

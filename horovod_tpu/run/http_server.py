@@ -18,7 +18,10 @@ It is also the job's metrics aggregation point: workers push JSON
 registry snapshots into the ``metrics`` scope (horovod_tpu/metrics/
 push.py), and a signed ``GET /metrics`` renders every rank's snapshot —
 plus the launcher's own registry — as one Prometheus text page
-(``GET /metrics.json`` serves the raw merged snapshots).
+(``GET /metrics.json`` serves the raw merged snapshots).  The collective
+sanitizer (analysis/sanitizer.py, HVD_SANITIZER=1) publishes per-dispatch
+fingerprints into the ``sanitizer`` scope; ``GET /sanitizer`` renders
+the live table grouped by sequence number then rank.
 """
 
 from __future__ import annotations
@@ -39,6 +42,11 @@ SECRET_HEADER = "X-Hvd-Signature"
 
 METRICS_SCOPE = "metrics"
 _METRICS_PREFIX = f"/{METRICS_SCOPE}/"
+
+# collective-sanitizer fingerprints (analysis/sanitizer.py): keys are
+# "<seq>.<rank>" → JSON fingerprint; GET /sanitizer renders the table
+SANITIZER_SCOPE = "sanitizer"
+_SANITIZER_PREFIX = f"/{SANITIZER_SCOPE}/"
 
 
 def sign(secret: bytes, path: str, body: bytes = b"") -> str:
@@ -90,6 +98,24 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         snaps.append(({"rank": "launcher"}, registry.snapshot()))
         return snaps
 
+    def _sanitizer_table(self) -> Dict[str, Dict[str, object]]:
+        """Published collective fingerprints grouped by sequence number:
+        ``{"5": {"0": {...}, "1": {...}}}`` — the live view of which rank
+        is ahead/behind when the sanitizer (or an operator) is chasing a
+        divergence."""
+        store: Dict[str, bytes] = self.server.store  # type: ignore
+        with self.server.lock:  # type: ignore
+            raw = {k[len(_SANITIZER_PREFIX):]: v for k, v in store.items()
+                   if k.startswith(_SANITIZER_PREFIX)}
+        table: Dict[str, Dict[str, object]] = {}
+        for key, val in raw.items():
+            seq, _, rank = key.partition(".")
+            try:
+                table.setdefault(seq, {})[rank] = json.loads(val)
+            except (ValueError, TypeError):
+                table.setdefault(seq, {})[rank] = "<undecodable>"
+        return table
+
     def do_GET(self) -> None:  # noqa: N802
         if not self._verify():
             self._reply(401)
@@ -108,6 +134,10 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             merged = {labels["rank"]: snap
                       for labels, snap in self._rank_snapshots()}
             self._reply(200, json.dumps(merged).encode(),
+                        content_type="application/json")
+            return
+        if path == "/sanitizer":
+            self._reply(200, json.dumps(self._sanitizer_table()).encode(),
                         content_type="application/json")
             return
         store: Dict[str, bytes] = self.server.store  # type: ignore
@@ -137,7 +167,11 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             store = self.server.store  # type: ignore
             for k in [k for k in store if k.startswith(prefix) or k == self.path]:
                 del store[k]
-            self.server.finalized.add(self.path)  # type: ignore
+            # only whole-scope deletes mark rendezvous finalization;
+            # per-key deletes (sanitizer fingerprint GC) must not grow
+            # this set one entry per dispatch
+            if self.path.rstrip("/").count("/") == 1:
+                self.server.finalized.add(self.path)  # type: ignore
         self._reply(200)
 
     def log_message(self, fmt, *args):  # silence default stderr spam

@@ -398,7 +398,8 @@ class RingExecutor:
                 )
             if op == "broadcast":
                 buf = bytearray(arr.tobytes())
-                self._ring.broadcast(buf, root)
+                # writes into buf in place
+                self._ring.broadcast(buf, root)  # hvd-lint: disable=HVD008
                 out = np.frombuffer(buf, arr.dtype).reshape(arr.shape)
             elif op == "allgather":
                 out = self._ring.allgather(arr)

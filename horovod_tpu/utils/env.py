@@ -83,6 +83,10 @@ HVD_METRICS_KV_ADDR = "HVD_METRICS_KV_ADDR"            # launcher rendezvous hos
 HVD_METRICS_KV_PORT = "HVD_METRICS_KV_PORT"            # launcher rendezvous port
 HVD_METRICS_SECRET = "HVD_METRICS_SECRET"              # hex HMAC secret for pushes
 HVD_METRICS_PUSH_SECONDS = "HVD_METRICS_PUSH_SECONDS"  # push interval (default 5)
+# collective sanitizer + linter (horovod_tpu/analysis/)
+HVD_SANITIZER = "HVD_SANITIZER"                        # 1 fingerprints every eager dispatch
+HVD_SANITIZER_TIMEOUT_SECONDS = "HVD_SANITIZER_TIMEOUT_SECONDS"  # peer wait (default 60)
+HVD_LINT_DISABLE = "HVD_LINT_DISABLE"                  # comma list of rule IDs hvd_lint skips
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67

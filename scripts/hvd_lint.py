@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""hvd_lint: collective-correctness linter for horovod_tpu training code.
+
+Static AST analysis modelling the repo's collective API surface
+(allreduce/allgather/broadcast/alltoall/reducescatter across the device,
+eager, and host planes, plus raw lax primitives), flagging the bugs that
+otherwise surface as cross-rank hangs:
+
+    HVD001  collective inside rank-divergent control flow
+    HVD002  collective under data-dependent if/while in a traced region
+    HVD003  mismatched signature between call sites naming one tensor
+    HVD004  blocking host I/O inside a traced region
+    HVD005  mutable default argument
+    HVD006  bare except
+    HVD007  undeclared HVD_* env read
+    HVD008  collective result discarded
+
+Run::
+
+    python scripts/hvd_lint.py examples/ horovod_tpu/     # lint the repo
+    python scripts/hvd_lint.py --format json my_train.py  # CI consumption
+    python scripts/hvd_lint.py --list-rules
+
+Suppress per line with ``# hvd-lint: disable=HVD001`` or per file with
+``# hvd-lint: disable-file=HVD001`` (docs/analysis.md has the full
+catalogue; the runtime counterpart is the HVD_SANITIZER=1 collective
+sanitizer).  Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from horovod_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Known-bad: collective guarded by a rank check in one arm only."""
+import horovod_tpu as hvd
+
+
+def save_and_sync(params):
+    if hvd.rank() == 0:
+        params = hvd.broadcast(params, root_rank=0)  # line 7: HVD001
+    return params
+
+
+def tainted_guard(params):
+    is_root = hvd.rank() == 0
+    if is_root:
+        params = hvd.allgather(params)  # line 14: HVD001 (via taint)
+    return params

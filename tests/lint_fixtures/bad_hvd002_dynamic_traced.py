@@ -1,0 +1,18 @@
+"""Known-bad: collective under data-dependent control flow in a traced
+region — per-rank data can trace divergent programs."""
+import horovod_tpu as hvd
+
+
+@hvd.spmd
+def step(params, batch):
+    if batch.sum() > 0:
+        batch = hvd.allreduce(batch, op=hvd.Sum)  # line 9: HVD002
+    return params, batch
+
+
+@hvd.spmd
+def loop_step(grads, scale):
+    while scale > 1.0:
+        grads = hvd.allreduce(grads)  # line 16: HVD002
+        scale = scale / 2.0
+    return grads

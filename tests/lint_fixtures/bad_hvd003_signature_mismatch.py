@@ -1,0 +1,20 @@
+"""Known-bad: two call sites naming the same tensor disagree on the
+reduction op (and another pair disagrees on the op *kind*) — the
+coordinator rejects or deadlocks on this at runtime."""
+import horovod_tpu as hvd
+
+
+def forward(x):
+    return hvd.allreduce(x, op=hvd.Sum, name="grads.0")
+
+
+def backward(x):
+    return hvd.allreduce(x, op=hvd.Average, name="grads.0")  # line 12: HVD003
+
+
+def sync_a(x):
+    return hvd.broadcast(x, root_rank=0, name="state")
+
+
+def sync_b(x):
+    return hvd.allgather(x, name="state")  # line 20: HVD003 (kind)

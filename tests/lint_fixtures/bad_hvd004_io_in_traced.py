@@ -1,0 +1,13 @@
+"""Known-bad: blocking host I/O inside a traced region — runs at trace
+time only (never per step) and stalls compilation."""
+import time
+
+import horovod_tpu as hvd
+
+
+@hvd.spmd
+def step(params, batch):
+    print("step", batch.shape)  # line 10: HVD004
+    grads = hvd.allreduce(batch)
+    time.sleep(0.1)  # line 12: HVD004
+    return params, grads

@@ -1,0 +1,11 @@
+"""Known-bad: mutable default arguments shared across calls."""
+
+
+def accumulate(x, acc=[]):  # line 4: HVD005
+    acc.append(x)
+    return acc
+
+
+def configure(name, opts={}):  # line 9: HVD005
+    opts[name] = True
+    return opts

@@ -1,0 +1,10 @@
+"""Known-bad: a bare except swallows everything, including the
+sanitizer's divergence diagnostics and KeyboardInterrupt."""
+import horovod_tpu as hvd
+
+
+def robust_reduce(x):
+    try:
+        return hvd.allreduce(x)
+    except:  # line 9: HVD006
+        return x

@@ -1,0 +1,9 @@
+"""Known-bad: HVD_* knobs read outside the utils/env.py inventory —
+invisible to tpurun flags, YAML config, and the docs knob tables."""
+import os
+
+
+def configure():
+    threshold = os.environ.get("HVD_MY_PRIVATE_KNOB")  # line 7: HVD007
+    window = os.environ["HVD_ANOTHER_KNOB"]  # line 8: HVD007
+    return threshold, window

@@ -1,0 +1,8 @@
+"""Known-bad: the collective APIs here are functional — a discarded
+result means the reduction never lands anywhere."""
+import horovod_tpu as hvd
+
+
+def sync(params):
+    hvd.allreduce(params, op=hvd.Average)  # line 7: HVD008
+    return params

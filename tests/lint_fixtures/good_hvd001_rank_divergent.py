@@ -1,0 +1,18 @@
+"""Known-good: every rank reaches the collective; rank guards hold only
+rank-local work (the reference checkpoint-on-rank-0 idiom)."""
+import horovod_tpu as hvd
+
+
+def save_and_sync(params, path):
+    params = hvd.broadcast(params, root_rank=0)  # unconditional: fine
+    if hvd.rank() == 0:
+        print("saving to", path)  # host-level, not traced: fine
+    return params
+
+
+def both_arms(params):
+    if hvd.rank() == 0:
+        out = hvd.allreduce(params, op=hvd.Sum)
+    else:
+        out = hvd.allreduce(params, op=hvd.Sum)  # matched kinds: fine
+    return out

@@ -1,0 +1,23 @@
+"""Known-good: unconditional collectives inside traced code; data-
+dependent selection happens on values, not on which collective runs."""
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+
+
+@hvd.spmd
+def step(params, batch):
+    reduced = hvd.allreduce(batch, op=hvd.Sum)  # unconditional: fine
+    batch = jnp.where(batch.sum() > 0, reduced, batch)  # select values
+    return params, batch
+
+
+@hvd.spmd
+def static_guard(params, batch, *, use_fp16=False):
+    # closure/static flag, not per-rank data: every rank agrees
+    if FP16_ENABLED:
+        batch = hvd.allreduce(batch)
+    return params, batch
+
+
+FP16_ENABLED = False

@@ -1,0 +1,15 @@
+"""Known-good: paired call sites agree on kind and signature."""
+import horovod_tpu as hvd
+
+
+def forward(x):
+    return hvd.allreduce(x, op=hvd.Sum, name="grads.0")
+
+
+def backward(x):
+    return hvd.allreduce(x, op=hvd.Sum, name="grads.0")
+
+
+def unrelated(x):
+    # different names never pair
+    return hvd.allreduce(x, op=hvd.Average, name="metrics.loss")

@@ -1,0 +1,18 @@
+"""Known-good: the debug-plane escape hatches are legal in traced code;
+plain I/O at host level is fine."""
+import jax
+
+import horovod_tpu as hvd
+
+
+@hvd.spmd
+def step(params, batch):
+    jax.debug.print("batch sum {}", batch.sum())  # debug plane: fine
+    return params, hvd.allreduce(batch)
+
+
+def host_loop(step_fn, params, batches):
+    for batch in batches:
+        params, _loss = step_fn(params, batch)
+        print("done one batch")  # host level: fine
+    return params

@@ -1,0 +1,13 @@
+"""Known-good: None-default with an in-body constructor."""
+
+
+def accumulate(x, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(x)
+    return acc
+
+
+def configure(name, opts=None):
+    opts = dict(opts or {})
+    opts[name] = True
+    return opts

@@ -1,0 +1,9 @@
+"""Known-good: exceptions are named; diagnostics propagate."""
+import horovod_tpu as hvd
+
+
+def robust_reduce(x):
+    try:
+        return hvd.allreduce(x)
+    except (ValueError, RuntimeError):
+        raise

@@ -1,0 +1,8 @@
+"""Known-good: only inventory knobs (utils/env.py) are read."""
+import os
+
+
+def configure():
+    timeline = os.environ.get("HVD_TIMELINE")
+    cycle = os.environ.get("HVD_CYCLE_TIME")
+    return timeline, cycle

@@ -1,0 +1,10 @@
+"""Known-good: results assigned; genuinely in-place helpers
+(broadcast_parameters & co.) may discard theirs."""
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+
+
+def sync(params, model):
+    params = hvd.allreduce(params, op=hvd.Average)
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    return params

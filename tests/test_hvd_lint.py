@@ -1,0 +1,281 @@
+"""hvd_lint: the collective-correctness linter (horovod_tpu/analysis/).
+
+Fixture corpus under tests/lint_fixtures/ pins one known-bad and one
+known-good snippet per rule (exact rule IDs + line numbers); the repo
+self-lint runs from tier-1 so a new rank-guarded collective or bare
+except fails fast (pattern of tests/test_env_lint.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.analysis import (
+    RULES,
+    Suppressions,
+    iter_python_files,
+    lint_paths,
+    lint_sources,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+LINT_CLI = os.path.join(REPO, "scripts", "hvd_lint.py")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# rule → (bad fixture, expected finding lines, good fixture)
+CORPUS = {
+    "HVD001": ("bad_hvd001_rank_divergent.py", [7, 14],
+               "good_hvd001_rank_divergent.py"),
+    "HVD002": ("bad_hvd002_dynamic_traced.py", [9, 16],
+               "good_hvd002_dynamic_traced.py"),
+    "HVD003": ("bad_hvd003_signature_mismatch.py", [12, 20],
+               "good_hvd003_signature_match.py"),
+    "HVD004": ("bad_hvd004_io_in_traced.py", [10, 12],
+               "good_hvd004_debug_print.py"),
+    "HVD005": ("bad_hvd005_mutable_default.py", [4, 9],
+               "good_hvd005_default.py"),
+    "HVD006": ("bad_hvd006_bare_except.py", [9],
+               "good_hvd006_named_except.py"),
+    "HVD007": ("bad_hvd007_undeclared_env.py", [7, 8],
+               "good_hvd007_declared_env.py"),
+    "HVD008": ("bad_hvd008_discarded.py", [7],
+               "good_hvd008_assigned.py"),
+}
+
+
+def test_corpus_covers_every_rule():
+    assert set(CORPUS) == set(RULES), "fixture corpus out of sync with " \
+                                      "the rule catalogue"
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_known_bad_fixture_fires_exact_rule_and_lines(rule):
+    bad, lines, _good = CORPUS[rule]
+    findings = lint_paths([_fixture(bad)])
+    assert findings, f"{bad} produced no findings"
+    assert {f.rule for f in findings} == {rule}, \
+        f"{bad}: expected only {rule}, got {[f.format() for f in findings]}"
+    assert [f.line for f in findings] == lines
+    assert all(f.file.endswith(bad) for f in findings)
+    assert all(f.severity == RULES[rule][0] for f in findings)
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_known_good_fixture_is_clean(rule):
+    _bad, _lines, good = CORPUS[rule]
+    findings = lint_paths([_fixture(good)])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_repo_self_lint_clean():
+    """Tier-1: the repo's own examples/ and horovod_tpu/ lint clean —
+    a new true positive is a test failure here, with the finding text."""
+    findings = lint_paths([os.path.join(REPO, "examples"),
+                           os.path.join(REPO, "horovod_tpu")])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_suppression_comments_silence_findings():
+    assert lint_paths([_fixture("suppressed.py")]) == []
+
+
+def test_file_level_suppression():
+    src = (
+        "# hvd-lint: disable-file=HVD006\n"
+        "try:\n    pass\nexcept:\n    pass\n"
+    )
+    assert lint_sources([("f.py", src)]) == []
+    # 'all' silences every rule
+    src_all = src.replace("HVD006", "all")
+    assert lint_sources([("f.py", src_all)]) == []
+
+
+def test_suppressions_parse_shapes():
+    supp = Suppressions.parse(
+        "x = 1  # hvd-lint: disable=HVD001, HVD008\n"
+        "# prose first: hvd-lint: disable-file=HVD007\n"
+    )
+    assert supp.by_line[1] == {"HVD001", "HVD008"}
+    assert supp.whole_file == {"HVD007"}
+
+
+def test_disable_argument_and_env_knob(monkeypatch):
+    bad = _fixture("bad_hvd006_bare_except.py")
+    assert lint_paths([bad], disable={"HVD006"}) == []
+    monkeypatch.setenv("HVD_LINT_DISABLE", "HVD006")
+    assert lint_paths([bad]) == []
+    monkeypatch.setenv("HVD_LINT_DISABLE", "HVD001")
+    assert [f.rule for f in lint_paths([bad])] == ["HVD006"]
+
+
+def test_cross_file_signature_pairing():
+    a = "import horovod_tpu as hvd\n" \
+        "def f(x):\n    return hvd.allreduce(x, op=hvd.Sum, name='t')\n"
+    b = "import horovod_tpu as hvd\n" \
+        "def g(x):\n    return hvd.allreduce(x, op=hvd.Adasum, name='t')\n"
+    findings = lint_sources([("a.py", a), ("b.py", b)])
+    assert [f.rule for f in findings] == ["HVD003"]
+    assert findings[0].file == "b.py" and findings[0].related == "a.py:3"
+
+
+def test_wrapper_call_marks_function_traced():
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def one_step(params, batch):\n"
+        "    if batch.sum() > 0:\n"
+        "        batch = hvd.allreduce(batch)\n"
+        "    return params, batch\n"
+        "step = hvd.spmd(one_step, out_specs=None)\n"
+    )
+    findings = lint_sources([("w.py", src)])
+    assert [f.rule for f in findings] == ["HVD002"]
+    assert findings[0].line == 4
+
+
+def test_rank_divergent_while_loop():
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def f(x):\n"
+        "    while hvd.rank() < 2:\n"
+        "        x = hvd.allreduce(x)\n"
+        "    return x\n"
+    )
+    assert [f.rule for f in lint_sources([("w.py", src)])] == ["HVD001"]
+
+
+def test_nonexistent_path_is_a_usage_error():
+    """A typo'd CI path must not lint zero files and report OK."""
+    with pytest.raises(OSError):
+        lint_paths([os.path.join(REPO, "no_such_dir_xyz")])
+    proc = subprocess.run(
+        [sys.executable, LINT_CLI, "no_such_dir_xyz"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_suppression_in_docstring_does_not_suppress():
+    """Suppression syntax quoted in a docstring/string (e.g. docs or the
+    CLI help) must not silence rules — only real comments count."""
+    src = (
+        '"""Docs: silence with # hvd-lint: disable-file=all."""\n'
+        "import horovod_tpu as hvd\n"
+        "def f(x):\n"
+        "    if hvd.rank() == 0:\n"
+        "        x = hvd.broadcast(x)\n"
+        "    return x\n"
+    )
+    assert [f.rule for f in lint_sources([("d.py", src)])] == ["HVD001"]
+
+
+def test_signature_spelling_normalizes():
+    """op=Sum and op=hvd.Sum are the same symbol imported two ways — not
+    a cross-site mismatch."""
+    a = "import horovod_tpu as hvd\n" \
+        "def f(x):\n    return hvd.allreduce(x, op=hvd.Sum, name='t')\n"
+    b = "from horovod_tpu import Sum, allreduce\n" \
+        "def g(x):\n    return allreduce(x, op=Sum, name='t')\n"
+    assert lint_sources([("a.py", a), ("b.py", b)]) == []
+
+
+def test_collective_in_nested_def_not_attributed_to_branch():
+    """Defining a callback (def or lambda) inside a rank-guarded arm
+    doesn't dispatch there — no HVD001."""
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def setup(x):\n"
+        "    if hvd.rank() == 0:\n"
+        "        cb = lambda g: hvd.allreduce(g)\n"
+        "        def helper(g):\n"
+        "            return hvd.allgather(g)\n"
+        "    return x\n"
+    )
+    assert lint_sources([("n.py", src)]) == []
+
+
+def test_nested_rank_branches_report_once():
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def f(x, debug):\n"
+        "    if hvd.rank() == 0:\n"
+        "        if hvd.rank() < 4:\n"
+        "            x = hvd.allreduce(x)\n"
+        "    return x\n"
+    )
+    findings = lint_sources([("n.py", src)])
+    assert [f.rule for f in findings] == ["HVD001"], \
+        [f.format() for f in findings]
+
+
+def test_environ_write_is_not_an_undeclared_read():
+    src = 'import os\nos.environ["HVD_BRAND_NEW_EXPORT"] = "1"\n'
+    assert lint_sources([("w.py", src)]) == []
+
+
+def test_user_dir_named_lint_fixtures_is_still_linted(tmp_path):
+    """Only the repo's own tests/lint_fixtures corpus is excluded; a user
+    directory sharing the name must not be silently skipped."""
+    d = tmp_path / "lint_fixtures"
+    d.mkdir()
+    (d / "mod.py").write_text("try:\n    pass\nexcept:\n    pass\n")
+    findings = lint_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["HVD006"]
+
+
+def test_syntax_error_becomes_finding():
+    findings = lint_sources([("broken.py", "def f(:\n")])
+    assert [f.rule for f in findings] == ["HVD000"]
+    assert findings[0].severity == "error"
+
+
+def test_iter_python_files_skips_fixture_corpus():
+    files = iter_python_files([os.path.join(REPO, "tests")])
+    assert files, "tests/ yields files"
+    assert not any("lint_fixtures" in f for f in files), \
+        "the known-bad corpus must not be swept into a directory lint"
+
+
+def test_cli_json_output_and_exit_codes():
+    bad = _fixture("bad_hvd001_rank_divergent.py")
+    proc = subprocess.run(
+        [sys.executable, LINT_CLI, "--format", "json", bad],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["count"] == 2
+    assert {f["rule"] for f in payload["findings"]} == {"HVD001"}
+    assert payload["findings"][0]["line"] == 7
+
+    ok = subprocess.run(
+        [sys.executable, LINT_CLI, _fixture("good_hvd001_rank_divergent.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "OK" in ok.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, LINT_CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for rule in RULES:
+        assert rule in proc.stdout
+
+
+def test_warnings_ok_flag():
+    bad = _fixture("bad_hvd006_bare_except.py")  # warning-severity only
+    proc = subprocess.run(
+        [sys.executable, LINT_CLI, "--warnings-ok", bad],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,277 @@
+"""Collective sanitizer (horovod_tpu/analysis/sanitizer.py): fingerprint
+cross-check over the rendezvous KV store.
+
+The two-rank tests stand up a real RendezvousServer and drive one
+Sanitizer per "rank" from two threads — the same wire path a real job
+takes (HMAC-signed HTTP PUT/GET), minus process spawn, so the divergence
+diagnostics are exercised deterministically inside the tier-1 budget.
+The slow test repeats the divergence through real processes via the
+function-mode run() harness (tests/test_multiprocess.py pattern)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu import eager, metrics
+from horovod_tpu.analysis import sanitizer as san_mod
+from horovod_tpu.analysis.sanitizer import (
+    CollectiveDivergenceError,
+    Sanitizer,
+)
+from horovod_tpu.run import http_client
+from horovod_tpu.run.http_server import RendezvousServer
+
+SECRET = b"sanitizer-test-secret"
+
+
+@pytest.fixture()
+def server():
+    s = RendezvousServer(secret=SECRET)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _pair(server, timeout=10.0):
+    return [
+        Sanitizer(rank, 2, "127.0.0.1", server.port, secret=SECRET,
+                  timeout=timeout)
+        for rank in (0, 1)
+    ]
+
+
+def _run_ranks(*fns):
+    """Run one callable per rank concurrently; return per-rank results
+    (the raised exception, when one is raised)."""
+    results = [None] * len(fns)
+
+    def wrap(i, fn):
+        try:
+            results[i] = fn()
+        except Exception as e:  # noqa: BLE001 — the exception IS the result
+            results[i] = e
+
+    threads = [threading.Thread(target=wrap, args=(i, fn))
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "rank thread hung"
+    return results
+
+
+def test_agreeing_ranks_pass_and_count(server):
+    s0, s1 = _pair(server)
+    before = metrics.SANITIZER_CHECKS.labels().get()
+
+    def rank(s):
+        def go():
+            seqs = []
+            for i in range(3):
+                seqs.append(s.check(op="allreduce", name=f"grad.{i}",
+                                    shape=(4, 2), dtype="float32"))
+            return seqs
+        return go
+
+    r0, r1 = _run_ranks(rank(s0), rank(s1))
+    assert r0 == [0, 1, 2] and r1 == [0, 1, 2]
+    assert metrics.SANITIZER_CHECKS.labels().get() == before + 6
+
+
+def test_order_divergence_raises_on_both_ranks_naming_everything(server):
+    """The acceptance case: an injected collective-order divergence
+    becomes a raised diagnostic naming rank, sequence number, and both
+    signatures — instead of a hang."""
+    s0, s1 = _pair(server)
+    before = metrics.SANITIZER_MISMATCHES.labels().get()
+    r0, r1 = _run_ranks(
+        lambda: s0.check(op="allreduce", name="grad.0", shape=(4,),
+                         dtype="float32"),
+        lambda: s1.check(op="broadcast", name="params", shape=(8,),
+                         dtype="bfloat16"),
+    )
+    assert isinstance(r0, CollectiveDivergenceError)
+    assert isinstance(r1, CollectiveDivergenceError)
+    msg = str(r0)
+    assert "sequence 0" in msg
+    assert "rank 0" in msg and "rank 1" in msg
+    # both call signatures, in full
+    assert "allreduce(name='grad.0', shape=(4,), dtype=float32)" in msg
+    assert "broadcast(name='params', shape=(8,), dtype=bfloat16)" in msg
+    # the mirror diagnostic on the other rank
+    assert "allreduce" in str(r1) and "broadcast" in str(r1)
+    assert metrics.SANITIZER_MISMATCHES.labels().get() >= before + 2
+
+
+@pytest.mark.parametrize("field,kwargs", [
+    ("shape", dict(op="allreduce", name="g", shape=(4, 3), dtype="float32")),
+    ("dtype", dict(op="allreduce", name="g", shape=(4, 2), dtype="int32")),
+    ("name", dict(op="allreduce", name="other", shape=(4, 2),
+                  dtype="float32")),
+])
+def test_signature_field_divergence_raises(server, field, kwargs):
+    s0, s1 = _pair(server)
+    base = dict(op="allreduce", name="g", shape=(4, 2), dtype="float32")
+    r0, r1 = _run_ranks(lambda: s0.check(**base), lambda: s1.check(**kwargs))
+    assert isinstance(r0, CollectiveDivergenceError), (field, r0)
+    assert isinstance(r1, CollectiveDivergenceError), (field, r1)
+
+
+def test_silent_peer_times_out_with_diagnostic(server):
+    """A rank-guarded collective: the peer never dispatches.  The waiting
+    rank must raise a diagnostic naming the silent rank, not hang."""
+    s0 = Sanitizer(0, 2, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=1.0)
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        s0.check(op="allreduce", name="grad.0", shape=(4,), dtype="float32")
+    msg = str(ei.value)
+    assert "rank 1 published no fingerprint" in msg
+    assert "sequence 0" in msg
+    assert "allreduce(name='grad.0'" in msg
+
+
+def test_sanitizer_http_table(server):
+    """GET /sanitizer renders the fingerprint table grouped by sequence
+    then rank — the live who-is-ahead view."""
+    s0, s1 = _pair(server)
+    _run_ranks(
+        lambda: s0.check(op="allreduce", name="g", shape=(2,),
+                         dtype="float32"),
+        lambda: s1.check(op="allreduce", name="g", shape=(2,),
+                         dtype="float32"),
+    )
+    table = http_client.get_sanitizer("127.0.0.1", server.port,
+                                      secret=SECRET)
+    assert set(table) == {"0"}
+    assert set(table["0"]) == {"0", "1"}
+    assert table["0"]["1"]["op"] == "allreduce"
+    assert table["0"]["0"]["shape"] == [2]
+
+
+def test_fingerprint_gc_bounds_the_store(server, monkeypatch):
+    """Each rank garbage-collects its own fingerprints behind GC_WINDOW,
+    so a long sanitized job can't grow the launcher's store without
+    bound (and GET /sanitizer stays a recent view)."""
+    monkeypatch.setattr(san_mod, "GC_WINDOW", 2)
+    s0, s1 = _pair(server)
+
+    def rank(s):
+        def go():
+            for i in range(5):
+                s.check(op="allreduce", name=f"g.{i}", shape=(2,),
+                        dtype="float32")
+        return go
+
+    _run_ranks(rank(s0), rank(s1))
+    table = http_client.get_sanitizer("127.0.0.1", server.port,
+                                      secret=SECRET)
+    assert "0" not in table and "1" not in table, table.keys()
+    assert "4" in table  # the recent window survives
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("HVD_SANITIZER", raising=False)
+    san_mod.reset()
+    try:
+        assert san_mod.instance() is None
+        # and the eager hook is a no-op
+        san_mod.maybe_check(op="allreduce", name="x", shape=(1,),
+                            dtype="float32")
+    finally:
+        san_mod.reset()
+
+
+def test_build_from_env(monkeypatch, server):
+    from horovod_tpu import core
+
+    monkeypatch.setenv("HVD_SANITIZER", "1")
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", str(server.port))
+    monkeypatch.setenv("HVD_METRICS_SECRET", SECRET.hex())
+    monkeypatch.setenv("HVD_SANITIZER_TIMEOUT_SECONDS", "7.5")
+    monkeypatch.setattr(core, "process_size", lambda: 2)
+    monkeypatch.setattr(core, "process_rank", lambda: 1)
+    san_mod.reset()
+    try:
+        s = san_mod.instance()
+        assert isinstance(s, Sanitizer)
+        assert (s.rank, s.size) == (1, 2)
+        assert s.port == server.port and s.secret == SECRET
+        assert s.timeout == 7.5
+    finally:
+        san_mod.reset()
+
+
+class _Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def check(self, **kw):
+        self.calls.append(kw)
+        return len(self.calls) - 1
+
+
+def test_eager_dispatch_guard_invokes_sanitizer(hvd_init, monkeypatch):
+    """The wiring: every eager collective dispatch fingerprints through
+    the sanitizer hook before negotiation."""
+    rec = _Recorder()
+    monkeypatch.setattr(san_mod, "_instance", rec)
+    vals = [np.full((3,), float(r + 1), np.float32)
+            for r in range(hvd_init.size())]
+    out = eager.allreduce_(vals, op=hvd_init.Sum, name="san.probe")
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.full((3,), 36.0))
+    _ = eager.broadcast_(vals, root_rank=0, name="san.probe2")
+    assert [c["op"] for c in rec.calls] == ["allreduce", "broadcast"]
+    assert rec.calls[0]["name"] == "san.probe"
+    assert tuple(rec.calls[0]["shape"]) == (3,)
+    assert "float32" in str(rec.calls[0]["dtype"])
+
+
+def _worker_sanitizer_divergence():
+    """Rank 0 dispatches an eager allreduce while rank 1 dispatches a
+    broadcast: HVD_SANITIZER=1 must turn that into a raised diagnostic
+    on both ranks (instead of the controller hang)."""
+    import numpy as np
+
+    import jax
+    import horovod_tpu as hvd
+    from horovod_tpu import eager
+    from horovod_tpu.analysis.sanitizer import CollectiveDivergenceError
+
+    hvd.init(devices=jax.devices("cpu"))
+    r = hvd.process_rank()
+    vals = [np.ones(4, np.float32) for _ in range(hvd.size())]
+    try:
+        if r == 0:  # hvd-lint: disable-file=all (injected divergence)
+            eager.allreduce_(vals, name="diverge.me")
+        else:
+            eager.broadcast_(vals, root_rank=0, name="diverge.me")
+        return {"rank": r, "raised": None}
+    except CollectiveDivergenceError as e:
+        return {"rank": r, "raised": str(e)}
+
+
+@pytest.mark.slow  # real 2-process spawn — outside the tier-1 budget
+def test_two_process_divergence_raises_not_hangs():
+    from horovod_tpu.run.run import run
+    from horovod_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    import os
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    results = run(_worker_sanitizer_divergence, np=2, extra_env={
+        "HVD_SANITIZER": "1",
+        "HVD_SANITIZER_TIMEOUT_SECONDS": "30",
+        "PYTHONPATH": tests_dir + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    })
+    for res in results:
+        assert res["raised"], f"rank {res['rank']} saw no divergence"
+        assert "sequence 0" in res["raised"]
+        assert "allreduce" in res["raised"]
+        assert "broadcast" in res["raised"]
