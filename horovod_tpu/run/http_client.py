@@ -74,6 +74,43 @@ def get_sanitizer(addr: str, port: int,
         return json.loads(resp.read().decode())
 
 
+def get_clock(addr: str, port: int, secret: Optional[bytes] = None,
+              timeout: float = 2.0) -> float:
+    """The rendezvous server's monotonic clock (µs) from ``GET /clock`` —
+    one leg of the replay engine's offset-estimation handshake
+    (timeline/replay/clock.py estimates rtt/offset around this call)."""
+    import json
+
+    with _request("GET", addr, port, "/clock", secret=secret,
+                  timeout=timeout) as resp:
+        return float(json.loads(resp.read().decode())["server_us"])
+
+
+def put_replay_summary(addr: str, port: int, summary: dict,
+                       secret: Optional[bytes] = None) -> None:
+    """Publish a replay summary (scripts/hvd_replay.py output) so
+    ``GET /replay`` on the rendezvous server serves it."""
+    import json
+
+    put_kv(addr, port, "replay", "summary",
+           json.dumps(summary).encode(), secret=secret)
+
+
+def get_replay(addr: str, port: int,
+               secret: Optional[bytes] = None) -> Optional[dict]:
+    """The latest replay summary from ``GET /replay`` (None if nothing
+    has been published yet)."""
+    import json
+
+    try:
+        with _request("GET", addr, port, "/replay", secret=secret) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
 def get_metrics(addr: str, port: int, secret: Optional[bytes] = None,
                 json_form: bool = False) -> str:
     """Scrape the launcher's aggregated metrics: Prometheus text from

@@ -31,6 +31,7 @@ import hmac
 import json
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -47,6 +48,12 @@ _METRICS_PREFIX = f"/{METRICS_SCOPE}/"
 # "<seq>.<rank>" → JSON fingerprint; GET /sanitizer renders the table
 SANITIZER_SCOPE = "sanitizer"
 _SANITIZER_PREFIX = f"/{SANITIZER_SCOPE}/"
+
+# replay-engine summary (timeline/replay/): scripts/hvd_replay.py pushes
+# its JSON summary here; GET /replay serves the latest one.  GET /clock
+# is the offset-estimation handshake the per-rank timelines use at init.
+REPLAY_SCOPE = "replay"
+REPLAY_SUMMARY_KEY = "summary"
 
 
 def sign(secret: bytes, path: str, body: bytes = b"") -> str:
@@ -139,6 +146,24 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         if path == "/sanitizer":
             self._reply(200, json.dumps(self._sanitizer_table()).encode(),
                         content_type="application/json")
+            return
+        if path == "/clock":
+            # one leg of the NTP-style offset handshake
+            # (timeline/replay/clock.py): the server's monotonic clock in
+            # µs — only server-relative consistency matters, every rank
+            # estimates its offset against this same process clock
+            body = json.dumps({"server_us": time.perf_counter() * 1e6})
+            self._reply(200, body.encode(),
+                        content_type="application/json")
+            return
+        if path == "/replay":
+            with self.server.lock:  # type: ignore
+                val = self.server.store.get(  # type: ignore
+                    f"/{REPLAY_SCOPE}/{REPLAY_SUMMARY_KEY}")
+            if val is None:
+                self._reply(404)
+            else:
+                self._reply(200, val, content_type="application/json")
             return
         store: Dict[str, bytes] = self.server.store  # type: ignore
         with self.server.lock:  # type: ignore

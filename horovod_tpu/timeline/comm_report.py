@@ -135,6 +135,8 @@ def _link_volume(op: str, nbytes: int, n: int) -> float:
         return 2.0 * (n - 1) / n * nbytes
     if op in ("all-gather", "reduce-scatter"):
         return (n - 1) / n * nbytes
+    if op == "broadcast":
+        return float(nbytes)        # pipelined ring bcast: full buffer
     return float(nbytes)  # permute / all-to-all: one shard hop
 
 
@@ -145,9 +147,68 @@ def _ring_hops(op: str, n: int) -> int:
         return 0
     if op == "all-reduce":
         return 2 * (n - 1)          # reduce-scatter + all-gather phases
-    if op in ("all-gather", "reduce-scatter"):
+    if op in ("all-gather", "reduce-scatter", "broadcast"):
         return n - 1
     return 1                        # permute / all-to-all: one exchange
+
+
+def predict_collective_us(
+    op: str,
+    nbytes: int,
+    world: int,
+    *,
+    calls: int = 1,
+    ici_bytes_per_sec: float = 186e9,
+    ici_hop_latency: float = 1e-6,
+) -> float:
+    """α–β cost of ``calls`` ring executions of ``op`` moving ``nbytes``
+    total, in µs — THE cost model: ``collective_report``'s scaling
+    curves, the per-tensor table below, and the replay engine's what-if
+    simulator (timeline/replay/simulator.py) all call this one function,
+    so a what-if and the report can never disagree on predicted cost."""
+    t = (_link_volume(op, nbytes, world) / ici_bytes_per_sec
+         + calls * _ring_hops(op, world) * ici_hop_latency)
+    return t * 1e6
+
+
+def per_tensor_table(
+    tensors: Dict[str, Dict[str, Any]],
+    world: int,
+    *,
+    measured_us: Optional[Dict[str, float]] = None,
+    ici_bytes_per_sec: float = 186e9,
+    ici_hop_latency: float = 1e-6,
+) -> Dict[str, Dict[str, Any]]:
+    """Per-tensor cost table: ``tensors`` maps tensor name ->
+    ``{"op", "bytes", "calls"}`` (``calls`` defaults to 1) and the result
+    adds ``predicted_us`` from :func:`predict_collective_us` plus, when a
+    ``measured_us`` map is given (e.g. comm-span durations out of a
+    merged trace), ``measured_us`` and ``model_error_pct`` — the
+    prediction-vs-reality check that tells you whether a what-if built on
+    this model is trustworthy for that tensor."""
+    measured_us = measured_us or {}
+    table: Dict[str, Dict[str, Any]] = {}
+    for name, d in tensors.items():
+        op = str(d.get("op", "all-reduce"))
+        nbytes = int(d.get("bytes", 0) or 0)
+        calls = int(d.get("calls", 1) or 1)
+        row: Dict[str, Any] = {
+            "op": op,
+            "bytes": nbytes,
+            "calls": calls,
+            "predicted_us": round(predict_collective_us(
+                op, nbytes, world, calls=calls,
+                ici_bytes_per_sec=ici_bytes_per_sec,
+                ici_hop_latency=ici_hop_latency), 3),
+        }
+        if name in measured_us:
+            m = float(measured_us[name])
+            row["measured_us"] = round(m, 3)
+            if m > 0:
+                row["model_error_pct"] = round(
+                    (row["predicted_us"] - m) / m * 100.0, 1)
+        table[name] = row
+    return table
 
 
 def model_scaling(

@@ -7,7 +7,12 @@ dPRO-style question "which rank is late?".  This module fuses them:
 
 * :func:`merge_traces` — one Chrome trace for the whole job, with each
   event's ``pid`` forced to its rank and ``process_name`` metadata so
-  chrome://tracing / Perfetto shows one row group per rank;
+  chrome://tracing / Perfetto shows one row group per rank.  When every
+  rank carries a ``clock_sync.json`` sidecar (written by
+  ``Timeline.initialize`` after the offset-estimation handshake against
+  the rendezvous server, timeline/replay/clock.py), event timestamps are
+  shifted onto one shared clock — the alignment the replay engine's
+  cross-rank critical path depends on;
 * :func:`straggler_report` — per-tensor negotiation-wait spread across
   ranks.  A NEGOTIATE span measures how long a rank waited for the rest
   of the job to reach the same collective (reference timeline.cc
@@ -27,13 +32,20 @@ from typing import Dict, List, Optional
 
 NEGOTIATE_PREFIX = "NEGOTIATE_"
 
+#: per-rank clock-offset sidecar written by Timeline.initialize
+CLOCK_SYNC_FILE = "clock_sync.json"
+
 
 def load_rank_events(path: str) -> List[dict]:
     """Parse one comm.json leniently: a live (unfinalized) file has no
     closing bracket and may end mid-stream (same contract as
-    scripts/trace_summary.py)."""
+    scripts/trace_summary.py).  A rank that initialized its writer but
+    never recorded an event leaves an empty (or whitespace-only, or
+    bare-``[``) file — that is an empty trace, not a parse error."""
     with open(path) as f:
         txt = f.read().strip()
+    if not txt or txt == "[":
+        return []
     if txt.endswith(","):
         txt = txt[:-1]
     if not txt.endswith("]"):
@@ -57,14 +69,60 @@ def discover_ranks(trace_dir: str) -> Dict[int, str]:
     return dict(sorted(out.items()))
 
 
-def merge_traces(trace_dir: str) -> dict:
+def load_clock_offsets(trace_dir: str) -> Dict[int, float]:
+    """rank -> trace-clock→server-clock offset (µs) from each rank's
+    ``clock_sync.json`` sidecar (written by ``Timeline.initialize`` after
+    the rendezvous handshake, timeline/replay/clock.py).  Ranks without a
+    sidecar are simply absent."""
+    out: Dict[int, float] = {}
+    for entry in os.listdir(trace_dir):
+        if not entry.isdigit():
+            continue
+        p = os.path.join(trace_dir, entry, CLOCK_SYNC_FILE)
+        if not os.path.isfile(p):
+            continue
+        try:
+            with open(p) as f:
+                out[int(entry)] = float(json.load(f)["offset_us"])
+        except (ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def clock_shifts(trace_dir: str, ranks) -> tuple:
+    """``(aligned, shift_per_rank, offsets)`` — THE alignment policy,
+    shared by :func:`merge_traces` and the replay stitcher so the merged
+    Chrome trace and the replay DAG built over the same directory can
+    never disagree: shifts apply only when EVERY rank has an offset
+    (all-or-nothing — mixing aligned and unaligned ranks is worse than
+    either), normalized so the earliest-offset rank stays put."""
+    offsets = load_clock_offsets(trace_dir)
+    aligned = bool(offsets) and all(r in offsets for r in ranks)
+    base = min(offsets.values()) if aligned else 0.0
+    shift = {r: (offsets[r] - base if aligned else 0.0) for r in ranks}
+    return aligned, shift, offsets
+
+
+def merge_traces(trace_dir: str, align_clocks: bool = True) -> dict:
     """All ranks' events as ONE Chrome trace (object form, so viewers
     accept it even though per-rank files use the array form): every
     event's ``pid`` is its rank — regardless of what the recording
     process wrote — plus ``process_name``/``process_sort_index``
-    metadata per rank."""
+    metadata per rank.
+
+    When ``align_clocks`` and EVERY rank has a ``clock_sync.json``
+    sidecar, each event's ``ts`` is shifted by that rank's offset
+    (normalized so the earliest rank stays at its original origin) — all
+    ranks then share one clock and cross-rank span comparisons are
+    meaningful.  With offsets missing for any rank nothing is shifted
+    (mixing aligned and unaligned ranks would be worse than either)."""
+    ranks = discover_ranks(trace_dir)
+    if align_clocks:
+        aligned, shift, offsets = clock_shifts(trace_dir, ranks)
+    else:
+        aligned, shift, offsets = False, {}, {}
     events: List[dict] = []
-    for rank, path in discover_ranks(trace_dir).items():
+    for rank, path in ranks.items():
         events.append({"name": "process_name", "ph": "M", "pid": rank,
                        "args": {"name": f"rank {rank}"}})
         events.append({"name": "process_sort_index", "ph": "M",
@@ -72,11 +130,16 @@ def merge_traces(trace_dir: str) -> dict:
         for ev in load_rank_events(path):
             ev = dict(ev)
             ev["pid"] = rank
+            if aligned and "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + shift[rank]
             events.append(ev)
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"source": "hvd_trace_merge",
-                          "trace_dir": os.path.abspath(trace_dir)}}
+                          "trace_dir": os.path.abspath(trace_dir),
+                          "clock_aligned": aligned,
+                          "clock_offsets_us": {str(r): round(o, 3)
+                                               for r, o in offsets.items()}}}
 
 
 def write_merged(trace_dir: str, out_path: str) -> dict:
@@ -92,12 +155,25 @@ def write_merged(trace_dir: str, out_path: str) -> dict:
 # ---------------------------------------------------------------------------
 # straggler analysis
 # ---------------------------------------------------------------------------
-def negotiation_waits(events: List[dict]) -> Dict[str, Dict[str, float]]:
-    """tensor -> {op, wait_us} from one rank's events: the duration of
-    each NEGOTIATE_<OP> B/E pair (first pair per tensor wins; repeated
-    negotiations of the same name accumulate)."""
+def negotiation_waits(
+    events: List[dict],
+) -> tuple:
+    """``(waits, unmatched)`` from one rank's events.
+
+    ``waits``: tensor -> {op, wait_us}, the duration of each
+    NEGOTIATE_<OP> B/E pair (repeated negotiations of the same name
+    accumulate); ``"X"``-phase negotiation events (complete spans, the
+    form the native writer emits) contribute their ``dur`` directly.
+
+    ``unmatched``: spans that never paired — a repeated ``"B"`` for the
+    same ``(name, tensor)`` key means the earlier span lost its ``"E"``
+    (it is counted, not silently overwritten), a stray ``"E"`` has no
+    open span, and whatever is still open at end-of-trace leaked.  A
+    truncated live trace shows up here instead of silently under-counting
+    waits."""
     waits: Dict[str, Dict[str, float]] = {}
     open_spans: Dict[tuple, float] = {}
+    unmatched = 0
     for ev in events:
         name = ev.get("name", "")
         if not name.startswith(NEGOTIATE_PREFIX):
@@ -106,8 +182,13 @@ def negotiation_waits(events: List[dict]) -> Dict[str, Dict[str, float]]:
         key = (name, tensor)
         ph = ev.get("ph")
         if ph == "B":
+            if key in open_spans:
+                unmatched += 1  # earlier B never saw its E
             open_spans[key] = float(ev.get("ts", 0.0))
-        elif ph == "E" and key in open_spans:
+        elif ph == "E":
+            if key not in open_spans:
+                unmatched += 1  # E without a B (trace started mid-span)
+                continue
             dur = float(ev.get("ts", 0.0)) - open_spans.pop(key)
             d = waits.setdefault(
                 tensor, {"op": name[len(NEGOTIATE_PREFIX):], "wait_us": 0.0}
@@ -118,7 +199,8 @@ def negotiation_waits(events: List[dict]) -> Dict[str, Dict[str, float]]:
                 tensor, {"op": name[len(NEGOTIATE_PREFIX):], "wait_us": 0.0}
             )
             d["wait_us"] += float(ev.get("dur", 0.0))
-    return waits
+    unmatched += len(open_spans)  # still open at end-of-trace
+    return waits, unmatched
 
 
 def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
@@ -134,11 +216,15 @@ def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
     * ``max_wait_rank`` — the rank that waited longest (arrived first).
 
     ``ranks`` summarizes per-rank blame: how many tensors each rank
-    stragglered, and its total negotiation wait (a chronically low
-    total = chronically late rank).
+    stragglered, its total negotiation wait (a chronically low
+    total = chronically late rank), and ``unmatched_spans`` — B/E pairs
+    that never closed, the signature of a truncated live trace.
     """
-    per_rank = {rank: negotiation_waits(load_rank_events(path))
-                for rank, path in discover_ranks(trace_dir).items()}
+    per_rank: Dict[int, Dict[str, dict]] = {}
+    unmatched: Dict[int, int] = {}
+    for rank, path in discover_ranks(trace_dir).items():
+        per_rank[rank], unmatched[rank] = negotiation_waits(
+            load_rank_events(path))
     tensors: Dict[str, dict] = {}
     for rank, waits in per_rank.items():
         for tensor, d in waits.items():
@@ -173,6 +259,7 @@ def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
                 "times_straggler": straggled[r],
                 "total_negotiate_wait_us": round(
                     sum(d["wait_us"] for d in per_rank[r].values()), 1),
+                "unmatched_spans": unmatched[r],
             }
             for r in per_rank
         },

@@ -180,16 +180,43 @@ class Recorder:
 
     def register_gradients(self, grads: Any) -> None:
         """gradient_name_list.json from pytree paths (reference
-        recorder.py:176-193 register_tensors / gradient name manifest)."""
+        recorder.py:176-193 register_tensors / gradient name manifest).
+
+        Also merges each gradient's shape into ``tensor_shapes.json`` and
+        its dtype into ``tensor_dtypes.json``, keyed by manifest name —
+        the byte counts the replay engine's what-if cost model
+        (timeline/replay/stitcher.py) joins comm events against."""
         if not self.enabled:
             return
+        leaves = jax.tree_util.tree_flatten_with_path(grads)[0]
         paths = [
             "gradients/" + "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                                     for k in path)
-            for path, _ in jax.tree_util.tree_flatten_with_path(grads)[0]
+            for path, _ in leaves
         ]
         with open(self._path("gradient_name_list.json"), "w") as f:
             json.dump(paths, f, indent=1)
+        shapes: Dict[str, list] = {}
+        dtypes: Dict[str, str] = {}
+        for name, (_, leaf) in zip(paths, leaves):
+            if hasattr(leaf, "shape"):
+                shapes[name] = list(leaf.shape)
+                dtypes[name] = str(getattr(leaf, "dtype", "float32"))
+        if shapes:
+            # merge, don't overwrite: record_step_function and earlier
+            # register_gradients calls (second param group, elastic
+            # rejoin) contribute keys too — losing a dtype silently
+            # falls the stitcher back to the 4-byte default
+            for name, payload in (("tensor_shapes.json", shapes),
+                                  ("tensor_dtypes.json", dtypes)):
+                path = self._path(name)
+                if os.path.isfile(path):
+                    with open(path) as f:
+                        existing = json.load(f)
+                    existing.update(payload)
+                    payload = existing
+                with open(path, "w") as f:
+                    json.dump(payload, f, indent=1)
 
     def dump_metadata(self, **meta: Any) -> None:
         """metadata.json (reference recorder.py metadata dump: model name,

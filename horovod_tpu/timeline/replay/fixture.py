@@ -1,0 +1,117 @@
+"""Synthetic 2-rank fixture trace with a hand-computed critical path.
+
+The replay engine's ground truth: a trace small enough to schedule by
+hand, used by ``scripts/hvd_replay.py --check`` (the tier-1 smoke) and
+the unit tests.  The step, on the ALIGNED clock (rank 1's raw
+timestamps are shifted −25 µs and its ``clock_sync.json`` carries
+``offset_us=+25`` — alignment itself is under test):
+
+::
+
+    rank 0:  [compute 100][ wait 200        ][comm 50][compute 100]
+    rank 1:  [compute 300 (straggler)       ][comm 50][compute  50]
+             0         100                  300      350   400   450
+
+* both ranks negotiate tensor ``g0`` (ALLREDUCE, 4 MiB: f32[1024,1024]
+  from tensor_shapes.json); rank 0 arrives at 100, rank 1 at 300 — the
+  collective starts at 300, so rank 0 waits 200 µs;
+* hand-computed critical path: rank 1's 300 µs compute → the 50 µs
+  collective → rank 0's 100 µs tail compute = **450 µs** makespan;
+* hand-computed "remove straggler rank 1" what-if: rank 1's leading
+  segment clamps to rank 0's 100 µs, the collective starts at 100,
+  rank 0's tail ends at 100+50+100 = **250 µs**;
+* hand-computed attribution: rank 0 {compute 200, comm 50,
+  negotiation 200, idle 0}; rank 1 {compute 350, comm 50,
+  negotiation 0, idle 50}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from ..recorder import structure_dag, write_gml
+
+TENSOR = "g0"
+SHAPE = [1024, 1024]                     # f32 → 4 MiB payload
+STEP_NO = 1
+
+#: hand-computed ground truth asserted by --check and the tests
+EXPECTED: Dict[str, object] = {
+    "makespan_us": 450.0,
+    "critical_path": [
+        {"kind": "compute", "rank": 1, "dur_us": 300.0},
+        {"kind": "comm", "tensor": TENSOR, "dur_us": 50.0},
+        {"kind": "compute", "rank": 0, "dur_us": 100.0},
+    ],
+    "remove_straggler_us": 250.0,
+    "straggler_rank": 1,
+    "attribution": {
+        "0": {"compute_us": 200.0, "comm_us": 50.0,
+              "negotiation_us": 200.0, "idle_us": 0.0},
+        "1": {"compute_us": 350.0, "comm_us": 50.0,
+              "negotiation_us": 0.0, "idle_us": 50.0},
+    },
+    "tensor_bytes": 1024 * 1024 * 4,
+}
+
+
+def _events_rank0() -> List[dict]:
+    t = TENSOR
+    return [
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": t, "ph": "B", "ts": 100.0,
+         "pid": 0, "tid": t},
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": t, "ph": "E", "ts": 300.0,
+         "pid": 0, "tid": t},
+        {"name": "ALLREDUCE", "cat": t, "ph": "X", "ts": 300.0,
+         "dur": 50.0, "pid": 0, "tid": t},
+        {"name": "STEP", "cat": f"step_{STEP_NO}", "ph": "X", "ts": 0.0,
+         "dur": 450.0, "pid": 0, "tid": "step"},
+    ]
+
+
+def _events_rank1() -> List[dict]:
+    # raw timestamps 25 µs BEHIND the aligned clock; clock_sync.json says
+    # offset_us=+25, so merge/stitch shifts them back onto the shared one
+    t = TENSOR
+    off = -25.0
+    return [
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": t, "ph": "B",
+         "ts": 300.0 + off, "pid": 1, "tid": t},
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": t, "ph": "E",
+         "ts": 300.0 + off, "pid": 1, "tid": t},
+        {"name": "ALLREDUCE", "cat": t, "ph": "X", "ts": 300.0 + off,
+         "dur": 50.0, "pid": 1, "tid": t},
+        {"name": "STEP", "cat": f"step_{STEP_NO}", "ph": "X",
+         "ts": 0.0 + off, "dur": 400.0, "pid": 1, "tid": "step"},
+    ]
+
+
+def write_fixture_trace(trace_dir: str) -> Dict[str, object]:
+    """Materialize the fixture (comm.json + clock_sync.json +
+    tensor_shapes/dtypes + gradient manifest + dag.gml + metadata per
+    rank) and return :data:`EXPECTED`."""
+    events = {0: _events_rank0(), 1: _events_rank1()}
+    offsets = {0: 0.0, 1: 25.0}
+    for rank in (0, 1):
+        d = os.path.join(trace_dir, str(rank))
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "comm.json"), "w") as f:
+            json.dump(events[rank], f, indent=1)
+        with open(os.path.join(d, "clock_sync.json"), "w") as f:
+            json.dump({"offset_us": offsets[rank], "rtt_us": 8.0,
+                       "samples": 8, "rank": rank,
+                       "method": "fixture"}, f, indent=1)
+        with open(os.path.join(d, "tensor_shapes.json"), "w") as f:
+            json.dump({TENSOR: SHAPE}, f, indent=1)
+        with open(os.path.join(d, "tensor_dtypes.json"), "w") as f:
+            json.dump({TENSOR: "float32"}, f, indent=1)
+        with open(os.path.join(d, "gradient_name_list.json"), "w") as f:
+            json.dump([TENSOR], f, indent=1)
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            json.dump({"rank": rank, "size": 2, "model": "fixture"},
+                      f, indent=1)
+        nodes, edges = structure_dag([TENSOR])
+        write_gml(nodes, edges, os.path.join(d, "dag.gml"))
+    return dict(EXPECTED)

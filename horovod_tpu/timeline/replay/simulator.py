@@ -1,0 +1,251 @@
+"""What-if simulation: re-run a stitched step DAG under modified
+assumptions and rank the scenarios by predicted speedup.
+
+This is the payoff of the whole byteprofile→stitch→replay chain: the
+merge can say "rank 3 is late", but only replay can say what fixing it
+is *worth*.  Each scenario rewrites one assumption and re-schedules the
+same DAG (critical_path.schedule):
+
+* ``remove_straggler_rank_<r>`` — the blamed rank's compute segments are
+  clamped to the fastest rank's matching segments (matched by segment
+  label, i.e. which tensor the segment feeds), as if its slowdown —
+  thermal throttling, a noisy neighbor, input skew — were gone;
+* ``ici_bandwidth_x<F>`` — every collective is re-costed with the α–β
+  model *calibrated per node*: the measured duration is split into an α
+  share (hop latency, from the ring-hop count) and a β share (bytes on
+  the wire), and only β shrinks with bandwidth — exactly how the comm
+  report models scaling (comm_report.predict_collective_us is the shared
+  cost model);
+* ``overlap_comm`` — collectives stop blocking their ranks' host
+  threads and only gate the end of step (perfect compute/comm overlap,
+  the upper bound fusion+async dispatch chase);
+* ``fuse_all_comm`` — all collectives in the step re-batched into one
+  bucket: one α, summed β, readiness gated by the LAST gradient — the
+  fusion-buffer ceiling (bucket re-batching is the reference's whole
+  fusion rationale).
+
+Predictions are *calibrated replays*: the baseline is the DAG replayed
+with measured durations, so a scenario's delta isolates exactly the
+assumption it changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ..comm_report import _link_volume, _ring_hops, predict_collective_us
+from .critical_path import Schedule, attribute, schedule
+from .stitcher import Node, StepDAG
+
+#: defaults shared with comm_report.collective_report (v5e-class ICI)
+DEFAULT_ICI_BYTES_PER_SEC = 186e9
+DEFAULT_HOP_LATENCY_US = 1.0
+
+
+@dataclasses.dataclass
+class CostModel:
+    """α–β parameters every scenario prices collectives with."""
+
+    world: int
+    ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC
+    hop_latency_us: float = DEFAULT_HOP_LATENCY_US
+
+    def alpha_us(self, node: Node) -> float:
+        return _ring_hops(node.op or "all-reduce",
+                          self.world) * self.hop_latency_us
+
+    def beta_us(self, node: Node) -> Optional[float]:
+        if not node.nbytes:
+            return None
+        return _link_volume(node.op or "all-reduce", node.nbytes,
+                            self.world) / self.ici_bytes_per_sec * 1e6
+
+    def predict_us(self, node: Node) -> Optional[float]:
+        if not node.nbytes:
+            return None
+        return predict_collective_us(
+            node.op or "all-reduce", node.nbytes, self.world,
+            ici_bytes_per_sec=self.ici_bytes_per_sec,
+            ici_hop_latency=self.hop_latency_us * 1e-6)
+
+    def calibrated_beta_us(self, node: Node) -> float:
+        """The measured duration's bandwidth-dependent share: measured
+        minus the α floor (never negative).  Calibration keeps what-ifs
+        honest on hardware whose effective bandwidth differs from the
+        datasheet — the model shape is analytic, the level is measured."""
+        return max(node.dur_us - self.alpha_us(node), 0.0)
+
+
+def identify_straggler(dag: StepDAG, sched: Schedule) -> Optional[int]:
+    """The rank that cost the others the most negotiation wait: per
+    collective, the last-arriving rank is blamed for that tensor's
+    max−min wait spread; highest total blame wins."""
+    blame: Dict[int, float] = {r: 0.0 for r in dag.chains}
+    for cid, rp in dag.ready_pred.items():
+        if len(rp) < 2:
+            continue
+        arrivals = {}
+        for rank, pred in rp.items():
+            arrivals[rank] = sched.end[pred] if pred is not None else \
+                dag.rank_base_us.get(rank, 0.0)
+        last = max(arrivals, key=arrivals.get)
+        blame[last] += max(arrivals.values()) - min(arrivals.values())
+    if not blame or max(blame.values()) <= 0.0:
+        return None
+    return max(blame, key=blame.get)
+
+
+# ---------------------------------------------------------------------------
+# scenario builders
+# ---------------------------------------------------------------------------
+def bandwidth_overrides(dag: StepDAG, cm: CostModel,
+                        factor: float) -> Dict[int, float]:
+    return {
+        n.nid: cm.alpha_us(n) + cm.calibrated_beta_us(n) / factor
+        for n in dag.nodes if n.kind == "comm"
+    }
+
+
+def remove_rank_overrides(dag: StepDAG, rank: int
+                          ) -> Dict[str, Dict[int, float]]:
+    """Clamp ``rank``'s compute segments to the fastest rank's matching
+    segment (by label); its step-start skew is clamped to the earliest
+    rank's."""
+    best_by_label: Dict[str, float] = {}
+    for r, chain in dag.chains.items():
+        if r == rank:
+            continue
+        for nid in chain:
+            node = dag.nodes[nid]
+            if node.kind == "compute":
+                cur = best_by_label.get(node.label)
+                best_by_label[node.label] = node.dur_us if cur is None \
+                    else min(cur, node.dur_us)
+    durs: Dict[int, float] = {}
+    for nid in dag.chains.get(rank, ()):
+        node = dag.nodes[nid]
+        if node.kind == "compute" and node.label in best_by_label:
+            durs[nid] = min(node.dur_us, best_by_label[node.label])
+    bases = {rank: min(dag.rank_base_us.values())}
+    return {"dur_overrides": durs, "base_overrides": bases}
+
+
+def fused_dag(dag: StepDAG, cm: CostModel) -> Optional[StepDAG]:
+    """The step DAG with every collective re-batched into ONE bucket:
+    per rank the bucket sits where its last collective sat (readiness =
+    the last gradient's arrival — fusion can't launch before the bucket
+    fills), computes keep their relative order, and the bucket costs one
+    α plus the summed calibrated β of its members.  None when there are
+    fewer than two collectives (nothing to fuse)."""
+    comm_nodes = [n for n in dag.nodes if n.kind == "comm"]
+    if len(comm_nodes) < 2:
+        return None
+    alpha = max(cm.alpha_us(n) for n in comm_nodes)
+    beta = sum(cm.calibrated_beta_us(n) for n in comm_nodes)
+    total_bytes = sum(n.nbytes or 0 for n in comm_nodes) or None
+
+    nodes: List[Node] = []
+    chains: Dict[int, List[int]] = {}
+    ready_pred: Dict[int, Dict[int, Optional[int]]] = {}
+    id_map: Dict[int, int] = {}
+
+    def clone(node: Node) -> int:
+        new = dataclasses.replace(node, nid=len(nodes))
+        nodes.append(new)
+        id_map[node.nid] = new.nid
+        return new.nid
+
+    fused = Node(0, "comm", alpha + beta, tensor="<fused>",
+                 op="all-reduce", nbytes=total_bytes, label="comm:<fused>",
+                 ranks=tuple(sorted({r for n in comm_nodes
+                                     for r in n.ranks})))
+    fused_id: Optional[int] = None
+    for rank, chain in dag.chains.items():
+        old_comms = [nid for nid in chain
+                     if dag.nodes[nid].kind == "comm"]
+        last_comm = old_comms[-1] if old_comms else None
+        new_chain: List[int] = []
+        for nid in chain:
+            node = dag.nodes[nid]
+            if node.kind == "compute":
+                new_chain.append(clone(node))
+            elif nid == last_comm:
+                if fused_id is None:
+                    fused.nid = len(nodes)
+                    nodes.append(fused)
+                    fused_id = fused.nid
+                    ready_pred[fused_id] = {}
+                # the bucket fills when this rank's LAST gradient is
+                # ready: its readiness pred is whatever precedes it in
+                # the rebuilt (compute-only-so-far) chain
+                ready_pred[fused_id][rank] = new_chain[-1] if new_chain \
+                    else None
+                new_chain.append(fused_id)
+        chains[rank] = new_chain
+    return StepDAG(
+        step=dag.step, t0_us=dag.t0_us, nodes=nodes, chains=chains,
+        ready_pred=ready_pred, rank_base_us=dict(dag.rank_base_us),
+        measured_span_us=dict(dag.measured_span_us), world=dag.world,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the what-if driver
+# ---------------------------------------------------------------------------
+def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
+            bandwidth_factors: tuple = (2.0, 4.0)) -> dict:
+    """Baseline replay + every scenario, ranked by predicted speedup."""
+    cm = cm or CostModel(world=dag.world)
+    base = schedule(dag)
+    baseline_us = base.makespan
+    scenarios: List[dict] = []
+
+    def add(name: str, sched_: Schedule, detail: str) -> None:
+        predicted = sched_.makespan
+        scenarios.append({
+            "scenario": name,
+            "predicted_step_us": round(predicted, 3),
+            "speedup_pct": round(
+                (baseline_us - predicted) / baseline_us * 100.0, 2)
+            if baseline_us > 0 else 0.0,
+            "detail": detail,
+        })
+
+    straggler = identify_straggler(dag, base)
+    if straggler is not None:
+        ov = remove_rank_overrides(dag, straggler)
+        add(f"remove_straggler_rank_{straggler}",
+            schedule(dag, dur_overrides=ov["dur_overrides"],
+                     base_overrides=ov["base_overrides"]),
+            f"rank {straggler}'s compute clamped to the fastest rank's "
+            "matching segments")
+    for f in bandwidth_factors:
+        add(f"ici_bandwidth_x{f:g}",
+            schedule(dag, dur_overrides=bandwidth_overrides(dag, cm, f)),
+            f"β share of every collective divided by {f:g} "
+            "(α latency floor kept)")
+    add("overlap_comm", schedule(dag, overlap=True),
+        "collectives no longer block host threads; they only gate "
+        "step end")
+    fdag = fused_dag(dag, cm)
+    if fdag is not None:
+        add("fuse_all_comm", schedule(fdag),
+            "all collectives re-batched into one bucket: one α, "
+            "summed β, launch gated by the last gradient")
+    scenarios.sort(key=lambda s: s["predicted_step_us"])
+    return {
+        "baseline_replay_us": round(baseline_us, 3),
+        "straggler_rank": straggler,
+        "cost_model": {
+            "world": cm.world,
+            "ici_bytes_per_sec": cm.ici_bytes_per_sec,
+            "hop_latency_us": cm.hop_latency_us,
+        },
+        "scenarios": scenarios,
+    }
+
+
+def attribution_with_baseline(dag: StepDAG) -> dict:
+    """Convenience: baseline schedule's attribution (CLI/server path)."""
+    return attribute(dag, schedule(dag))

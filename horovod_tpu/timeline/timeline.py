@@ -141,17 +141,26 @@ class Timeline:
 
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, directory: Optional[str] = None) -> None:
-        """Open ``<dir>/<rank>/comm.json`` (reference timeline.cc:205-228)."""
+        """Open ``<dir>/<rank>/comm.json`` (reference timeline.cc:205-228).
+
+        When the launcher's rendezvous server is reachable
+        (``HVD_METRICS_KV_*`` set), also run the clock-offset handshake
+        and drop a ``clock_sync.json`` sidecar next to comm.json — the
+        per-rank trace-clock→server-clock offset the cross-rank merge
+        and the replay engine use to put every rank on one clock
+        (``HVD_REPLAY_CLOCK_SYNC=0`` skips it)."""
         directory = directory or env_util.get_str(env_util.HVD_TIMELINE) or \
             env_util.get_str(env_util.HVD_TRACE_DIR)
         if not directory:
             return
         rank = core.process_rank() if core.is_initialized() else 0
         path = os.path.join(directory, str(rank), "comm.json")
+        opened = False
         with self._lock:
             if self._writer is None:
                 self._writer = _make_writer(path)
                 self._dir = os.path.dirname(path)
+                opened = True
                 # fresh trace file = fresh step window: an init() after a
                 # previous run's auto-close must not inherit its counter
                 # (else the new trace instantly re-closes empty)
@@ -171,6 +180,40 @@ class Timeline:
 
                     atexit.register(self.shutdown)
                     self._atexit_registered = True
+        if opened:
+            # network I/O — after the lock is released, and never fatal
+            self._record_clock_sync(os.path.dirname(path), rank)
+
+    def _record_clock_sync(self, rank_dir: str, rank: int) -> None:
+        """Estimate this rank's trace-clock→server-clock offset against
+        the rendezvous server and persist it as ``clock_sync.json``
+        (timeline/replay/clock.py; applied by merge_traces).  Written as
+        a sidecar, not a trace event, so it survives the native writer's
+        fixed event schema."""
+        if not env_util.get_bool(env_util.HVD_REPLAY_CLOCK_SYNC, True):
+            return
+        addr = env_util.get_str(env_util.HVD_METRICS_KV_ADDR)
+        port = env_util.get_int(env_util.HVD_METRICS_KV_PORT, 0)
+        if not addr or not port:
+            return
+        secret_hex = env_util.get_str(env_util.HVD_METRICS_SECRET)
+        secret = bytes.fromhex(secret_hex) if secret_hex else None
+        try:
+            from .replay.clock import estimate_offset
+
+            est = estimate_offset(
+                addr, port, secret=secret,
+                samples=env_util.get_int(
+                    env_util.HVD_REPLAY_CLOCK_SAMPLES, 8),
+                local_clock_us=self._ts_us,
+            )
+            est["rank"] = rank
+            with open(os.path.join(rank_dir, "clock_sync.json"), "w") as f:
+                json.dump(est, f, indent=1)
+            log.debug("clock sync: offset %.1f us (rtt %.1f us)",
+                      est["offset_us"], est["rtt_us"])
+        except Exception as e:  # noqa: BLE001
+            log.debug("clock sync skipped: %s", e)
 
     def shutdown(self) -> None:
         with self._lock:

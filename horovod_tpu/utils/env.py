@@ -87,6 +87,11 @@ HVD_METRICS_PUSH_SECONDS = "HVD_METRICS_PUSH_SECONDS"  # push interval (default 
 HVD_SANITIZER = "HVD_SANITIZER"                        # 1 fingerprints every eager dispatch
 HVD_SANITIZER_TIMEOUT_SECONDS = "HVD_SANITIZER_TIMEOUT_SECONDS"  # peer wait (default 60)
 HVD_LINT_DISABLE = "HVD_LINT_DISABLE"                  # comma list of rule IDs hvd_lint skips
+# dPRO-style replay engine (horovod_tpu/timeline/replay/)
+HVD_REPLAY_CLOCK_SYNC = "HVD_REPLAY_CLOCK_SYNC"        # 0 skips the init-time clock handshake
+HVD_REPLAY_CLOCK_SAMPLES = "HVD_REPLAY_CLOCK_SAMPLES"  # handshake round trips (default 8)
+HVD_REPLAY_ICI_GBPS = "HVD_REPLAY_ICI_GBPS"            # what-if link bandwidth, GB/s (default 186)
+HVD_REPLAY_HOP_US = "HVD_REPLAY_HOP_US"                # what-if per-hop latency, µs (default 1)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67

@@ -1,0 +1,163 @@
+"""Replay a merged byteprofile trace: critical path + what-if scenarios.
+
+The dPRO-style closer for the capture stack: stitch
+``<trace_dir>/<rank>/comm.json`` + Recorder artifacts into a global
+per-step DAG (clock-aligned via each rank's ``clock_sync.json``), report
+the critical path and {compute, negotiation, comm, idle} attribution,
+and rank what-if scenarios (remove straggler, scale ICI bandwidth,
+perfect overlap, fuse-all re-batching) by predicted speedup.
+
+Run::
+
+    python scripts/hvd_replay.py <trace_dir> \
+        [--step N] [--json] [--out summary.json] \
+        [--annotated replay_trace.json] \
+        [--push host:port [--secret HEX]]    # serve via GET /replay
+    python scripts/hvd_replay.py --check     # fixture self-test (tier-1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.timeline.replay import analyze, annotated_trace  # noqa: E402
+
+
+def run_check() -> int:
+    """Self-test on the hand-computed fixture: the critical path must
+    match exactly and the remove-straggler prediction within 5% — the
+    acceptance bar the engine's unit tests also pin."""
+    from horovod_tpu.timeline.replay.fixture import write_fixture_trace
+
+    with tempfile.TemporaryDirectory(prefix="hvd_replay_check_") as d:
+        exp = write_fixture_trace(d)
+        res = analyze(d)
+        s = res.summary["steps"][0]
+        errors = []
+        if abs(s["replay_step_us"] - exp["makespan_us"]) > 1e-3:
+            errors.append(
+                f"makespan {s['replay_step_us']} != {exp['makespan_us']}")
+        got_cp = [(r["kind"], r["rank"], round(r["dur_us"], 3))
+                  for r in s["critical_path"]]
+        want_cp = [(r["kind"], r.get("rank"), r["dur_us"])
+                   for r in exp["critical_path"]]
+        if got_cp != want_cp:
+            errors.append(f"critical path {got_cp} != {want_cp}")
+        wi = {sc["scenario"]: sc["predicted_step_us"]
+              for sc in s["what_if"]["scenarios"]}
+        key = f"remove_straggler_rank_{exp['straggler_rank']}"
+        want = exp["remove_straggler_us"]
+        got = wi.get(key)
+        if got is None or abs(got - want) / want > 0.05:
+            errors.append(f"{key} predicted {got}, want {want} ±5%")
+        if not res.summary["clock_aligned"]:
+            errors.append("fixture clock offsets not applied")
+        if errors:
+            print("hvd_replay --check FAILED:", file=sys.stderr)
+            for e in errors:
+                print(f"  {e}", file=sys.stderr)
+            return 1
+        print(f"hvd_replay --check OK: critical path exact, "
+              f"{key} = {got:.1f} us (hand-computed {want:.1f})")
+        return 0
+
+
+def _print_text(summary: dict) -> None:
+    print(f"replayed {summary['trace_dir']}  "
+          f"ranks={summary['ranks']}  "
+          f"clock_aligned={summary['clock_aligned']}")
+    for s in summary["steps"]:
+        print(f"\nstep {s['step']}: measured {s['measured_step_us']:.1f} us,"
+              f" replay {s['replay_step_us']:.1f} us"
+              f" (error {s['replay_error_pct']}%)")
+        print("  critical path:")
+        for row in s["critical_path"]:
+            who = f"rank {row['rank']}" if row["rank"] is not None else \
+                "ranks " + ",".join(str(r) for r in row["ranks"] or ())
+            what = row["tensor"] or row["label"] or row["kind"]
+            print(f"    {row['start_us']:>10.1f} us  {row['kind']:<8} "
+                  f"{who:<10} {what:<24} {row['dur_us']:>9.1f} us")
+        print("  attribution (us):")
+        for rank, a in sorted(s["attribution"]["per_rank"].items(),
+                              key=lambda kv: int(kv[0])):
+            print(f"    rank {rank}: compute {a['compute_us']:>10.1f}  "
+                  f"comm {a['comm_us']:>9.1f}  "
+                  f"negotiation {a['negotiation_us']:>10.1f}  "
+                  f"idle {a['idle_us']:>9.1f}")
+        print("  what-if (ranked):")
+        for sc in s["what_if"]["scenarios"]:
+            print(f"    {sc['scenario']:<28} {sc['predicted_step_us']:>10.1f}"
+                  f" us  ({sc['speedup_pct']:+.1f}%)")
+    if summary["recommendations"]:
+        best = summary["recommendations"][0]
+        print(f"\nbest lever: {best['scenario']} (step {best['step']}) — "
+              f"predicted {best['predicted_step_us']:.1f} us, "
+              f"{best['speedup_pct']:+.1f}%")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="dPRO-style replay: critical path + what-if over a "
+                    "merged trace dir")
+    p.add_argument("trace_dir", nargs="?",
+                   help="timeline dir (HVD_TIMELINE target)")
+    p.add_argument("--step", type=int, default=None,
+                   help="replay only this step number")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable summary on stdout")
+    p.add_argument("--out", default=None,
+                   help="also write the summary JSON here")
+    p.add_argument("--annotated", default=None,
+                   help="write the merged Chrome trace with the critical "
+                        "path highlighted (default off; pass a path)")
+    p.add_argument("--push", default=None, metavar="HOST:PORT",
+                   help="publish the summary to the rendezvous server so "
+                        "GET /replay serves it")
+    p.add_argument("--secret", default=None,
+                   help="hex HMAC secret for --push (HVD_RUN_SECRET "
+                        "equivalent)")
+    p.add_argument("--check", action="store_true",
+                   help="self-test on the built-in hand-computed fixture")
+    args = p.parse_args(argv)
+
+    if args.check:
+        sys.exit(run_check())
+    if not args.trace_dir:
+        p.error("trace_dir is required (or use --check)")
+    push_host = push_port = None
+    if args.push:
+        push_host, _, port_s = args.push.partition(":")
+        if not push_host or not port_s.isdigit():
+            p.error(f"--push wants HOST:PORT, got {args.push!r}")
+        push_port = int(port_s)
+
+    result = analyze(args.trace_dir, step=args.step)
+    summary = result.summary
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+    if args.annotated:
+        annotated_trace(args.trace_dir, result, out_path=args.annotated)
+    if args.push:
+        from horovod_tpu.run.http_client import put_replay_summary
+
+        secret = bytes.fromhex(args.secret) if args.secret else None
+        put_replay_summary(push_host, push_port, summary, secret=secret)
+        print(f"pushed summary -> GET http://{args.push}/replay",
+              file=sys.stderr)
+
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        _print_text(summary)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,48 @@ def test_hlo_parser_multidim_async_start():
     assert cols["collective-permute"]["bytes"] == 128 * 256 * 4
 
 
+def test_per_tensor_table_predicted_vs_measured():
+    """The per-tensor cost table: predicted from the same α–β model the
+    scaling curves and the replay what-ifs use, measured joined by
+    tensor name, error surfaced."""
+    from horovod_tpu.timeline.comm_report import (
+        per_tensor_table, predict_collective_us,
+    )
+
+    tensors = {
+        "g0": {"op": "all-reduce", "bytes": 4 * 1024 * 1024, "calls": 1},
+        "g1": {"op": "all-gather", "bytes": 1024, "calls": 2},
+    }
+    table = per_tensor_table(tensors, 8,
+                             measured_us={"g0": 300.0})
+    assert set(table) == {"g0", "g1"}
+    want_g0 = predict_collective_us("all-reduce", 4 * 1024 * 1024, 8)
+    assert table["g0"]["predicted_us"] == pytest.approx(want_g0, abs=1e-3)
+    assert table["g0"]["measured_us"] == 300.0
+    assert "model_error_pct" in table["g0"]
+    # no measurement for g1 -> prediction only
+    assert "measured_us" not in table["g1"]
+    # the α term scales with calls
+    one = per_tensor_table({"g": {"op": "all-gather", "bytes": 1024,
+                                  "calls": 1}}, 8)["g"]["predicted_us"]
+    assert table["g1"]["predicted_us"] > one
+
+
+def test_predict_collective_us_matches_model_scaling():
+    """predict_collective_us IS model_scaling's per-op term — the two
+    must never drift (the replay engine relies on this equality)."""
+    from horovod_tpu.timeline.comm_report import (
+        model_scaling, predict_collective_us,
+    )
+
+    cols = {"all-reduce": {"count": 3, "bytes": 10_000_000}}
+    comm_seconds, _ = model_scaling(cols, None, sizes=(8,))
+    want_us = comm_seconds[8] * 1e6
+    got_us = predict_collective_us("all-reduce", 10_000_000, 8, calls=3)
+    # model_scaling rounds to whole µs (round(t, 6) in seconds)
+    assert got_us == pytest.approx(want_us, abs=1.0)
+
+
 def test_latency_term_separates_fused_from_per_tensor():
     """The α (per-collective latency) term: one fused 100 MB allreduce
     beats 160 per-tensor allreduces of the same total bytes — the

@@ -86,6 +86,139 @@ def test_merge_empty_dir_raises(tmp_path):
         merge_mod.merge_traces(str(tmp_path))
 
 
+def test_load_rank_events_empty_or_whitespace_file(tmp_path):
+    """A rank that initialized its writer but never recorded (empty or
+    whitespace-only comm.json) is an empty trace, not a JSON error."""
+    p = tmp_path / "comm.json"
+    p.write_text("")
+    assert merge_mod.load_rank_events(str(p)) == []
+    p.write_text("  \n\t ")
+    assert merge_mod.load_rank_events(str(p)) == []
+    p.write_text("[\n")
+    assert merge_mod.load_rank_events(str(p)) == []
+
+
+def test_merge_with_an_empty_rank(two_rank_dir, tmp_path):
+    """An initialized-but-silent rank merges as an empty row group
+    instead of crashing the whole merge."""
+    d = two_rank_dir / "2"
+    d.mkdir()
+    (d / "comm.json").write_text("")
+    merged = merge_mod.merge_traces(str(two_rank_dir))
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1, 2}  # rank 2 present via its metadata events
+
+
+def test_rank_discovery_ignores_non_numeric_subdirs(two_rank_dir):
+    """Output artifacts (merged_trace.json) and stray dirs ('logs',
+    'xla_trace') next to the rank dirs must not break discovery."""
+    (two_rank_dir / "logs").mkdir()
+    (two_rank_dir / "logs" / "comm.json").write_text("[]")
+    (two_rank_dir / "merged_trace.json").write_text("{}")
+    ranks = merge_mod.discover_ranks(str(two_rank_dir))
+    assert sorted(ranks) == [0, 1]
+
+
+def test_negotiation_x_phase_events(tmp_path):
+    """Complete-span ('X') negotiation events — the native writer's
+    form — contribute their dur to the per-tensor waits."""
+    _write_rank(tmp_path, 0, [
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": "t", "ph": "X",
+         "ts": 10.0, "dur": 120.0, "pid": 0, "tid": "t"}])
+    _write_rank(tmp_path, 1, [
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": "t", "ph": "X",
+         "ts": 10.0, "dur": 20.0, "pid": 1, "tid": "t"}])
+    report = merge_mod.straggler_report(str(tmp_path))
+    (row,) = report["tensors"]
+    assert row["per_rank_wait_us"] == {"0": 120.0, "1": 20.0}
+    assert row["straggler_rank"] == 1
+
+
+def test_straggler_report_top_truncation(tmp_path):
+    """--top keeps only the N widest spreads, widest first."""
+    for rank in (0, 1):
+        evs = []
+        for i in range(5):
+            # spread grows with i: rank 1 always waits 10, rank 0 waits
+            # 10 + 100*i
+            wait = 10.0 + (100.0 * i if rank == 0 else 0.0)
+            evs += _negotiate_events(f"t{i}", "ALLREDUCE",
+                                     1000.0 * i, wait, pid=rank)
+        _write_rank(tmp_path, rank, evs)
+    full = merge_mod.straggler_report(str(tmp_path))
+    assert len(full["tensors"]) == 5
+    top2 = merge_mod.straggler_report(str(tmp_path), top=2)
+    assert [r["tensor"] for r in top2["tensors"]] == ["t4", "t3"]
+    # rank summaries keep covering every rank even when truncated
+    assert set(top2["ranks"]) == {"0", "1"}
+
+
+def test_unmatched_spans_surfaced(tmp_path):
+    """A repeated 'B' for the same key (lost 'E'), a stray 'E', and a
+    dangling 'B' at end-of-trace are counted, not silently dropped —
+    the truncated-live-trace diagnosis the report needs."""
+    _write_rank(tmp_path, 0, [
+        # B overwritten by a second B (first one lost its E)
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": "t", "ph": "B", "ts": 0.0,
+         "pid": 0, "tid": "t"},
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": "t", "ph": "B", "ts": 50.0,
+         "pid": 0, "tid": "t"},
+        {"name": "NEGOTIATE_ALLREDUCE", "cat": "t", "ph": "E", "ts": 80.0,
+         "pid": 0, "tid": "t"},
+        # stray E with no open span
+        {"name": "NEGOTIATE_BROADCAST", "cat": "u", "ph": "E", "ts": 90.0,
+         "pid": 0, "tid": "u"},
+        # dangling B, trace truncated
+        {"name": "NEGOTIATE_ALLGATHER", "cat": "v", "ph": "B", "ts": 95.0,
+         "pid": 0, "tid": "v"},
+    ])
+    _write_rank(tmp_path, 1, _negotiate_events("t", "ALLREDUCE", 0.0, 30.0,
+                                               pid=1))
+    waits, unmatched = merge_mod.negotiation_waits(
+        merge_mod.load_rank_events(str(tmp_path / "0" / "comm.json")))
+    assert unmatched == 3
+    # the surviving pair still measures: 80 - 50 = 30
+    assert waits["t"]["wait_us"] == pytest.approx(30.0)
+    report = merge_mod.straggler_report(str(tmp_path))
+    assert report["ranks"]["0"]["unmatched_spans"] == 3
+    assert report["ranks"]["1"]["unmatched_spans"] == 0
+
+
+def test_merge_applies_clock_offsets(tmp_path):
+    """With a clock_sync.json sidecar on EVERY rank, events shift onto
+    the shared clock (earliest-offset rank stays put)."""
+    _write_rank(tmp_path, 0, [{"name": "A", "ph": "X", "ts": 100.0,
+                               "dur": 1.0, "pid": 0, "tid": "t"}])
+    _write_rank(tmp_path, 1, [{"name": "A", "ph": "X", "ts": 100.0,
+                               "dur": 1.0, "pid": 1, "tid": "t"}])
+    (tmp_path / "0" / "clock_sync.json").write_text(
+        json.dumps({"offset_us": 5.0}))
+    (tmp_path / "1" / "clock_sync.json").write_text(
+        json.dumps({"offset_us": 30.0}))
+    merged = merge_mod.merge_traces(str(tmp_path))
+    assert merged["otherData"]["clock_aligned"] is True
+    ts = {e["pid"]: e["ts"] for e in merged["traceEvents"]
+          if e.get("ph") == "X"}
+    assert ts[0] == pytest.approx(100.0)       # min offset: unshifted
+    assert ts[1] == pytest.approx(125.0)       # +25 relative
+
+
+def test_merge_partial_offsets_not_applied(tmp_path):
+    """Offsets for a strict subset of ranks are worse than none —
+    nothing shifts and the trace says so."""
+    _write_rank(tmp_path, 0, [{"name": "A", "ph": "X", "ts": 100.0,
+                               "dur": 1.0, "pid": 0, "tid": "t"}])
+    _write_rank(tmp_path, 1, [{"name": "A", "ph": "X", "ts": 100.0,
+                               "dur": 1.0, "pid": 1, "tid": "t"}])
+    (tmp_path / "1" / "clock_sync.json").write_text(
+        json.dumps({"offset_us": 30.0}))
+    merged = merge_mod.merge_traces(str(tmp_path))
+    assert merged["otherData"]["clock_aligned"] is False
+    ts = {e["pid"]: e["ts"] for e in merged["traceEvents"]
+          if e.get("ph") == "X"}
+    assert ts == {0: 100.0, 1: 100.0}
+
+
 def test_straggler_report(two_rank_dir):
     report = merge_mod.straggler_report(str(two_rank_dir))
     by_tensor = {r["tensor"]: r for r in report["tensors"]}
