@@ -104,3 +104,8 @@ from .optim import (  # noqa: F401
     broadcast_variables,
 )
 from .elastic.join import join, join_allreduce  # noqa: F401
+from .elastic import (  # noqa: F401
+    ElasticState,
+    HorovodAbortError,
+    abort,
+)

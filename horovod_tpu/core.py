@@ -263,6 +263,15 @@ def init(
             start_pusher_from_env(_state.process_index)
         except Exception as e:  # noqa: BLE001 — metrics must never
             log.warning("metrics pusher setup failed: %s", e)  # block init
+        # Heartbeat leases + coordinated-abort polling (elastic/
+        # heartbeat.py): active when the launcher exported rendezvous
+        # wiring and this is a multi-process job.
+        try:
+            from .elastic.heartbeat import start_from_env
+
+            start_from_env()
+        except Exception as e:  # noqa: BLE001 — liveness reporting must
+            log.warning("heartbeat setup failed: %s", e)  # never block init
 
 
 def shutdown() -> None:
@@ -285,6 +294,12 @@ def shutdown() -> None:
         from .metrics.push import stop_pusher
 
         stop_pusher()  # flushes one final snapshot to the launcher
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .elastic import heartbeat
+
+        heartbeat.stop()
     except Exception:  # noqa: BLE001
         pass
     with _lock:

@@ -34,6 +34,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import core, metrics
 from .analysis import sanitizer as _sanitizer
+from .elastic import faults as _faults
+from .elastic import heartbeat as _heartbeat
 from .spmd import put_per_rank, get_per_rank, rank_context
 from .core import Average, Sum, Adasum, Min, Max
 from .ops import collectives
@@ -58,6 +60,12 @@ def _dispatch_guard(name: str, op: str, tensors):
         sample = tensors[0] if _is_per_rank_list(tensors) else tensors
         shape = np.shape(sample)
         dtype = getattr(sample, "dtype", "float32")
+        # First: a coordinated abort must surface HERE, before this rank
+        # enters a collective its dead peer will never join (elastic/
+        # heartbeat.py polls the flag; docs/fault_tolerance.md).  The
+        # fault harness's dispatch-seam faults fire at the same point.
+        _heartbeat.maybe_raise_abort()
+        _faults.on_dispatch(name)
         # Before the watchdog/negotiation: a divergence must raise the
         # sanitizer's diagnostic, not mature into a stall warning first.
         _sanitizer.maybe_check(op=op, name=name, shape=shape, dtype=dtype)

@@ -1,0 +1,17 @@
+"""Elastic / failure-domain runtime.
+
+Two halves:
+
+* **join** (join.py) — uneven-data participation, the reference's
+  ``hvd.join()`` contract in compiled-SPMD form.
+* **failure-domain runtime** (abort.py, heartbeat.py, state.py,
+  faults.py; docs/fault_tolerance.md) — heartbeat leases with a
+  ``GET /health`` view, one job-wide coordinated abort flag raised as
+  :class:`HorovodAbortError` at the dispatch/train-step seams,
+  :class:`ElasticState` auto-resume under ``tpurun --restarts``, and the
+  ``HVD_FAULT_SPEC`` fault-injection harness that tests all of it.
+"""
+
+from .abort import HorovodAbortError, abort  # noqa: F401
+from .state import ElasticState  # noqa: F401
+from . import faults, heartbeat  # noqa: F401

@@ -1,0 +1,256 @@
+"""Fault-injection harness: drive detect→abort→restart→resume on purpose.
+
+A fault-tolerance path that only runs when hardware actually dies is an
+untested path.  ``HVD_FAULT_SPEC`` injects failures at three seams so
+tests (tests/test_elastic_runtime.py and the tier-1 tpurun smoke) exercise
+the full failure-domain loop deterministically:
+
+* **step** — the train-step callback (training.py) and any loop that
+  calls :func:`on_step` directly;
+* **dispatch** — every eager collective dispatch
+  (eager._dispatch_guard);
+* **http** — the rendezvous HTTP client (run/http_client.py), to
+  exercise its retry/backoff path.
+
+Grammar (specs separated by ``;``, fields by ``:``, ``key=value``)::
+
+    HVD_FAULT_SPEC="rank=1:step=3:kind=crash"
+    HVD_FAULT_SPEC="rank=*:kind=slow=200ms:prob=0.5;rank=0:step=10:kind=hang"
+    HVD_FAULT_SPEC="kind=http_drop:prob=0.3:restart=*"
+
+Fields:
+
+``rank``     int or ``*`` (default ``*``): the HVD_PROCESS_ID it fires on.
+``step``     int or ``*`` (default ``*``): the 0-based invocation counter
+             of the seam in this process (each seam counts separately).
+``kind``     ``crash`` (``os._exit(17)`` — a sudden worker death),
+             ``hang`` (sleep forever, the wedged-collective shape),
+             ``slow=<dur>`` (inject ``<dur>`` latency, e.g. ``200ms`` /
+             ``1.5s``, then continue), or ``http_drop`` (raise
+             ``URLError`` from the HTTP client).
+``prob``     float in [0, 1] (default 1.0).
+``seam``     ``step`` / ``dispatch`` / ``http``; defaults to ``http``
+             for ``http_drop`` and ``step`` otherwise.
+``restart``  int or ``*`` (default 0): the ``HVD_RESTART_COUNT``
+             incarnation the fault applies to.  The default means a
+             crash fires on the first run only, so a supervised restart
+             (tpurun --restarts) relaunches into a clean incarnation.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: exit code of an injected ``crash`` — distinguishable from real failures
+#: in launcher logs and test assertions.
+FAULT_EXIT_CODE = 17
+
+KINDS = ("crash", "hang", "slow", "http_drop")
+SEAMS = ("step", "dispatch", "http")
+
+_DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m)?$")
+_DUR_SCALE = {"ms": 1e-3, "s": 1.0, "m": 60.0, None: 1.0}
+
+
+class FaultSpecError(ValueError):
+    """``HVD_FAULT_SPEC`` did not parse; the message pins the bad field."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str
+    seam: str
+    rank: Optional[int] = None      # None = any rank
+    step: Optional[int] = None      # None = every invocation
+    restart: Optional[int] = 0      # None = every incarnation
+    prob: float = 1.0
+    duration: float = 0.0           # slow: injected latency, seconds
+
+
+def parse_duration(text: str) -> float:
+    m = _DURATION.match(text.strip())
+    if not m:
+        raise FaultSpecError(f"bad duration {text!r} (want e.g. 200ms, 1.5s)")
+    return float(m.group(1)) * _DUR_SCALE[m.group(2)]
+
+
+def _int_or_any(value: str, field: str) -> Optional[int]:
+    if value == "*":
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        raise FaultSpecError(f"bad {field}={value!r} (want an int or '*')")
+
+
+def parse_spec(text: str) -> List[Fault]:
+    """Parse one ``HVD_FAULT_SPEC`` value into its fault list."""
+    faults: List[Fault] = []
+    for chunk in text.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = {}
+        for field in chunk.split(":"):
+            key, sep, value = field.partition("=")
+            key = key.strip()
+            if not sep or not key:
+                raise FaultSpecError(
+                    f"bad field {field!r} in {chunk!r} (want key=value)")
+            fields[key] = value.strip()
+        unknown = set(fields) - {"rank", "step", "kind", "prob", "seam",
+                                 "restart"}
+        if unknown:
+            raise FaultSpecError(
+                f"unknown field(s) {sorted(unknown)} in {chunk!r}")
+        if "kind" not in fields:
+            raise FaultSpecError(f"missing kind= in {chunk!r}")
+        kind, _, arg = fields["kind"].partition("=")
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown kind {kind!r} in {chunk!r} (want one of {KINDS})")
+        duration = 0.0
+        if kind == "slow":
+            if not arg:
+                raise FaultSpecError(
+                    f"kind=slow needs a duration (slow=200ms) in {chunk!r}")
+            duration = parse_duration(arg)
+        elif arg:
+            raise FaultSpecError(
+                f"kind={kind} takes no argument (got {arg!r}) in {chunk!r}")
+        seam = fields.get("seam", "http" if kind == "http_drop" else "step")
+        if seam not in SEAMS:
+            raise FaultSpecError(
+                f"unknown seam {seam!r} in {chunk!r} (want one of {SEAMS})")
+        prob = float(fields.get("prob", 1.0))
+        if not 0.0 <= prob <= 1.0:
+            raise FaultSpecError(f"prob={prob} out of [0, 1] in {chunk!r}")
+        faults.append(Fault(
+            kind=kind, seam=seam,
+            rank=_int_or_any(fields.get("rank", "*"), "rank"),
+            step=_int_or_any(fields.get("step", "*"), "step"),
+            restart=_int_or_any(fields.get("restart", "0"), "restart"),
+            prob=prob, duration=duration,
+        ))
+    return faults
+
+
+class FaultInjector:
+    """One process's armed fault set.  Each seam keeps its own 0-based
+    invocation counter; a matching fault acts when the counter, rank,
+    incarnation, and probability all line up."""
+
+    def __init__(self, faults: List[Fault], rank: int, restart: int):
+        self.faults = list(faults)
+        self.rank = int(rank)
+        self.restart = int(restart)
+        self._counts = {seam: 0 for seam in SEAMS}
+        self._lock = threading.Lock()
+
+    def fire(self, seam: str, detail: str = "") -> None:
+        with self._lock:
+            n = self._counts[seam]
+            self._counts[seam] = n + 1
+        for f in self.faults:
+            if f.seam != seam:
+                continue
+            if f.rank is not None and f.rank != self.rank:
+                continue
+            if f.restart is not None and f.restart != self.restart:
+                continue
+            if f.step is not None and f.step != n:
+                continue
+            if f.prob < 1.0 and random.random() >= f.prob:
+                continue
+            self._act(f, seam, n, detail)
+
+    def _act(self, f: Fault, seam: str, n: int, detail: str) -> None:
+        from .. import metrics
+
+        if metrics.on():
+            metrics.FAULTS_INJECTED.labels(f.kind).inc()
+        log.warning("fault injection: %s at %s[%d] rank=%d restart=%d %s",
+                    f.kind, seam, n, self.rank, self.restart, detail)
+        if f.kind == "crash":
+            os._exit(FAULT_EXIT_CODE)
+        elif f.kind == "hang":
+            while True:  # the wedged-worker shape: only a signal ends it
+                time.sleep(3600)
+        elif f.kind == "slow":
+            time.sleep(f.duration)
+        elif f.kind == "http_drop":
+            import urllib.error
+
+            raise urllib.error.URLError(
+                f"injected http_drop at {seam}[{n}] {detail}")
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring (built lazily from HVD_FAULT_SPEC, like the sanitizer)
+# ---------------------------------------------------------------------------
+_UNSET = object()
+_instance = _UNSET
+_instance_lock = threading.Lock()
+
+
+def _build_from_env() -> Optional[FaultInjector]:
+    spec = env_util.get_str(env_util.HVD_FAULT_SPEC)
+    if not spec:
+        return None
+    faults = parse_spec(spec)  # a malformed spec must fail loudly, not arm 0
+    if not faults:
+        return None
+    rank = env_util.get_int(env_util.HVD_PROCESS_ID, 0)
+    restart = env_util.get_int(env_util.HVD_RESTART_COUNT, 0)
+    inj = FaultInjector(faults, rank, restart)
+    log.warning("fault injection armed: %d fault(s) on rank %d "
+                "(incarnation %d): %s", len(faults), rank, restart, spec)
+    return inj
+
+
+def instance() -> Optional[FaultInjector]:
+    global _instance
+    if _instance is _UNSET:
+        with _instance_lock:
+            if _instance is _UNSET:
+                _instance = _build_from_env()
+    return _instance
+
+
+def reset() -> None:
+    """Drop the cached injector (tests / re-init re-read the env)."""
+    global _instance
+    with _instance_lock:
+        _instance = _UNSET
+
+
+def on_step() -> None:
+    """The train-step seam (training.py; callable from any train loop)."""
+    inj = instance()
+    if inj is not None:
+        inj.fire("step")
+
+
+def on_dispatch(name: str) -> None:
+    """The eager-dispatch seam (eager._dispatch_guard)."""
+    inj = instance()
+    if inj is not None:
+        inj.fire("dispatch", detail=name)
+
+
+def on_http(path: str) -> None:
+    """The HTTP-client seam (run/http_client._request)."""
+    inj = instance()
+    if inj is not None:
+        inj.fire("http", detail=path)

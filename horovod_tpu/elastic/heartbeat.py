@@ -1,0 +1,178 @@
+"""Heartbeat leases: liveness that is observable *before* a collective
+times out.
+
+Each rank runs one daemon thread that, every
+``HVD_HEARTBEAT_INTERVAL_SECONDS`` (default 2):
+
+* renews this rank's lease — a signed PUT of ``{rank, count, interval,
+  pid}`` into the rendezvous server's ``health`` scope (the server stamps
+  the receipt on *its* clock, so lease age needs no cross-host clock
+  agreement; ``GET /health`` renders per-rank age and a
+  live/stale/dead verdict, run/http_server.py);
+* polls the job-wide abort flag (elastic/abort.py).  When set, the next
+  eager dispatch (eager._dispatch_guard) or train step (training.py)
+  raises :class:`~horovod_tpu.elastic.abort.HorovodAbortError` naming the
+  failing rank and reason — surviving ranks exit in seconds with a root
+  cause instead of hanging until a transport timeout.
+
+Wiring mirrors the metrics pusher and sanitizer: the launcher exports
+``HVD_METRICS_KV_ADDR``/``PORT``/``HVD_METRICS_SECRET`` and
+``core.init()`` calls :func:`start_from_env`; ``HVD_HEARTBEAT_DISABLE=1``
+turns the plane off.  Lease loss is tolerated (the next interval renews);
+the thread never raises into the training process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from ..run.http_server import (  # noqa: F401 — wire constants live with
+    ABORT_KEY,                   # the server; HEALTH_SCOPE re-exported
+    ABORT_SCOPE,                 # for the runtime side
+    HEALTH_SCOPE,
+)
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+from .abort import HorovodAbortError, format_abort
+
+log = get_logger(__name__)
+
+
+class HeartbeatThread(threading.Thread):
+    """One rank's lease renewer + abort poller."""
+
+    def __init__(self, rank: int, size: int, addr: str, port: int,
+                 secret: Optional[bytes] = None,
+                 interval: Optional[float] = None):
+        super().__init__(daemon=True, name="hvd-heartbeat")
+        self.rank = int(rank)
+        self.size = int(size)
+        self.addr = addr
+        self.port = int(port)
+        self.secret = secret
+        self.interval = float(
+            interval if interval is not None
+            else env_util.get_float(
+                env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
+                env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS,
+            )
+        )
+        self.abort_info: Optional[dict] = None
+        self.beats = 0
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        self.beat()  # publish the first lease before any wait
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def beat(self) -> None:
+        """One tick: renew the lease, then poll the abort flag.  Never
+        raises — a flaky rendezvous link must not take the rank down; the
+        retrying HTTP client (HVD_HTTP_RETRIES) absorbs transients."""
+        from ..run.http_client import get_kv, put_kv
+
+        lease = {
+            "rank": self.rank,
+            "count": self.beats,
+            "interval": self.interval,
+            "pid": os.getpid(),
+        }
+        try:
+            put_kv(self.addr, self.port, HEALTH_SCOPE, str(self.rank),
+                   json.dumps(lease).encode(), secret=self.secret)
+            self.beats += 1
+            from .. import metrics
+
+            if metrics.on():
+                metrics.HEARTBEATS.inc()
+        except Exception as e:  # noqa: BLE001
+            log.debug("heartbeat lease renewal failed: %s", e)
+        try:
+            raw = get_kv(self.addr, self.port, ABORT_SCOPE, ABORT_KEY,
+                         secret=self.secret)
+        except Exception as e:  # noqa: BLE001
+            log.debug("heartbeat abort poll failed: %s", e)
+            return
+        if raw is not None and self.abort_info is None:
+            try:
+                self.abort_info = json.loads(raw)
+            except (ValueError, TypeError):
+                self.abort_info = {"reason": "<undecodable abort flag>",
+                                   "source": "unknown"}
+            log.error("heartbeat observed %s", format_abort(self.abort_info))
+            from .. import metrics
+
+            if metrics.on():
+                metrics.ABORTS.labels("observed").inc()
+            self._stop.set()  # no point renewing a lease on a dead job
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring (core.init / the train-step and dispatch seams)
+# ---------------------------------------------------------------------------
+_instance: Optional[HeartbeatThread] = None
+_lock = threading.Lock()
+
+
+def start(rank: int, size: int, addr: str, port: int,
+          secret: Optional[bytes] = None,
+          interval: Optional[float] = None) -> HeartbeatThread:
+    """Start (or replace) the process-wide heartbeat thread."""
+    global _instance
+    with _lock:
+        if _instance is not None:
+            _instance.stop()
+        _instance = HeartbeatThread(rank, size, addr, port,
+                                    secret=secret, interval=interval)
+        _instance.start()
+        log.info("heartbeat active: rank %d/%d via %s:%d every %.1fs",
+                 _instance.rank, _instance.size, addr, port,
+                 _instance.interval)
+        return _instance
+
+
+def start_from_env() -> Optional[HeartbeatThread]:
+    """Launcher-driven activation: no-op unless this is a multi-process
+    job with rendezvous wiring (tpurun / run() export it) and
+    ``HVD_HEARTBEAT_DISABLE`` is unset."""
+    if env_util.get_bool(env_util.HVD_HEARTBEAT_DISABLE):
+        return None
+    size = env_util.get_int(env_util.HVD_NUM_PROCESSES, 1)
+    if size <= 1:
+        return None  # a single process has no peers to outlive it
+    addr = env_util.get_str(env_util.HVD_METRICS_KV_ADDR)
+    port = env_util.get_int(env_util.HVD_METRICS_KV_PORT, 0)
+    if not addr or not port:
+        return None
+    secret_hex = env_util.get_str(env_util.HVD_METRICS_SECRET)
+    secret = bytes.fromhex(secret_hex) if secret_hex else None
+    rank = env_util.get_int(env_util.HVD_PROCESS_ID, 0)
+    return start(rank, size, addr, port, secret=secret)
+
+
+def instance() -> Optional[HeartbeatThread]:
+    return _instance
+
+
+def stop() -> None:
+    """Stop and drop the process heartbeat (core.shutdown / tests)."""
+    global _instance
+    with _lock:
+        if _instance is not None:
+            _instance.stop()
+            _instance = None
+
+
+def maybe_raise_abort() -> None:
+    """The dispatch/train-step seam: raise if the heartbeat observed the
+    job-wide abort flag.  One attribute read when nothing is wrong."""
+    hb = _instance
+    if hb is not None and hb.abort_info is not None:
+        raise HorovodAbortError(format_abort(hb.abort_info))

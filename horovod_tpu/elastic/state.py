@@ -1,0 +1,78 @@
+"""ElasticState: the auto-resume half of supervised restart.
+
+The supervisor (run/run.py ``tpurun --restarts N``) relaunches a failed
+job with ``HVD_RESTART_COUNT`` exported; this module is what the training
+script pairs with it so a relaunch *continues* instead of starting over::
+
+    state = {"params": params, "opt_state": opt_state}
+    es = hvd.elastic.ElasticState("gs://ckpts/run1", state)
+    state, start_step = es.resume()      # no-op on a fresh run
+    for step in range(start_step, total_steps):
+        state = train_step(state, ...)
+        if step % ckpt_every == 0:
+            es.state = state
+            es.save(step + 1)            # rank 0 writes step_{N}
+
+On restart every rank restores the newest ``step_N`` checkpoint through
+``utils/checkpoint.py`` (rank-consistent step choice + root-broadcast
+restore), so the job loses at most one checkpoint interval — the
+reference's broadcast-on-start resume contract (SURVEY §5), now driven
+automatically by the failure-domain runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from .. import core
+from ..utils import env as env_util
+from ..utils.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class ElasticState:
+    """A checkpoint directory paired with the live training state."""
+
+    def __init__(self, path: str, state: Any):
+        self.path = path
+        self.state = state
+        self.step = 0
+
+    @property
+    def restart_count(self) -> int:
+        """Which incarnation this is (0 = first launch); set by the
+        supervisor on every relaunch."""
+        return env_util.get_int(env_util.HVD_RESTART_COUNT, 0)
+
+    def save(self, step: int) -> Optional[str]:
+        """Checkpoint the current state as ``step_{step}`` (rank 0 writes;
+        returns the written path there, None elsewhere)."""
+        out = save_checkpoint(self.path, self.state, step=step)
+        self.step = int(step)
+        return out
+
+    def resume(self) -> Tuple[Any, int]:
+        """Restore the newest checkpoint under ``path`` and return
+        ``(state, step)``; a fresh run returns the initial state and 0.
+
+        Multi-process: the step choice is broadcast from rank 0 so every
+        rank restores the same checkpoint even when only root can list
+        the directory; the restore itself rides ``restore_checkpoint``'s
+        agreement round (root failures surface on every rank)."""
+        step = latest_step(self.path)
+        if core.is_initialized() and core.process_size() > 1:
+            from .. import eager
+
+            step = eager.broadcast_object(step)
+        if step is None:
+            log.info("elastic resume: no checkpoint under %s (incarnation "
+                     "%d starts fresh)", self.path, self.restart_count)
+            self.step = 0
+            return self.state, 0
+        self.state = restore_checkpoint(self.path, self.state, step=step)
+        self.step = int(step)
+        log.info("elastic resume: restored step %d from %s (incarnation %d)",
+                 self.step, self.path, self.restart_count)
+        return self.state, self.step

@@ -38,6 +38,11 @@ hvd_controller_stall_warnings   gauge      coordinator-side stall warnings
 hvd_join_events_total           counter    elastic host-plane join() calls
 hvd_sanitizer_checks_total      counter    sanitizer fingerprints verified
 hvd_sanitizer_mismatches_total  counter    sanitizer divergences raised
+hvd_heartbeats_total            counter    lease renewals pushed to /health
+hvd_aborts_total                counter    coordinated aborts, by ``source``
+hvd_http_retries_total          counter    rendezvous HTTP requests retried
+hvd_faults_injected_total       counter    HVD_FAULT_SPEC faults, by ``kind``
+hvd_restarts_total              counter    supervised job relaunches (launcher)
 ==============================  =========  ==================================
 """
 
@@ -132,6 +137,25 @@ SANITIZER_MISMATCHES = registry.counter(
     "hvd_sanitizer_mismatches_total",
     "Collective-sanitizer divergences detected (signature mismatch or "
     "silent peer).")
+
+HEARTBEATS = registry.counter(
+    "hvd_heartbeats_total",
+    "Heartbeat lease renewals pushed to the rendezvous /health scope.")
+ABORTS = registry.counter(
+    "hvd_aborts_total",
+    "Coordinated aborts by source plane (launcher/stall_inspector/api) "
+    "plus 'observed' on ranks whose heartbeat saw the flag.", ("source",))
+HTTP_RETRIES = registry.counter(
+    "hvd_http_retries_total",
+    "Rendezvous HTTP requests retried after a transient failure "
+    "(URLError or 5xx).")
+FAULTS_INJECTED = registry.counter(
+    "hvd_faults_injected_total",
+    "Faults injected by the HVD_FAULT_SPEC harness, by kind.", ("kind",))
+RESTARTS = registry.counter(
+    "hvd_restarts_total",
+    "Supervised job relaunches performed by the tpurun restart policy "
+    "(launcher-side).")
 
 
 def on() -> bool:

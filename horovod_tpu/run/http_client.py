@@ -1,39 +1,111 @@
 """Client for the rendezvous KV store (reference
 horovod/run/http/http_client.py: read_data_from_kvstore /
-put_data_into_kvstore)."""
+put_data_into_kvstore).
+
+Transient-failure policy: every request to the rendezvous server crosses
+a real network on a pod, so idempotent requests (GET/DELETE — the server
+is a plain KV store) are retried with exponential backoff + jitter on
+``URLError`` and 5xx responses.  PUTs are retried only when the caller
+opts in (``retry=True``) — the store's PUTs are last-writer-wins
+overwrites, so opting in is safe for keys with a single writer (the
+abort flag, heartbeat leases).  Knobs: ``HVD_HTTP_RETRIES`` (default 2
+retries after the first attempt) and ``HVD_HTTP_BACKOFF_MS`` (default
+50 ms base, doubled per attempt).  Retries surface as the
+``hvd_http_retries_total`` counter.  The ``HVD_FAULT_SPEC`` harness's
+``http_drop`` faults inject here (elastic/faults.py) so the retry path
+itself is testable.
+"""
 
 from __future__ import annotations
 
+import random
 import time
 import urllib.error
 import urllib.request
 from typing import Optional
 
+from ..utils import env as env_util
 from .http_server import SECRET_HEADER, sign
+
+#: methods safe to retry without opt-in: the server's GET/DELETE are
+#: idempotent (reads and prefix-deletes of a plain KV store)
+_IDEMPOTENT_METHODS = ("GET", "DELETE")
+
+
+def _record_retry() -> None:
+    """Count one retried request; never raises (the metrics plane must
+    not take down a rendezvous request)."""
+    try:
+        from .. import metrics
+
+        if metrics.on():
+            metrics.HTTP_RETRIES.inc()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _request(method: str, addr: str, port: int, path: str,
              body: bytes = b"", secret: Optional[bytes] = None,
-             timeout: float = 10.0):
+             timeout: float = 10.0, retries: Optional[int] = None):
+    """One HTTP request with bounded retries.  ``retries=None`` applies
+    the default policy: ``HVD_HTTP_RETRIES`` for idempotent methods,
+    0 for PUTs (callers opt in via an explicit count)."""
+    if retries is None:
+        retries = env_util.get_int(env_util.HVD_HTTP_RETRIES,
+                                   env_util.DEFAULT_HTTP_RETRIES) \
+            if method in _IDEMPOTENT_METHODS else 0
+    backoff = env_util.get_float(env_util.HVD_HTTP_BACKOFF_MS,
+                                 env_util.DEFAULT_HTTP_BACKOFF_MS) / 1000.0
     url = f"http://{addr}:{port}{path}"
-    req = urllib.request.Request(url, data=body if method == "PUT" else None,
-                                 method=method)
-    if secret is not None:
-        req.add_header(SECRET_HEADER, sign(secret, path, body))
-    return urllib.request.urlopen(req, timeout=timeout)
+    attempt = 0
+    while True:
+        req = urllib.request.Request(
+            url, data=body if method == "PUT" else None, method=method,
+        )
+        if secret is not None:
+            req.add_header(SECRET_HEADER, sign(secret, path, body))
+        try:
+            from ..elastic import faults
+
+            faults.on_http(path)  # inside the loop: drops exercise retries
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            # 4xx (404 rendezvous-miss, 401 bad secret) is a real answer,
+            # not a transient — only server errors are retried
+            if e.code < 500 or attempt >= retries:
+                raise
+        except urllib.error.URLError:
+            if attempt >= retries:
+                raise
+        attempt += 1
+        _record_retry()
+        # full jitter on top of the doubling base: concurrent ranks
+        # hammering a recovering server must not re-synchronize
+        time.sleep(backoff * (2 ** (attempt - 1))
+                   + random.uniform(0.0, backoff))
 
 
 def put_kv(addr: str, port: int, scope: str, key: str, value: bytes,
-           secret: Optional[bytes] = None) -> None:
-    with _request("PUT", addr, port, f"/{scope}/{key}", value, secret):
+           secret: Optional[bytes] = None, retry: bool = False,
+           timeout: float = 10.0) -> None:
+    """PUT one key.  ``retry=True`` opts this (non-idempotent but
+    last-writer-wins) write into the transient-failure retry policy —
+    use it for single-writer keys like the abort flag."""
+    retries = env_util.get_int(env_util.HVD_HTTP_RETRIES,
+                               env_util.DEFAULT_HTTP_RETRIES) if retry else 0
+    with _request("PUT", addr, port, f"/{scope}/{key}", value, secret,
+                  timeout=timeout, retries=retries):
         pass
 
 
 def get_kv(addr: str, port: int, scope: str, key: str,
            secret: Optional[bytes] = None,
            wait: bool = False, timeout: float = 60.0) -> Optional[bytes]:
-    """GET, optionally polling until the key appears (rendezvous wait)."""
+    """GET, optionally polling until the key appears (rendezvous wait).
+    The poll backs off from 50 ms toward a 1 s cap so a long rendezvous
+    wait is tens of requests, not ``timeout / 0.1`` of them."""
     deadline = time.monotonic() + timeout
+    delay = 0.05
     while True:
         try:
             with _request("GET", addr, port, f"/{scope}/{key}",
@@ -41,7 +113,8 @@ def get_kv(addr: str, port: int, scope: str, key: str,
                 return resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404 and wait and time.monotonic() < deadline:
-                time.sleep(0.1)
+                time.sleep(delay)
+                delay = min(delay * 1.5, 1.0)
                 continue
             if e.code == 404:
                 return None
@@ -71,6 +144,17 @@ def get_sanitizer(addr: str, port: int,
     import json
 
     with _request("GET", addr, port, "/sanitizer", secret=secret) as resp:
+        return json.loads(resp.read().decode())
+
+
+def get_health(addr: str, port: int,
+               secret: Optional[bytes] = None) -> dict:
+    """The failure-domain liveness view from ``GET /health``: per-rank
+    heartbeat lease age + live/stale/dead verdict (computed on the
+    server's clock) and the job-wide abort flag (None when unset)."""
+    import json
+
+    with _request("GET", addr, port, "/health", secret=secret) as resp:
         return json.loads(resp.read().decode())
 
 

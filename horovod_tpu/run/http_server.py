@@ -22,6 +22,13 @@ plus the launcher's own registry — as one Prometheus text page
 sanitizer (analysis/sanitizer.py, HVD_SANITIZER=1) publishes per-dispatch
 fingerprints into the ``sanitizer`` scope; ``GET /sanitizer`` renders
 the live table grouped by sequence number then rank.
+
+The failure-domain runtime rides it too (docs/fault_tolerance.md): ranks
+renew heartbeat leases under ``/health/<rank>`` (stamped on the server's
+clock at receipt), ``GET /health`` reports per-rank lease age with
+live/stale/dead verdicts plus the job-wide abort flag, and the
+``/abort/flag`` key is the coordinated-abort protocol's single source of
+truth.
 """
 
 from __future__ import annotations
@@ -54,6 +61,21 @@ _SANITIZER_PREFIX = f"/{SANITIZER_SCOPE}/"
 # is the offset-estimation handshake the per-rank timelines use at init.
 REPLAY_SCOPE = "replay"
 REPLAY_SUMMARY_KEY = "summary"
+
+# failure-domain runtime (elastic/heartbeat.py, elastic/abort.py): ranks
+# renew leases under /health/<rank>; the server stamps each PUT on ITS
+# clock and GET /health renders per-rank lease age + live/stale/dead
+# verdicts.  The job-wide abort flag lives at /abort/flag.
+HEALTH_SCOPE = "health"
+_HEALTH_PREFIX = f"/{HEALTH_SCOPE}/"
+ABORT_SCOPE = "abort"
+ABORT_KEY = "flag"
+
+#: lease-age verdict thresholds, in units of the lease's own renewal
+#: interval: a rank is ``stale`` past STALE_FACTOR missed intervals and
+#: ``dead`` past DEAD_FACTOR — the server-side lease expiry.
+STALE_FACTOR = 2.0
+DEAD_FACTOR = 4.0
 
 
 def sign(secret: bytes, path: str, body: bytes = b"") -> str:
@@ -123,11 +145,57 @@ class KVStoreHandler(BaseHTTPRequestHandler):
                 table.setdefault(seq, {})[rank] = "<undecodable>"
         return table
 
+    def _health_report(self) -> Dict[str, object]:
+        """Per-rank lease ages and verdicts, computed on the SERVER clock
+        (lease expiry is server-side: a rank whose clock drifts — or
+        whose process died — cannot keep its own lease alive).  Includes
+        the abort flag so one GET answers both "who is alive" and "is
+        the job aborting"."""
+        now = time.monotonic()
+        store: Dict[str, bytes] = self.server.store  # type: ignore
+        with self.server.lock:  # type: ignore
+            leases = {k[len(_HEALTH_PREFIX):]: v for k, v in store.items()
+                      if k.startswith(_HEALTH_PREFIX)}
+            stamps = dict(self.server.lease_times)  # type: ignore
+            abort_raw = store.get(f"/{ABORT_SCOPE}/{ABORT_KEY}")
+        ranks: Dict[str, object] = {}
+        for rank, raw in leases.items():
+            try:
+                lease = json.loads(raw)
+            except (ValueError, TypeError):
+                lease = {}
+            age = now - stamps.get(_HEALTH_PREFIX + rank, now)
+            interval = float(lease.get("interval", 0.0)) or 1.0
+            if age <= STALE_FACTOR * interval:
+                verdict = "live"
+            elif age <= DEAD_FACTOR * interval:
+                verdict = "stale"
+            else:
+                verdict = "dead"
+            ranks[rank] = {
+                "age_seconds": round(age, 3),
+                "interval": interval,
+                "count": lease.get("count"),
+                "pid": lease.get("pid"),
+                "verdict": verdict,
+            }
+        abort = None
+        if abort_raw is not None:
+            try:
+                abort = json.loads(abort_raw)
+            except (ValueError, TypeError):
+                abort = {"reason": "<undecodable abort flag>"}
+        return {"ranks": ranks, "abort": abort}
+
     def do_GET(self) -> None:  # noqa: N802
         if not self._verify():
             self._reply(401)
             return
         path = self.path.rstrip("/")
+        if path == "/health":
+            self._reply(200, json.dumps(self._health_report()).encode(),
+                        content_type="application/json")
+            return
         # Aggregated metrics routes.  No key collision with the KV store:
         # stored keys are always two-part /scope/key paths.
         if path == "/metrics":
@@ -181,6 +249,11 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             return
         with self.server.lock:  # type: ignore
             self.server.store[self.path] = body  # type: ignore
+            if self.path.startswith(_HEALTH_PREFIX):
+                # the lease stamp: receipt on the SERVER clock, so age /
+                # expiry never depend on worker clocks (GET /health)
+                self.server.lease_times[self.path] = (  # type: ignore
+                    time.monotonic())
         self._reply(200)
 
     def do_DELETE(self) -> None:  # noqa: N802
@@ -192,6 +265,7 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             store = self.server.store  # type: ignore
             for k in [k for k in store if k.startswith(prefix) or k == self.path]:
                 del store[k]
+                self.server.lease_times.pop(k, None)  # type: ignore
             # only whole-scope deletes mark rendezvous finalization;
             # per-key deletes (sanitizer fingerprint GC) must not grow
             # this set one entry per dispatch
@@ -214,6 +288,7 @@ class RendezvousServer:
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.secret = secret  # type: ignore[attr-defined]
         self._httpd.finalized = set()  # type: ignore[attr-defined]
+        self._httpd.lease_times = {}  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -242,6 +317,17 @@ class RendezvousServer:
     def put(self, scope: str, key: str, value: bytes) -> None:
         with self._httpd.lock:  # type: ignore[attr-defined]
             self._httpd.store[f"/{scope}/{key}"] = value  # type: ignore
+
+    def clear_scope(self, scope: str) -> None:
+        """Drop every key under ``scope`` (the supervisor resets the
+        ``abort``/``health`` scopes between restart attempts so a stale
+        flag cannot abort the fresh incarnation)."""
+        prefix = f"/{scope}/"
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            store = self._httpd.store  # type: ignore[attr-defined]
+            for k in [k for k in store if k.startswith(prefix)]:
+                del store[k]
+                self._httpd.lease_times.pop(k, None)  # type: ignore
 
 
 def find_free_port() -> int:

@@ -24,8 +24,10 @@ the KV store, results collected back).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import pickle
+import random
 import secrets as _secrets
 import shlex
 import signal
@@ -33,6 +35,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ..utils import env as env_util
@@ -70,6 +73,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--start-timeout", type=int, default=600)
     parser.add_argument("--ssh-port", type=int, dest="ssh_port")
     parser.add_argument("--disable-cache", action="store_true")
+    parser.add_argument("--restarts", type=int, dest="restarts", default=0,
+                        help="supervised-restart budget: relaunch the whole "
+                             "job up to N times after a failure, with "
+                             "exponential backoff; HVD_RESTART_COUNT is "
+                             "exported so ElasticState.resume() restores "
+                             "the latest checkpoint (docs/fault_tolerance.md)")
     parser.add_argument("--controller", dest="controller",
                         choices=["auto", "xla", "native"], default="auto",
                         help="eager control plane: 'native' runs the C++ "
@@ -268,107 +277,111 @@ class _Job:
     def __init__(self) -> None:
         self.procs: List[subprocess.Popen] = []
         self.failed: Optional[int] = None
+        self.interrupted = False  # operator signal: never auto-restart
         self._lock = threading.Lock()
 
-    def kill_all(self, sig=signal.SIGTERM) -> None:
+    def _signal_survivors(self, sig) -> int:
+        alive = 0
         with self._lock:
             for p in self.procs:
                 if p.poll() is None:
+                    alive += 1
                     try:
                         p.send_signal(sig)
                     except OSError:
                         pass
+        return alive
+
+    def all_exited(self) -> bool:
+        with self._lock:
+            return all(p.poll() is not None for p in self.procs)
+
+    def kill_all(self, sig=signal.SIGTERM, *, grace: Optional[float] = None,
+                 escalate: bool = True) -> None:
+        """Terminate every live worker, escalating SIGTERM→SIGKILL after
+        ``grace`` seconds (``HVD_TERM_GRACE_SECONDS``, default 5).  A
+        worker wedged in a collective ignores SIGTERM; without the
+        escalation the launcher used to leak it."""
+        if not self._signal_survivors(sig):
+            return
+        if not escalate or sig == signal.SIGKILL:
+            return
+        if grace is None:
+            grace = env_util.get_float(env_util.HVD_TERM_GRACE_SECONDS,
+                                       env_util.DEFAULT_TERM_GRACE_SECONDS)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if self.all_exited():
+                return
+            time.sleep(0.1)
+        survivors = self._signal_survivors(signal.SIGKILL)
+        if survivors:
+            log.warning("%d worker(s) ignored SIGTERM for %.1fs; sent "
+                        "SIGKILL", survivors, grace)
 
 
-def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
-    """Spawn workers, capture output, propagate failure
-    (reference gloo_run.py:142-259)."""
-    hosts = sorted({s.hostname for s in slots},
-                   key=[s.hostname for s in slots].index)
-    coordinator = f"{socket.gethostname()}:{env_util.get_int('HVD_COORD_PORT', 0) or _free_port()}"
+def _supervise(job: _Job, rdv_server: Optional[RendezvousServer],
+               poll_interval: float = 0.2) -> int:
+    """Event-driven wait on the worker set: react to the FIRST failure,
+    whichever rank it is (the old loop blocked in ``procs[0].wait()``, so
+    a crashed rank 3 went unnoticed while rank 0 idled in a collective).
 
-    # Metrics aggregation point: the launcher hosts a rendezvous server
-    # that ranks push registry snapshots to; GET /metrics (signed) on it
-    # serves the whole job's Prometheus page (docs/metrics.md).
-    metrics_server = None
-    metrics_on = env_util.parse_bool(
-        env.get(env_util.HVD_METRICS, os.environ.get(env_util.HVD_METRICS)),
-        True,
-    )
-    # An operator-provided HVD_METRICS_KV_ADDR means an external
-    # aggregation server: forward the operator's values untouched.
-    external_sink = env.get(
-        env_util.HVD_METRICS_KV_ADDR,
-        os.environ.get(env_util.HVD_METRICS_KV_ADDR),
-    )
-    if not getattr(args, "dry_run", False) and metrics_on \
-            and not external_sink:
-        # operator-provided secret (hex) wins so their tooling can sign
-        # scrapes; otherwise generate one and LOG it — a secret nobody
-        # knows makes the advertised endpoint unusable
-        secret_hex = env.get(env_util.HVD_METRICS_SECRET,
-                             os.environ.get(env_util.HVD_METRICS_SECRET))
-        try:
-            metrics_secret = bytes.fromhex(secret_hex) if secret_hex \
-                else _secrets.token_bytes(16)
-        except ValueError:
-            raise ValueError(
-                f"{env_util.HVD_METRICS_SECRET} must be hex, got "
-                f"{secret_hex!r}"
-            )
-        metrics_server = RendezvousServer(secret=metrics_secret)
-        metrics_port = metrics_server.start()
-        metrics_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
-            else socket.gethostname()
-        env = dict(env)
-        env[env_util.HVD_METRICS_KV_ADDR] = metrics_host
-        env[env_util.HVD_METRICS_KV_PORT] = str(metrics_port)
-        env[env_util.HVD_METRICS_SECRET] = metrics_secret.hex()
-        # never echo an operator-provided credential into job logs; a
-        # generated one must be printed or the endpoint is unusable
-        secret_expr = "bytes.fromhex(os.environ['HVD_METRICS_SECRET'])" \
-            if secret_hex else f"bytes.fromhex('{metrics_secret.hex()}')"
-        log.info(
-            "metrics: signed GET http://%s:%d/metrics aggregates all "
-            "ranks — e.g. horovod_tpu.run.http_client.get_metrics("
-            "'%s', %d, secret=%s)",
-            metrics_host, metrics_port, metrics_host, metrics_port,
-            secret_expr,
-        )
+    On a failure: publish the coordinated-abort flag on the rendezvous
+    server (each rank's heartbeat polls it and raises HorovodAbortError
+    at the next dispatch — elastic/heartbeat.py), give survivors one
+    heartbeat window to exit with that root cause, then escalate
+    SIGTERM→SIGKILL on whatever is left."""
+    procs = job.procs
+    while True:
+        states = [p.poll() for p in procs]
+        failures = [(pid, c) for pid, c in enumerate(states)
+                    if c is not None and c != 0]
+        if failures:
+            pid, code = failures[0]
+            log.error("worker %d exited with code %d; aborting job",
+                      pid, code)
+            job.failed = pid
+            hb_interval = env_util.get_float(
+                env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
+                env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS)
+            if rdv_server is not None:
+                # note: ..elastic re-exports the abort() FUNCTION over the
+                # submodule attribute, so names are imported directly
+                from ..elastic.abort import ABORT_KEY, ABORT_SCOPE, make_flag
 
-    controller = getattr(args, "controller", "auto") or "auto"
-    if controller == "auto":
-        controller = "native" if len(hosts) > 1 else "xla"
-    # The launcher hosts the native controller server (the reference hosts
-    # its rendezvous on the launcher the same way, gloo_run.py:262-288):
-    # bind port 0 locally, point workers at this machine.
-    ctrl_server = None
-    controller_addr = None
-    if controller == "native" and not getattr(args, "dry_run", False):
-        from ..runtime.controller import ControllerServer
+                rdv_server.put(
+                    ABORT_SCOPE, ABORT_KEY,
+                    json.dumps(make_flag(
+                        f"worker {pid} exited with code {code}",
+                        rank=pid, source="launcher",
+                    )).encode(),
+                )
+                # survivors poll the flag once per heartbeat interval and
+                # raise at their next step/dispatch seam; the exit budget
+                # is two intervals plus the term grace (a rank mid-save
+                # needs the slack), matching the documented bound of
+                # 2 x HVD_HEARTBEAT_INTERVAL_SECONDS + grace
+                grace = env_util.get_float(
+                    env_util.HVD_TERM_GRACE_SECONDS,
+                    env_util.DEFAULT_TERM_GRACE_SECONDS)
+                deadline = time.monotonic() + 2.0 * hb_interval + grace
+                while time.monotonic() < deadline and not job.all_exited():
+                    time.sleep(0.1)
+            job.kill_all()
+            return code
+        if all(c == 0 for c in states):
+            return 0
+        time.sleep(poll_interval)
 
-        ctrl_server = ControllerServer(len(hosts), port=0)
-        ctrl_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
-            else socket.gethostname()
-        controller_addr = f"{ctrl_host}:{ctrl_server.port}"
-    elif controller == "native":
-        controller_addr = "<launcher>:<bound-at-launch>"
-    envs = worker_envs(
-        slots, env, coordinator,
-        controller=controller, controller_addr=controller_addr,
-    )
 
-    if getattr(args, "dry_run", False):
-        for pid, hostname in enumerate(hosts):
-            print(f"[dry-run] process {pid} on {hostname}:")
-            for k in sorted(set(envs[pid]) - set(env)):
-                print(f"  {k}={envs[pid][k]}")
-            print(f"  command: {' '.join(args.command)}")
-        return 0
-
+def _launch_attempt(args, hosts: List[str], envs: List[Dict[str, str]],
+                    rdv_server: Optional[RendezvousServer],
+                    attempt: int = 0) -> int:
+    """Spawn one incarnation of the worker set and supervise it to exit."""
     job = _Job()
 
     def handler(signum, frame):
+        job.interrupted = True
         job.kill_all(signal.SIGTERM)
 
     old_int = signal.signal(signal.SIGINT, handler)
@@ -398,46 +411,189 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
 
             t = threading.Thread(
                 target=_pump_output,
-                args=(proc, pid, args.output_filename),
+                args=(proc, pid, args.output_filename, attempt),
                 daemon=True,
             )
             t.start()
             threads.append(t)
 
-        rc = 0
-        for pid, proc in enumerate(job.procs):
-            code = proc.wait()
-            if code != 0 and rc == 0:
-                rc = code
-                log.error("worker %d exited with code %d; terminating job",
-                          pid, code)
-                job.kill_all()
+        rc = _supervise(job, rdv_server)
         for t in threads:
             t.join(timeout=5)
+        if job.interrupted and rc == 0:
+            rc = 130  # operator interrupt must not read as success
+        args._interrupted = job.interrupted  # noqa: SLF001 — restart gate
         return rc
     finally:
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGTERM, old_term)
-        if ctrl_server is not None:
-            log.info(
-                "controller: %d cycles, %d cache hits, %d stall warnings",
-                ctrl_server.cycles, ctrl_server.cache_hits,
-                ctrl_server.stall_warnings,
+
+
+def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
+    """Stand up the job's rendezvous plane, then spawn + supervise the
+    worker set, relaunching up to ``--restarts`` times on failure
+    (reference gloo_run.py:142-259, plus the failure-domain runtime of
+    docs/fault_tolerance.md)."""
+    hosts = sorted({s.hostname for s in slots},
+                   key=[s.hostname for s in slots].index)
+    coordinator = f"{socket.gethostname()}:{env_util.get_int('HVD_COORD_PORT', 0) or _free_port()}"
+
+    # Rendezvous/aggregation point: the launcher hosts one server that
+    # carries metrics pushes (GET /metrics), sanitizer fingerprints,
+    # heartbeat leases + the abort flag (GET /health), and replay
+    # summaries.  It exists whenever metrics OR heartbeats want it.
+    rdv_server = None
+    metrics_on = env_util.parse_bool(
+        env.get(env_util.HVD_METRICS, os.environ.get(env_util.HVD_METRICS)),
+        True,
+    )
+    heartbeat_on = not env_util.parse_bool(
+        env.get(env_util.HVD_HEARTBEAT_DISABLE,
+                os.environ.get(env_util.HVD_HEARTBEAT_DISABLE)),
+        False,
+    )
+    # An operator-provided HVD_METRICS_KV_ADDR means an external
+    # aggregation server: forward the operator's values untouched.
+    external_sink = env.get(
+        env_util.HVD_METRICS_KV_ADDR,
+        os.environ.get(env_util.HVD_METRICS_KV_ADDR),
+    )
+    if not getattr(args, "dry_run", False) and (metrics_on or heartbeat_on) \
+            and not external_sink:
+        # operator-provided secret (hex) wins so their tooling can sign
+        # scrapes; otherwise generate one and LOG it — a secret nobody
+        # knows makes the advertised endpoint unusable
+        secret_hex = env.get(env_util.HVD_METRICS_SECRET,
+                             os.environ.get(env_util.HVD_METRICS_SECRET))
+        try:
+            rdv_secret = bytes.fromhex(secret_hex) if secret_hex \
+                else _secrets.token_bytes(16)
+        except ValueError:
+            raise ValueError(
+                f"{env_util.HVD_METRICS_SECRET} must be hex, got "
+                f"{secret_hex!r}"
             )
-            ctrl_server.stop()
-        if metrics_server is not None:
-            metrics_server.stop()
+        rdv_server = RendezvousServer(secret=rdv_secret)
+        rdv_port = rdv_server.start()
+        rdv_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
+            else socket.gethostname()
+        env = dict(env)
+        env[env_util.HVD_METRICS_KV_ADDR] = rdv_host
+        env[env_util.HVD_METRICS_KV_PORT] = str(rdv_port)
+        env[env_util.HVD_METRICS_SECRET] = rdv_secret.hex()
+        if metrics_on:
+            # never echo an operator-provided credential into job logs; a
+            # generated one must be printed or the endpoint is unusable
+            secret_expr = "bytes.fromhex(os.environ['HVD_METRICS_SECRET'])" \
+                if secret_hex else f"bytes.fromhex('{rdv_secret.hex()}')"
+            log.info(
+                "metrics: signed GET http://%s:%d/metrics aggregates all "
+                "ranks — e.g. horovod_tpu.run.http_client.get_metrics("
+                "'%s', %d, secret=%s)",
+                rdv_host, rdv_port, rdv_host, rdv_port,
+                secret_expr,
+            )
+        if heartbeat_on:
+            log.info("health: GET http://%s:%d/health reports per-rank "
+                     "lease verdicts", rdv_host, rdv_port)
+
+    controller = getattr(args, "controller", "auto") or "auto"
+    if controller == "auto":
+        controller = "native" if len(hosts) > 1 else "xla"
+
+    if getattr(args, "dry_run", False):
+        controller_addr = "<launcher>:<bound-at-launch>" \
+            if controller == "native" else None
+        envs = worker_envs(slots, env, coordinator, controller=controller,
+                           controller_addr=controller_addr)
+        for pid, hostname in enumerate(hosts):
+            print(f"[dry-run] process {pid} on {hostname}:")
+            for k in sorted(set(envs[pid]) - set(env)):
+                print(f"  {k}={envs[pid][k]}")
+            print(f"  command: {' '.join(args.command)}")
+        return 0
+
+    restarts = getattr(args, "restarts", 0) or 0
+    backoff_base = env_util.get_float(env_util.HVD_RESTART_BACKOFF_SECONDS,
+                                      env_util.DEFAULT_RESTART_BACKOFF_SECONDS)
+    attempt = 0
+    try:
+        while True:
+            # The native controller server is per-incarnation: a failed
+            # attempt leaves half-negotiated state behind, and a restart
+            # must rendezvous from scratch.
+            ctrl_server = None
+            controller_addr = None
+            if controller == "native":
+                from ..runtime.controller import ControllerServer
+
+                ctrl_server = ControllerServer(len(hosts), port=0)
+                ctrl_host = "127.0.0.1" \
+                    if all(h in LOCAL_HOSTS for h in hosts) \
+                    else socket.gethostname()
+                controller_addr = f"{ctrl_host}:{ctrl_server.port}"
+            env_attempt = dict(env)
+            env_attempt[env_util.HVD_RESTART_COUNT] = str(attempt)
+            envs = worker_envs(
+                slots, env_attempt, coordinator,
+                controller=controller, controller_addr=controller_addr,
+            )
+            try:
+                rc = _launch_attempt(args, hosts, envs, rdv_server,
+                                     attempt=attempt)
+            finally:
+                if ctrl_server is not None:
+                    log.info(
+                        "controller: %d cycles, %d cache hits, %d stall "
+                        "warnings", ctrl_server.cycles,
+                        ctrl_server.cache_hits, ctrl_server.stall_warnings,
+                    )
+                    ctrl_server.stop()
+            if rc == 0 or attempt >= restarts \
+                    or getattr(args, "_interrupted", False):
+                if rc != 0 and getattr(args, "_interrupted", False):
+                    log.info("job interrupted by operator signal; not "
+                             "restarting")
+                return rc
+            attempt += 1
+            from .. import metrics as metrics_mod
+
+            if metrics_mod.on():
+                metrics_mod.RESTARTS.inc()
+            delay = backoff_base * (2 ** (attempt - 1)) \
+                + random.uniform(0.0, backoff_base)
+            log.warning(
+                "restarting job (attempt %d/%d) in %.1fs after exit code "
+                "%d; workers resume from their latest checkpoint "
+                "(HVD_RESTART_COUNT=%d)", attempt, restarts, delay, rc,
+                attempt,
+            )
+            time.sleep(delay)
+            if rdv_server is not None:
+                # a stale abort flag or dead lease must not kill the
+                # fresh incarnation at its first heartbeat
+                from .http_server import ABORT_SCOPE, HEALTH_SCOPE
+
+                rdv_server.clear_scope(ABORT_SCOPE)
+                rdv_server.clear_scope(HEALTH_SCOPE)
+    finally:
+        if rdv_server is not None:
+            rdv_server.stop()
 
 
 def _pump_output(proc: subprocess.Popen, pid: int,
-                 output_dir: Optional[str]) -> None:
+                 output_dir: Optional[str], attempt: int = 0) -> None:
     """Tag each line with the worker index (mpirun --tag-output style,
     reference mpi_run.py:115-149) and/or tee to per-rank files
-    (reference gloo_run.py output capture)."""
+    (reference gloo_run.py output capture).  Restart attempts get their
+    own files — truncating rank.N.txt on relaunch would destroy the very
+    crash diagnostics the restart was for."""
     sink = None
     if output_dir:
         os.makedirs(output_dir, exist_ok=True)
-        sink = open(os.path.join(output_dir, f"rank.{pid}.txt"), "w")
+        name = f"rank.{pid}.txt" if attempt == 0 \
+            else f"rank.{pid}.restart{attempt}.txt"
+        sink = open(os.path.join(output_dir, name), "w")
     assert proc.stdout is not None
     for line in proc.stdout:
         sys.stdout.write(f"[{pid}]<stdout>: {line}")
@@ -576,7 +732,44 @@ def run(fn, args=(), kwargs=None, np: int = 1,
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "horovod_tpu.run.task_fn"], env=env,
             ))
-        rcs = [p.wait() for p in procs]
+        # Supervise like launch_job: react to the FIRST failure, whichever
+        # worker it is — a rank-order wait would hang here forever while a
+        # surviving worker blocks in a collective its dead peer never
+        # joins.  The abort flag goes onto this server so the survivors'
+        # heartbeats surface the root cause before the escalating kill.
+        while True:
+            states = [p.poll() for p in procs]
+            if all(c is not None for c in states):
+                rcs = states
+                break
+            failures = [(pid, c) for pid, c in enumerate(states)
+                        if c is not None and c != 0]
+            if failures:
+                bad_pid, code = failures[0]
+                log.error("function-mode worker %d exited with code %d; "
+                          "aborting job", bad_pid, code)
+                from ..elastic.abort import ABORT_KEY, ABORT_SCOPE, make_flag
+
+                server.put(ABORT_SCOPE, ABORT_KEY, json.dumps(make_flag(
+                    f"worker {bad_pid} exited with code {code}",
+                    rank=bad_pid, source="launcher",
+                )).encode())
+                hb_interval = env_util.get_float(
+                    env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
+                    env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS)
+                grace = env_util.get_float(
+                    env_util.HVD_TERM_GRACE_SECONDS,
+                    env_util.DEFAULT_TERM_GRACE_SECONDS)
+                deadline = time.monotonic() + 2.0 * hb_interval + grace
+                while time.monotonic() < deadline \
+                        and any(p.poll() is None for p in procs):
+                    time.sleep(0.1)
+                kill_job = _Job()
+                kill_job.procs = procs
+                kill_job.kill_all()
+                rcs = [p.wait() for p in procs]
+                break
+            time.sleep(0.1)
         if any(rcs):
             # surface the tracebacks the workers published before exiting
             errors = []
@@ -603,9 +796,12 @@ def run(fn, args=(), kwargs=None, np: int = 1,
             results.append(payload["value"])
         return results
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+        # escalating teardown: SIGTERM, grace, then SIGKILL — a worker
+        # wedged in a collective ignores SIGTERM and would leak
+        if any(p.poll() is None for p in procs):
+            grace_job = _Job()
+            grace_job.procs = procs
+            grace_job.kill_all()
         if ctrl_server is not None:
             ctrl_server.stop()
         server.stop()

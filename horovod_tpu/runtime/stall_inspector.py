@@ -167,9 +167,24 @@ class StallInspector:
 
     @staticmethod
     def _default_shutdown(name: str) -> None:
+        """Stall shutdown = coordinated abort, then local exit.  The old
+        behavior (exit alone) stranded every OTHER rank in a silent hang
+        until a collective timeout; setting the job-wide flag first means
+        peers raise HorovodAbortError naming this rank's stalled op
+        within a heartbeat interval (elastic/abort.py)."""
         log.critical(
             "operation [%s] exceeded the stall shutdown threshold; "
             "terminating (HVD_STALL_SHUTDOWN_TIME_SECONDS)", name,
+        )
+        from ..elastic.abort import trigger
+
+        # best-effort, with a SHORT per-attempt timeout: an unreachable
+        # rendezvous (the launcher VM may be the thing that died) must
+        # delay this exit by seconds, not the full retry budget
+        trigger(
+            f"stall shutdown: operation [{name}] exceeded "
+            "HVD_STALL_SHUTDOWN_TIME_SECONDS",
+            source="stall_inspector", timeout=2.0,
         )
         os._exit(1)
 

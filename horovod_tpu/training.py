@@ -18,6 +18,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import core
 from .core import Average
+from .elastic import faults as _faults
+from .elastic import heartbeat as _heartbeat
 from .ops.compression import Compression
 from .ops.fusion import allreduce_pytree
 from .spmd import spmd
@@ -204,6 +206,13 @@ def make_train_step(
             isinstance(leaf, jax.core.Tracer)
             for leaf in jax.tree_util.tree_leaves((state, x, y))
         )
+        if not under_trace:
+            # Failure-domain seam (docs/fault_tolerance.md): a coordinated
+            # abort raises HorovodAbortError here — before this rank
+            # dispatches a step its dead peer will never join — and the
+            # HVD_FAULT_SPEC harness injects its step-seam faults.
+            _heartbeat.maybe_raise_abort()
+            _faults.on_step()
         if not under_trace and metrics.on():
             _record_step_metrics(x)
         if timeline.active and not under_trace:

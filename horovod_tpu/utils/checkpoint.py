@@ -54,7 +54,10 @@ def latest_step(path: str) -> Optional[int]:
         import fsspec
 
         fs, root = fsspec.core.url_to_fs(path)
-        names = [os.path.basename(p.rstrip("/")) for p in fs.ls(root)]
+        # detail=False explicitly: AbstractFileSystem defaults to detail
+        # dicts (only LocalFileSystem happens to return plain paths)
+        names = [os.path.basename(str(p).rstrip("/"))
+                 for p in fs.ls(root, detail=False)]
     except ImportError:
         try:
             names = os.listdir(path)

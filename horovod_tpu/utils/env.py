@@ -92,11 +92,25 @@ HVD_REPLAY_CLOCK_SYNC = "HVD_REPLAY_CLOCK_SYNC"        # 0 skips the init-time c
 HVD_REPLAY_CLOCK_SAMPLES = "HVD_REPLAY_CLOCK_SAMPLES"  # handshake round trips (default 8)
 HVD_REPLAY_ICI_GBPS = "HVD_REPLAY_ICI_GBPS"            # what-if link bandwidth, GB/s (default 186)
 HVD_REPLAY_HOP_US = "HVD_REPLAY_HOP_US"                # what-if per-hop latency, µs (default 1)
+# failure-domain runtime (horovod_tpu/elastic/, docs/fault_tolerance.md)
+HVD_HEARTBEAT_INTERVAL_SECONDS = "HVD_HEARTBEAT_INTERVAL_SECONDS"  # lease renewal (default 2)
+HVD_HEARTBEAT_DISABLE = "HVD_HEARTBEAT_DISABLE"        # 1 turns the lease/abort plane off
+HVD_TERM_GRACE_SECONDS = "HVD_TERM_GRACE_SECONDS"      # SIGTERM→SIGKILL escalation grace (default 5)
+HVD_HTTP_RETRIES = "HVD_HTTP_RETRIES"                  # rendezvous HTTP retry budget (default 2)
+HVD_HTTP_BACKOFF_MS = "HVD_HTTP_BACKOFF_MS"            # base retry backoff, ms (default 50)
+HVD_FAULT_SPEC = "HVD_FAULT_SPEC"                      # fault-injection spec (elastic/faults.py)
+HVD_RESTART_COUNT = "HVD_RESTART_COUNT"                # incarnation index set by the supervisor
+HVD_RESTART_BACKOFF_SECONDS = "HVD_RESTART_BACKOFF_SECONDS"  # restart backoff base (default 1)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
 FUSION_BUFFER_ATOMIC_UNIT = 64                     # reference common.h:94
 DEFAULT_STALL_WARNING_SECONDS = 60.0               # reference stall_inspector.h:72
+DEFAULT_HEARTBEAT_INTERVAL_SECONDS = 2.0           # elastic/heartbeat.py lease renewal
+DEFAULT_TERM_GRACE_SECONDS = 5.0                   # run/run.py SIGTERM→SIGKILL grace
+DEFAULT_HTTP_RETRIES = 2                           # run/http_client.py retry budget
+DEFAULT_HTTP_BACKOFF_MS = 50.0                     # run/http_client.py backoff base
+DEFAULT_RESTART_BACKOFF_SECONDS = 1.0              # run/run.py restart backoff base
 
 
 def get_int(name: str, default: int) -> int:

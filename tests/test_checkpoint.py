@@ -1,0 +1,169 @@
+"""utils/checkpoint.py failure paths + ElasticState resume (the
+auto-resume half of the failure-domain runtime, docs/fault_tolerance.md).
+
+The multi-process agreement round is driven with monkeypatched core/eager
+seams so every branch — root restore failure surfacing on all ranks,
+non-root unreadable path falling back to broadcast_object, the
+all-ranks-readable broadcast_parameters path — runs deterministically in
+one process; latest_step is pinned on local, missing, and remote
+(memory://) paths."""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu import core, eager
+from horovod_tpu.elastic.state import ElasticState
+from horovod_tpu.utils import checkpoint as ck
+
+
+# -- latest_step -------------------------------------------------------------
+def test_latest_step_missing_path_is_none(tmp_path):
+    assert ck.latest_step(str(tmp_path / "never-written")) is None
+
+
+def test_latest_step_picks_numeric_max_and_ignores_junk(tmp_path):
+    for name in ("step_1", "step_10", "step_2", "step_x", "other", "step_"):
+        (tmp_path / name).mkdir()
+    assert ck.latest_step(str(tmp_path)) == 10
+
+
+def test_latest_step_empty_dir_is_none(tmp_path):
+    assert ck.latest_step(str(tmp_path)) is None
+
+
+def test_latest_step_remote_memory_url():
+    """Remote stores list through fsspec — os.listdir would raise on a
+    URL and silently retarget restore at the run root."""
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    fs.mkdirs("/ckroot/step_3", exist_ok=True)
+    with fs.open("/ckroot/step_3/marker", "wb") as f:
+        f.write(b"1")
+    try:
+        assert ck.latest_step("memory://ckroot") == 3
+        assert ck.latest_step("memory://ckroot-missing") is None
+    finally:
+        fs.rm("/ckroot", recursive=True)
+
+
+# -- multi-process restore branches (seams monkeypatched) --------------------
+@pytest.fixture()
+def fake_multi(monkeypatch):
+    """A simulated 2-process world: core reports multi, the step-choice
+    broadcast is identity, and tests install their own agreement-round
+    results."""
+    monkeypatch.setattr(core, "is_initialized", lambda: True)
+    monkeypatch.setattr(core, "process_size", lambda: 2)
+    monkeypatch.setattr(core, "process_rank", lambda: 0)
+    monkeypatch.setattr(eager, "broadcast_object",
+                        lambda obj, *a, **k: obj)
+    return monkeypatch
+
+
+def test_root_restore_failure_surfaces_on_every_rank(fake_multi, tmp_path):
+    """Rank 0 cannot read the checkpoint: the agreement round must turn
+    that into a RuntimeError on EVERY rank — raising before the
+    agreement would leave the others blocked until timeout with no root
+    cause."""
+    calls = []
+
+    def agree(status, **k):
+        calls.append(status)
+        return [status, None]  # we are rank 0 and we failed; rank 1 is fine
+
+    fake_multi.setattr(eager, "allgather_object", agree)
+    with pytest.raises(RuntimeError, match="rank 0 failed to restore"):
+        ck.restore_checkpoint(str(tmp_path / "nope"), {"w": np.zeros(2)})
+    assert len(calls) == 1 and calls[0] is not None  # the held error shipped
+
+
+def test_nonroot_unreadable_falls_back_to_broadcast_object(fake_multi,
+                                                           tmp_path):
+    """A non-root rank without the shared filesystem must still come back
+    with root's bytes: statuses show root succeeded, so the payload rides
+    broadcast_object instead of raising locally."""
+    fake_multi.setattr(core, "process_rank", lambda: 1)
+    fake_multi.setattr(
+        eager, "allgather_object",
+        lambda status, **k: [None, status],  # root fine, we failed
+    )
+    roots_tree = {"w": np.full(2, 7.0)}
+    shipped = []
+
+    def bcast(obj, *a, **k):
+        shipped.append(obj)
+        return roots_tree
+
+    fake_multi.setattr(eager, "broadcast_object", bcast)
+    out = ck.restore_checkpoint(str(tmp_path / "nope"),
+                                {"w": np.zeros(2)}, step=5)
+    np.testing.assert_array_equal(out["w"], roots_tree["w"])
+    assert shipped == [None]  # the non-root contributes nothing
+
+
+def test_all_ranks_readable_takes_array_plane_broadcast(fake_multi,
+                                                        tmp_path):
+    """Every rank restored: the cheaper array-plane broadcast_parameters
+    runs (not the pickled broadcast_object)."""
+    saved = ck.save_checkpoint(str(tmp_path), {"w": np.arange(3.0)}, step=4)
+    assert saved is not None and saved.endswith("step_4")
+
+    fake_multi.setattr(eager, "allgather_object",
+                       lambda status, **k: [None, None])
+    from horovod_tpu.optim import distributed as dist
+
+    seen = []
+
+    def bparams(tree, *a, **k):
+        seen.append(tree)
+        return tree
+
+    fake_multi.setattr(dist, "broadcast_parameters", bparams)
+    out = ck.restore_checkpoint(str(tmp_path), {"w": np.zeros(3)})
+    np.testing.assert_array_equal(out["w"], np.arange(3.0))
+    assert len(seen) == 1  # took the array plane
+
+
+def test_single_process_failure_raises_directly(tmp_path):
+    with pytest.raises(Exception):  # noqa: B017 — orbax's own error type
+        ck.restore_checkpoint(str(tmp_path / "nope"), {"w": np.zeros(2)},
+                              broadcast=False)
+
+
+# -- ElasticState ------------------------------------------------------------
+def test_elastic_state_fresh_run_and_resume(tmp_path, monkeypatch):
+    path = str(tmp_path / "run")
+    es = ElasticState(path, {"w": np.zeros(3, np.float32)})
+    state, start = es.resume()
+    assert start == 0 and es.step == 0  # fresh: initial state untouched
+    np.testing.assert_array_equal(state["w"], np.zeros(3))
+
+    es.state = {"w": np.full(3, 2.0, np.float32)}
+    assert es.save(2).endswith("step_2")
+    es.state = {"w": np.full(3, 5.0, np.float32)}
+    assert es.save(5).endswith("step_5")
+
+    monkeypatch.setenv("HVD_RESTART_COUNT", "1")
+    es2 = ElasticState(path, {"w": np.zeros(3, np.float32)})
+    assert es2.restart_count == 1
+    state, start = es2.resume()
+    assert start == 5 and es2.step == 5  # newest step wins
+    np.testing.assert_array_equal(state["w"], np.full(3, 5.0))
+
+
+def test_elastic_state_loses_at_most_one_interval(tmp_path):
+    """The resume contract: whatever was checkpointed last is what comes
+    back — work after the last save is the (bounded) loss."""
+    path = str(tmp_path / "run")
+    es = ElasticState(path, {"w": np.zeros(1, np.float32)})
+    for step in range(1, 4):
+        es.state = {"w": np.full(1, float(step), np.float32)}
+        es.save(step)
+    # steps 4 and 5 ran but never checkpointed before the "crash"
+    es2 = ElasticState(path, {"w": np.zeros(1, np.float32)})
+    state, start = es2.resume()
+    assert start == 3
+    np.testing.assert_array_equal(state["w"], [3.0])

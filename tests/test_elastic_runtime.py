@@ -1,0 +1,520 @@
+"""Failure-domain runtime (docs/fault_tolerance.md): heartbeat leases +
+GET /health verdicts, the coordinated-abort protocol, the HVD_FAULT_SPEC
+harness, HTTP-client retries, SIGTERM→SIGKILL escalation, event-driven
+launcher supervision, and the tier-1 tpurun --restarts resume smoke.
+
+The reference has no counterpart — its only failure handling is the
+stall warning + blanket shutdown (stall_inspector.h:42) and the
+launcher's kill-on-first-nonzero-exit (gloo_run.py:253-259); these tests
+pin the behaviors that replace it."""
+
+import http.server
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic import faults as faults_mod
+from horovod_tpu.elastic import heartbeat as hb_mod
+from horovod_tpu.elastic.abort import (
+    HorovodAbortError,
+    make_flag,
+    read_flag,
+    trigger,
+)
+from horovod_tpu.elastic.faults import (
+    FAULT_EXIT_CODE,
+    Fault,
+    FaultInjector,
+    FaultSpecError,
+    parse_duration,
+    parse_spec,
+)
+from horovod_tpu.run.http_client import get_health, get_kv, put_kv
+from horovod_tpu.run.http_server import RendezvousServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def rdv():
+    """A live rendezvous server + teardown of the module-level heartbeat
+    and fault-injector singletons the tests arm."""
+    secret = b"elastic-secret"
+    server = RendezvousServer(secret=secret)
+    server.start()
+    yield server, "127.0.0.1", server.port, secret
+    hb_mod.stop()
+    faults_mod.reset()
+    server.stop()
+
+
+# -- fault-spec grammar ------------------------------------------------------
+def test_parse_spec_full_grammar():
+    faults = parse_spec(
+        "rank=1:step=3:kind=crash;"
+        "rank=*:kind=slow=200ms:prob=0.5;"
+        "kind=http_drop:restart=*;"
+        "rank=0:step=10:kind=hang:seam=dispatch"
+    )
+    assert faults[0] == Fault(kind="crash", seam="step", rank=1, step=3,
+                              restart=0, prob=1.0)
+    assert faults[1].kind == "slow" and faults[1].duration == pytest.approx(0.2)
+    assert faults[1].rank is None and faults[1].prob == 0.5
+    assert faults[2].seam == "http" and faults[2].restart is None
+    assert faults[3].seam == "dispatch" and faults[3].step == 10
+
+
+def test_parse_duration_units():
+    assert parse_duration("200ms") == pytest.approx(0.2)
+    assert parse_duration("1.5s") == pytest.approx(1.5)
+    assert parse_duration("2m") == pytest.approx(120.0)
+    assert parse_duration("3") == pytest.approx(3.0)
+
+
+@pytest.mark.parametrize("bad", [
+    "rank=1",                      # missing kind
+    "kind=explode",                # unknown kind
+    "kind=slow",                   # slow needs a duration
+    "kind=crash=now",              # crash takes no argument
+    "kind=crash:step=soon",        # non-int step
+    "kind=crash:prob=2.0",         # prob out of range
+    "kind=crash:seam=gpu",         # unknown seam
+    "kind=crash:color=red",        # unknown field
+    "rank 1 kind crash",           # not key=value
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(FaultSpecError):
+        parse_spec(bad)
+
+
+def test_injector_matches_rank_step_and_restart():
+    slow = Fault(kind="slow", seam="step", rank=1, step=2, restart=0,
+                 prob=1.0, duration=0.05)
+    inj = FaultInjector([slow], rank=1, restart=0)
+    t0 = time.monotonic()
+    inj.fire("step")  # counter 0
+    inj.fire("step")  # counter 1
+    assert time.monotonic() - t0 < 0.04
+    t0 = time.monotonic()
+    inj.fire("step")  # counter 2 — fires
+    assert time.monotonic() - t0 >= 0.05
+
+    # wrong rank: never fires
+    inj = FaultInjector([slow], rank=0, restart=0)
+    t0 = time.monotonic()
+    for _ in range(4):
+        inj.fire("step")
+    assert time.monotonic() - t0 < 0.04
+
+    # wrong incarnation: the default restart=0 gate keeps a supervised
+    # relaunch clean
+    inj = FaultInjector([slow], rank=1, restart=1)
+    t0 = time.monotonic()
+    for _ in range(4):
+        inj.fire("step")
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_injector_http_drop_raises_urlerror():
+    inj = FaultInjector([Fault(kind="http_drop", seam="http", step=None,
+                               restart=None)], rank=0, restart=0)
+    with pytest.raises(urllib.error.URLError, match="injected http_drop"):
+        inj.fire("http", detail="/scope/key")
+
+
+def test_env_wiring_arms_and_reset_disarms(monkeypatch):
+    faults_mod.reset()
+    assert faults_mod.instance() is None  # no spec → inert seams
+    faults_mod.on_step()                  # must be a cheap no-op
+
+    monkeypatch.setenv("HVD_FAULT_SPEC", "rank=3:step=1:kind=crash")
+    monkeypatch.setenv("HVD_PROCESS_ID", "3")
+    monkeypatch.setenv("HVD_RESTART_COUNT", "2")
+    faults_mod.reset()
+    inj = faults_mod.instance()
+    assert inj is not None and inj.rank == 3 and inj.restart == 2
+    # armed on another incarnation: stepping through is safe
+    faults_mod.on_step()
+    faults_mod.on_step()
+    faults_mod.reset()
+
+
+# -- heartbeat leases + GET /health ------------------------------------------
+def _wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def test_heartbeat_lease_and_health_verdicts(rdv):
+    server, addr, port, secret = rdv
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.1)
+    assert _wait_for(lambda: hb.beats >= 2)
+    health = get_health(addr, port, secret=secret)
+    assert health["abort"] is None
+    r0 = health["ranks"]["0"]
+    assert r0["verdict"] == "live"
+    assert r0["interval"] == pytest.approx(0.1)
+    assert r0["pid"] == os.getpid()
+    assert "1" not in health["ranks"]  # rank 1 never published
+
+    # stop renewing: the lease ages past DEAD_FACTOR x interval on the
+    # SERVER clock and the server-side expiry flips the verdict
+    hb_mod.stop()
+    assert _wait_for(
+        lambda: get_health(addr, port, secret=secret)
+        ["ranks"]["0"]["verdict"] == "dead",
+        timeout=3.0,
+    )
+
+
+def test_heartbeat_observes_abort_and_seam_raises(rdv):
+    server, addr, port, secret = rdv
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.1)
+    assert _wait_for(lambda: hb.beats >= 1)
+    hb_mod.maybe_raise_abort()  # no flag yet: a no-op
+
+    assert trigger("worker 1 exited with code 17", rank=1,
+                   source="launcher", addr=addr, port=port, secret=secret)
+    assert _wait_for(lambda: hb.abort_info is not None)
+    with pytest.raises(HorovodAbortError) as exc:
+        hb_mod.maybe_raise_abort()
+    msg = str(exc.value)
+    # the acceptance contract: the error NAMES the dead rank and reason
+    assert "worker 1 exited with code 17" in msg
+    assert "failing rank 1" in msg and "launcher" in msg
+    # GET /health carries the flag too
+    health = get_health(addr, port, secret=secret)
+    assert health["abort"]["rank"] == 1
+
+    # the flag is also readable directly (launcher/tooling side)
+    flag = read_flag(addr, port, secret=secret)
+    assert flag["source"] == "launcher" and flag["rank"] == 1
+
+
+def test_abort_api_sets_flag_and_raises(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", addr)
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", str(port))
+    monkeypatch.setenv("HVD_METRICS_SECRET", secret.hex())
+    monkeypatch.setenv("HVD_PROCESS_ID", "1")
+    import horovod_tpu as hvd
+
+    with pytest.raises(HorovodAbortError, match="input pipeline died"):
+        hvd.abort("input pipeline died")
+    flag = read_flag(addr, port, secret=secret)
+    assert flag["reason"] == "input pipeline died"
+    assert flag["rank"] == 1 and flag["source"] == "api"
+
+
+def test_start_from_env_gates(monkeypatch, rdv):
+    server, addr, port, secret = rdv
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", addr)
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", str(port))
+    monkeypatch.setenv("HVD_METRICS_SECRET", secret.hex())
+    # single process: no peers, no heartbeat
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "1")
+    assert hb_mod.start_from_env() is None
+    # multi-process but disabled
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "2")
+    monkeypatch.setenv("HVD_HEARTBEAT_DISABLE", "1")
+    assert hb_mod.start_from_env() is None
+    # armed
+    monkeypatch.delenv("HVD_HEARTBEAT_DISABLE")
+    monkeypatch.setenv("HVD_PROCESS_ID", "1")
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.1")
+    hb = hb_mod.start_from_env()
+    assert hb is not None and hb.rank == 1 and hb.size == 2
+    assert hb.interval == pytest.approx(0.1)
+    assert _wait_for(lambda: hb.beats >= 1)
+
+
+# -- stall inspector routes through the coordinated abort --------------------
+def test_stall_shutdown_sets_abort_flag_first(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", addr)
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", str(port))
+    monkeypatch.setenv("HVD_METRICS_SECRET", secret.hex())
+    exits = []
+    monkeypatch.setattr(os, "_exit", exits.append)
+    from horovod_tpu.runtime.stall_inspector import StallInspector
+
+    StallInspector._default_shutdown("allreduce.wedged")
+    assert exits == [1]  # still terminates locally...
+    flag = read_flag(addr, port, secret=secret)  # ...but flags the job first
+    assert flag["source"] == "stall_inspector"
+    assert "allreduce.wedged" in flag["reason"]
+
+
+# -- HTTP client: retries with backoff ---------------------------------------
+class _FlakyHandler(http.server.BaseHTTPRequestHandler):
+    """Returns 500 for the first ``fail_first`` requests of each method,
+    then succeeds; counts attempts per method."""
+
+    def _serve(self):
+        counts = self.server.counts  # type: ignore[attr-defined]
+        counts[self.command] = counts.get(self.command, 0) + 1
+        if counts[self.command] <= self.server.fail_first:  # type: ignore
+            self.send_response(500)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        body = b"ok"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_PUT = do_DELETE = _serve
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def flaky_server():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    srv.counts = {}
+    srv.fail_first = 2
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    t.join(timeout=5)
+
+
+def test_get_retries_transient_5xx(flaky_server, monkeypatch):
+    monkeypatch.setenv("HVD_HTTP_RETRIES", "3")
+    monkeypatch.setenv("HVD_HTTP_BACKOFF_MS", "1")
+    out = get_kv("127.0.0.1", flaky_server.server_port, "s", "k")
+    assert out == b"ok"
+    assert flaky_server.counts["GET"] == 3  # 2 failures + 1 success
+
+
+def test_get_retry_budget_exhausts(flaky_server, monkeypatch):
+    flaky_server.fail_first = 100
+    monkeypatch.setenv("HVD_HTTP_RETRIES", "2")
+    monkeypatch.setenv("HVD_HTTP_BACKOFF_MS", "1")
+    with pytest.raises(urllib.error.HTTPError):
+        get_kv("127.0.0.1", flaky_server.server_port, "s", "k")
+    assert flaky_server.counts["GET"] == 3  # initial + 2 retries, then raise
+
+
+def test_put_not_retried_unless_opted_in(flaky_server, monkeypatch):
+    monkeypatch.setenv("HVD_HTTP_RETRIES", "3")
+    monkeypatch.setenv("HVD_HTTP_BACKOFF_MS", "1")
+    with pytest.raises(urllib.error.HTTPError):
+        put_kv("127.0.0.1", flaky_server.server_port, "s", "k", b"v")
+    assert flaky_server.counts["PUT"] == 1  # non-idempotent: no retry
+
+    # opted in: the remaining failure (the server 500s the first two PUTs
+    # total) is retried through to success
+    put_kv("127.0.0.1", flaky_server.server_port, "s", "k", b"v", retry=True)
+    assert flaky_server.counts["PUT"] == 3  # 1 earlier + 1 failed + 1 ok
+
+
+def test_urlerror_retried_then_raised(monkeypatch):
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    monkeypatch.setenv("HVD_HTTP_RETRIES", "2")
+    monkeypatch.setenv("HVD_HTTP_BACKOFF_MS", "1")
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.URLError):
+        get_kv("127.0.0.1", dead_port, "s", "k")
+    assert time.monotonic() - t0 < 10.0  # bounded, not an infinite retry
+
+
+def test_injected_http_drop_exercises_retry_path(monkeypatch, rdv):
+    """The http seam + retry policy compose: a prob=1 http_drop exhausts
+    the retry budget and surfaces as URLError (a prob<1 drop would be
+    absorbed) — the fault the satellite knob exists to rehearse."""
+    server, addr, port, secret = rdv
+    monkeypatch.setenv("HVD_FAULT_SPEC", "kind=http_drop:restart=*")
+    monkeypatch.setenv("HVD_HTTP_RETRIES", "2")
+    monkeypatch.setenv("HVD_HTTP_BACKOFF_MS", "1")
+    faults_mod.reset()
+    try:
+        with pytest.raises(urllib.error.URLError, match="injected"):
+            get_kv(addr, port, "s", "k", secret=secret)
+    finally:
+        faults_mod.reset()
+
+
+def test_get_kv_wait_backoff_still_rendezvouses(rdv):
+    server, addr, port, secret = rdv
+
+    def late_put():
+        time.sleep(0.3)
+        put_kv(addr, port, "late", "k", b"v", secret=secret)
+
+    t = threading.Thread(target=late_put)
+    t.start()
+    assert get_kv(addr, port, "late", "k", secret=secret,
+                  wait=True, timeout=10.0) == b"v"
+    t.join()
+
+
+# -- kill escalation ---------------------------------------------------------
+def _spawn_child(src: str) -> subprocess.Popen:
+    p = subprocess.Popen([sys.executable, "-u", "-c", src],
+                         stdout=subprocess.PIPE, text=True)
+    assert p.stdout.readline().strip() == "go"
+    return p
+
+
+def test_kill_all_escalates_to_sigkill():
+    """A worker wedged in a collective ignores SIGTERM; before the
+    escalation the launcher leaked it forever."""
+    from horovod_tpu.run.run import _Job
+
+    p = _spawn_child(
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "print('go', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    job = _Job()
+    job.procs.append(p)
+    t0 = time.monotonic()
+    job.kill_all(grace=0.5)
+    p.wait(timeout=10)
+    assert time.monotonic() - t0 < 10
+    assert p.returncode == -signal.SIGKILL
+
+
+def test_kill_all_sigterm_suffices_without_escalation():
+    from horovod_tpu.run.run import _Job
+
+    p = _spawn_child(
+        "import time\nprint('go', flush=True)\ntime.sleep(120)\n"
+    )
+    job = _Job()
+    job.procs.append(p)
+    job.kill_all(grace=5.0)
+    p.wait(timeout=10)
+    assert p.returncode == -signal.SIGTERM  # killed by the polite signal
+
+
+# -- event-driven supervision ------------------------------------------------
+def test_supervisor_reacts_to_non_rank0_failure(monkeypatch):
+    """A crashed rank 1 must tear the job down while rank 0 is still
+    mid-sleep — the old wait loop blocked in procs[0].wait() and only
+    noticed after rank 0 finished (or never)."""
+    from horovod_tpu.run.run import run_commandline
+
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.2")
+    monkeypatch.setenv("HVD_TERM_GRACE_SECONDS", "1")
+    script = (
+        "import os, sys, time\n"
+        "sys.exit(7) if os.environ['HVD_PROCESS_ID'] == '1' "
+        "else time.sleep(120)\n"
+    )
+    t0 = time.monotonic()
+    rc = run_commandline([
+        "-np", "2", "-H", "localhost:1,127.0.0.1:1", "--controller", "xla",
+        sys.executable, "-c", script,
+    ])
+    elapsed = time.monotonic() - t0
+    assert rc == 7  # the FIRST failure's code propagates
+    assert elapsed < 30, f"supervisor blocked for {elapsed:.0f}s"
+
+
+# -- tier-1 smoke: crash → abort → restart → resume --------------------------
+def test_tpurun_restart_resumes_from_checkpoint(tmp_path, monkeypatch,
+                                                capsys):
+    """The acceptance loop end-to-end: HVD_FAULT_SPEC kills rank 1 at its
+    step 3; rank 0 exits in seconds raising HorovodAbortError naming
+    rank 1 (no hang-until-timeout); --restarts 1 relaunches after
+    backoff; ElasticState.resume() restores the newest checkpoint; the
+    final state matches an uninterrupted run (w == 6 after 6 unit
+    increments) and tpurun exits 0."""
+    from horovod_tpu.run.run import run_commandline
+    from horovod_tpu.utils.checkpoint import latest_step
+
+    ckpt = tmp_path / "ckpt"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "from horovod_tpu.elastic import faults, heartbeat\n"
+        "from horovod_tpu.elastic.state import ElasticState\n"
+        "from horovod_tpu.run.http_client import get_kv, put_kv\n"
+        "from horovod_tpu.utils.checkpoint import save_checkpoint\n"
+        "rank = int(os.environ['HVD_PROCESS_ID'])\n"
+        "heartbeat.start_from_env()\n"
+        "# warm up jax + orbax OUTSIDE the supervised window (the first\n"
+        "# save pays several seconds of backend init; a mid-save kill\n"
+        "# would leave attempt 0 with no checkpoint at all)\n"
+        f"scratch = os.path.join({str(tmp_path)!r}, f'warmup.{{rank}}')\n"
+        "save_checkpoint(scratch, {'w': np.zeros(2, np.float32)}, step=0)\n"
+        "# start barrier over the rendezvous KV: interpreter start-up\n"
+        "# skew must not let one rank crash before the other has begun\n"
+        "addr = os.environ['HVD_METRICS_KV_ADDR']\n"
+        "port = int(os.environ['HVD_METRICS_KV_PORT'])\n"
+        "secret = bytes.fromhex(os.environ['HVD_METRICS_SECRET'])\n"
+        "gen = os.environ['HVD_RESTART_COUNT']\n"
+        "put_kv(addr, port, 'sync', f'ready.{rank}.{gen}', b'1', secret)\n"
+        "assert get_kv(addr, port, 'sync', f'ready.{1 - rank}.{gen}',\n"
+        "              secret, wait=True, timeout=120) is not None\n"
+        f"es = ElasticState({str(ckpt)!r}, {{'w': np.zeros(2, np.float32)}})\n"
+        "state, start = es.resume()\n"
+        "print('START', rank, start, os.environ['HVD_RESTART_COUNT'],\n"
+        "      flush=True)\n"
+        "for step in range(start, 6):\n"
+        "    heartbeat.maybe_raise_abort()\n"
+        "    faults.on_step()\n"
+        "    time.sleep(0.4 if rank == 0 else 0.2)\n"
+        "    state['w'] = state['w'] + 1.0\n"
+        "    es.state = state\n"
+        "    if rank == 0:\n"
+        "        es.save(step + 1)\n"
+        "print('DONE', rank, float(state['w'][0]), flush=True)\n"
+    )
+    monkeypatch.setenv("HVD_FAULT_SPEC", "rank=1:step=3:kind=crash")
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.3")
+    monkeypatch.setenv("HVD_TERM_GRACE_SECONDS", "2")
+    monkeypatch.setenv("HVD_RESTART_BACKOFF_SECONDS", "0.2")
+    monkeypatch.setenv("HVD_METRICS_PUSH_SECONDS", "3600")
+
+    rc = run_commandline([
+        "-np", "2", "-H", "localhost:1,127.0.0.1:1", "--controller", "xla",
+        "--restarts", "1",
+        sys.executable, str(script),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out[-3000:]
+    # attempt 0: rank 1 crashed (exit 17); rank 0 raised the coordinated
+    # abort NAMING rank 1, instead of sleeping out its remaining steps
+    assert "HorovodAbortError" in out, out[-3000:]
+    assert "worker 1 exited with code %d" % FAULT_EXIT_CODE in out
+    assert "failing rank 1" in out
+    # attempt 1 resumed from a checkpoint, not from scratch...
+    resumed = [l for l in out.splitlines()
+               if "START" in l and l.rstrip().endswith("1")]
+    assert resumed, out[-3000:]
+    assert all(int(l.split()[-2]) > 0 for l in resumed), resumed
+    # ...and the final state matches an uninterrupted 6-step run
+    assert "DONE 0 6.0" in out and "DONE 1 6.0" in out
+    assert latest_step(str(ckpt)) == 6
+
+
+def test_make_flag_records_rank_from_env(monkeypatch):
+    monkeypatch.setenv("HVD_PROCESS_ID", "5")
+    flag = make_flag("why", source="api")
+    assert flag["rank"] == 5 and flag["reason"] == "why"
+    assert json.loads(json.dumps(flag)) == flag  # wire-serializable
