@@ -87,6 +87,9 @@ class _GlobalState:
 
 _state = _GlobalState()
 _lock = threading.Lock()
+# The kwargs of the last successful init(), replayed by reinit() so an
+# elastic membership change rebuilds against the same device selection.
+_init_kwargs: dict = {}
 
 
 class _SpmdContext(threading.local):
@@ -133,10 +136,24 @@ def init(
       comm: optional subset of global device indices to form the world from
         (reference operations.cc:655-663 ranks argument).
     """
-    global _state
+    global _state, _init_kwargs
     with _lock:
         if _state.initialized:
             return
+        _init_kwargs = {
+            "platform": platform, "devices": devices,
+            "local_size": local_size, "comm": comm,
+        }
+        # Elastic membership: adopt the committed epoch FIRST — a shrink
+        # that raced this process's start-up rewrote the world, and the
+        # identity/controller env must be read post-adoption (the ack
+        # doubles as the driver's start barrier).
+        try:
+            from .elastic import membership
+
+            membership.attach()
+        except Exception as e:  # noqa: BLE001 — membership must never
+            log.warning("membership attach failed: %s", e)  # block init
         if os.environ.get("HVD_COORDINATOR_ADDR"):
             # Multi-host bootstrap: the tpurun launcher sets these.  This is
             # the rendezvous step — the analog of GlooContext::Initialize's
@@ -210,8 +227,11 @@ def init(
         # the native-controller-only deployment, where the XLA plane stays
         # per-process but the eager control/data planes span the job
         # (reference gloo_context.cc:128-156 reads HOROVOD_RANK/SIZE the
-        # same way).
-        if jax_nproc > 1:
+        # same way).  Elastic jobs always use the env identity: membership
+        # epochs rewrite HVD_NUM_PROCESSES/HVD_PROCESS_ID on every world
+        # change, while jax.distributed cannot be resized in process and
+        # would pin the stale pre-shrink world.
+        if jax_nproc > 1 and not env_util.get_bool(env_util.HVD_ELASTIC):
             process_index, process_count = jax_pidx, jax_nproc
         else:
             process_count = env_util.get_int(env_util.HVD_NUM_PROCESSES, 1)
@@ -304,6 +324,24 @@ def shutdown() -> None:
         pass
     with _lock:
         _state = _GlobalState(epoch=_state.epoch + 1)
+
+
+def reinit() -> None:
+    """Tear down and re-initialize in process against the *current*
+    environment — the elastic-membership rebuild (docs/fault_tolerance.md):
+    after the driver commits a new epoch, `elastic/membership.py` rewrites
+    ``HVD_NUM_PROCESSES``/``HVD_PROCESS_ID``/``HVD_CONTROLLER_ADDR`` and
+    calls this, which re-creates the mesh, reconnects the eager controller
+    client to the epoch's fresh ControllerServer, and restarts the
+    heartbeat/metrics daemons — no process relaunch, no JIT cache loss
+    beyond the step functions that must re-trace over the new mesh
+    (training.make_train_step rebuilds those lazily via the mesh epoch).
+
+    The device selection of the last :func:`init` is replayed; callers
+    that never initialized get a plain :func:`init`."""
+    kwargs = dict(_init_kwargs)
+    shutdown()
+    init(**kwargs)
 
 
 def is_initialized() -> bool:

@@ -1,6 +1,6 @@
 """Elastic / failure-domain runtime.
 
-Two halves:
+Three layers:
 
 * **join** (join.py) — uneven-data participation, the reference's
   ``hvd.join()`` contract in compiled-SPMD form.
@@ -10,8 +10,19 @@ Two halves:
   :class:`HorovodAbortError` at the dispatch/train-step seams,
   :class:`ElasticState` auto-resume under ``tpurun --restarts``, and the
   ``HVD_FAULT_SPEC`` fault-injection harness that tests all of it.
+* **elastic membership** (membership.py worker side, driver.py launcher
+  side; ``tpurun --elastic``) — shrink/grow worlds through committed
+  membership epochs: survivors rebuild in process (``core.reinit()``),
+  ranks are re-assigned densely, state re-syncs via rank-0 in-memory
+  broadcast, and spare hosts rejoin at epoch boundaries without a
+  relaunch.  :func:`run` is the ``@hvd.elastic.run`` analog.
 """
 
 from .abort import HorovodAbortError, abort  # noqa: F401
 from .state import ElasticState  # noqa: F401
-from . import faults, heartbeat  # noqa: F401
+from .membership import (  # noqa: F401
+    RemovedFromWorldError,
+    join_world,
+    run,
+)
+from . import driver, faults, heartbeat, membership  # noqa: F401

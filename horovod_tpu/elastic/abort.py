@@ -45,8 +45,13 @@ class HorovodAbortError(RuntimeError):
 def format_abort(info: dict) -> str:
     who = info.get("rank")
     src = info.get("source", "unknown")
-    where = f" (reported by {src}" + (
-        f", failing rank {who})" if who is not None else ")")
+    epoch = info.get("epoch")
+    parts = [f"reported by {src}"]
+    if who is not None:
+        parts.append(f"failing rank {who}")
+    if epoch is not None:
+        parts.append(f"membership epoch {epoch}")
+    where = f" ({', '.join(parts)})"
     return f"coordinated abort: {info.get('reason', '<no reason>')}{where}"
 
 
@@ -64,17 +69,25 @@ def _rendezvous_from_env():
 
 
 def make_flag(reason: str, *, rank: Optional[int] = None,
-              source: str = "api") -> dict:
+              source: str = "api", epoch: Optional[int] = None) -> dict:
+    """``epoch`` scopes the flag to one membership epoch: the elastic
+    driver stamps the epoch it is aborting, and heartbeats of a LATER
+    epoch ignore the flag (a survivor that already rebuilt must not be
+    re-aborted by the stale flag of the world it just left).  ``None``
+    (the launcher/stall/api flags) is honored by every epoch."""
     if rank is None:
         rank = env_util.get_int(env_util.HVD_PROCESS_ID, -1)
         rank = rank if rank >= 0 else None
-    return {
+    flag = {
         "reason": str(reason),
         "rank": rank,
         "source": source,
         "pid": os.getpid(),
         "time": time.time(),
     }
+    if epoch is not None:
+        flag["epoch"] = int(epoch)
+    return flag
 
 
 def publish(flag: dict, *, addr: Optional[str] = None,

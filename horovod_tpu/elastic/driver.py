@@ -1,0 +1,363 @@
+"""Elastic driver: the launcher side of shrink/grow worlds.
+
+Owned by the ``tpurun --elastic`` supervisor (run/run.py) — the analog of
+the reference's ElasticDriver + host discovery loop (reference
+horovod/run/elastic/driver.py: worker state machine, host blacklisting,
+rank re-assignment), re-based on the rendezvous server this repo already
+runs for metrics/heartbeats:
+
+* the driver **commits membership epochs** (elastic/membership.py wire
+  layout) instead of killing the job on the first failure;
+* worker death is detected two ways — child-process exit (the supervise
+  loop polls every worker, whichever rank dies first) and **heartbeat
+  lease expiry** on the server's own clock (which also catches network
+  partitions: a ``kind=partition`` rank is alive but cannot renew);
+* each epoch gets a **fresh ControllerServer** sized to the new world,
+  so the native negotiation plane can never mix epochs;
+* a worker removed ``HVD_ELASTIC_MAX_FLAPS`` times is **blocklisted**
+  and its rejoin announcements are ignored (flapping hosts must not
+  thrash the job with rebuild churn);
+* rejoin announcements are admitted at the next epoch boundary, once
+  the current epoch is stable (every member acked its rebuild).
+
+The driver never relaunches processes itself — that remains ``tpurun
+--restarts``'s job, and the two compose: the driver shrinks past
+failures while ``len(world) >= min_np``, and only when the floor is
+violated does it give up, letting the restart loop do a full relaunch.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..run.http_server import (
+    ABORT_KEY,
+    ABORT_SCOPE,
+    ANNOUNCE_PREFIX,
+    BLOCKLIST_KEY,
+    EPOCH_KEY,
+    HEALTH_SCOPE,
+    MEMBERSHIP_SCOPE,
+    READY_PREFIX,
+    STATE_PREFIX,
+)
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+from .abort import make_flag
+
+log = get_logger(__name__)
+
+
+class ElasticDriver:
+    """Membership authority for one job incarnation.
+
+    ``rdv_server``: the launcher's RendezvousServer (direct in-process
+    access — the driver is its single membership writer).
+    ``worker_ids``: the initial roster, in rank order.
+    ``controller``: "native" stands up a per-epoch ControllerServer and
+    publishes its address in each epoch record; anything else leaves the
+    eager plane controller-less (compiled-schedule-only jobs, tests).
+    """
+
+    def __init__(self, rdv_server, worker_ids: Sequence[str], *,
+                 min_np: int = 1, controller: str = "xla",
+                 controller_host: str = "127.0.0.1",
+                 max_flaps: Optional[int] = None):
+        self.server = rdv_server
+        self.min_np = max(int(min_np), 1)
+        self.controller = controller
+        self.controller_host = controller_host
+        self.max_flaps = int(
+            max_flaps if max_flaps is not None
+            else env_util.get_int(env_util.HVD_ELASTIC_MAX_FLAPS,
+                                  env_util.DEFAULT_ELASTIC_MAX_FLAPS))
+        self.epoch = -1
+        self.initial = set(str(w) for w in worker_ids)
+        self.world: List[str] = []
+        self.flaps: Dict[str, int] = {}
+        self.blocklist: set = set()
+        self.finished: set = set()   # members that exited 0 (end of training)
+        self.failed_reason: Optional[str] = None  # set when below min_np
+        self.ctrl_server = None
+        self.controller_addr: Optional[str] = None
+        self._commit_time = 0.0
+        self._stable = False
+        self._hb_interval = env_util.get_float(
+            env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
+            env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS)
+        self._timeout = env_util.get_float(
+            env_util.HVD_ELASTIC_TIMEOUT_SECONDS,
+            env_util.DEFAULT_ELASTIC_TIMEOUT_SECONDS)
+        self.commit(list(worker_ids), reason="initial world")
+
+    # -- epoch commits -------------------------------------------------------
+    def commit(self, world: List[str], *, removed: Sequence[str] = (),
+               admitted: Sequence[str] = (), reason: str = "") -> dict:
+        """Commit the next membership epoch: rebuild the per-epoch
+        controller server, publish the record, and reset the stability
+        barrier.  Single writer — only the driver calls this."""
+        self.epoch += 1
+        self.world = list(world)
+        if self.controller == "native":
+            old = self.ctrl_server
+            from ..runtime.controller import ControllerServer
+
+            self.ctrl_server = ControllerServer(len(world), port=0)
+            self.controller_addr = (
+                f"{self.controller_host}:{self.ctrl_server.port}")
+            if old is not None:
+                # survivors' clients reconnect during reinit; the dead
+                # epoch's server holds half-negotiated state and must go
+                old.stop()
+        rec = {
+            "epoch": self.epoch,
+            "world": self.world,
+            "size": len(self.world),
+            "removed": list(removed),
+            "admitted": list(admitted),
+            "controller_addr": self.controller_addr,
+            "reason": reason,
+            "time": time.time(),
+        }
+        # health first: stale leases keyed by the OLD ranks must not read
+        # as deaths in the new epoch (new heartbeats re-populate on ack)
+        self.server.clear_scope(HEALTH_SCOPE)
+        self.server.put(MEMBERSHIP_SCOPE, EPOCH_KEY,
+                        json.dumps(rec).encode())
+        self.server.put(MEMBERSHIP_SCOPE, BLOCKLIST_KEY,
+                        json.dumps(sorted(self.blocklist)).encode())
+        self._commit_time = time.monotonic()
+        self._stable = False
+        from .. import metrics
+
+        if metrics.on():
+            metrics.MEMBERSHIP_EPOCHS.inc()
+            if removed:
+                metrics.RANKS_REMOVED.inc(len(removed))
+            if admitted:
+                metrics.RANKS_ADMITTED.inc(len(admitted))
+        log.warning("membership epoch %d committed: world=%s removed=%s "
+                    "admitted=%s (%s)", self.epoch, self.world,
+                    list(removed), list(admitted), reason)
+        return rec
+
+    # -- membership changes --------------------------------------------------
+    def remove(self, worker: str, reason: str) -> bool:
+        """Shrink the world past ``worker``.  Workers that already
+        finished cleanly are drained from the roster in the same commit
+        (they will never ack or heartbeat again — leaving them in would
+        hang the stability barrier and hand rank 0 to an exited
+        process).  Returns False (and records ``failed_reason``) when
+        the LIVE remainder would violate ``min_np`` — the caller must
+        then fail the job the fail-stop way."""
+        if worker not in self.world:
+            return True
+        drained = [w for w in self.world
+                   if w != worker and w in self.finished]
+        survivors = [w for w in self.world
+                     if w != worker and w not in self.finished]
+        if len(survivors) < self.min_np:
+            self.failed_reason = (
+                f"{reason}; world would shrink to {len(survivors)} < "
+                f"min_np {self.min_np}")
+            return False
+        self.flaps[worker] = self.flaps.get(worker, 0) + 1
+        if self.flaps[worker] >= self.max_flaps:
+            self.blocklist.add(worker)
+            log.warning("worker %s blocklisted after %d removals",
+                        worker, self.flaps[worker])
+        old_rank = self.world.index(worker)
+        # the lease itself is revoked by commit()'s HEALTH-scope reset
+        self._publish_abort(reason, rank=old_rank)
+        if drained:
+            reason = f"{reason} (drained finished worker(s) {drained})"
+        self.commit(survivors, removed=[worker], reason=reason)
+        return True
+
+    def admit(self, workers: Sequence[str],
+              reason: str = "rejoin") -> Optional[dict]:
+        """Grow the world by ``workers`` at this epoch boundary (the
+        running members are interrupted through the same abort seam a
+        shrink uses — rejoin is the shrink path in reverse)."""
+        workers = [w for w in workers
+                   if w not in self.blocklist and w not in self.world]
+        if not workers:
+            return None
+        self._publish_abort(
+            f"admitting worker(s) {workers} into epoch {self.epoch + 1}",
+            rank=None)
+        return self.commit(self.world + list(workers), admitted=workers,
+                           reason=reason)
+
+    def _publish_abort(self, reason: str, rank: Optional[int]) -> None:
+        """Stamp the flag with the epoch being aborted so survivors that
+        already rebuilt ignore it (elastic/heartbeat.py epoch filter)."""
+        flag = make_flag(reason, rank=rank, source="elastic_driver",
+                         epoch=self.epoch)
+        self.server.put(ABORT_SCOPE, ABORT_KEY, json.dumps(flag).encode())
+
+    # -- the periodic poll ---------------------------------------------------
+    def _ready_workers(self, epoch: int) -> set:
+        prefix = f"{READY_PREFIX}{epoch}."
+        return {k[len(prefix):]
+                for k in self.server.scope_items(MEMBERSHIP_SCOPE)
+                if k.startswith(prefix)}
+
+    def _announced(self) -> set:
+        return {k[len(ANNOUNCE_PREFIX):]
+                for k in self.server.scope_items(MEMBERSHIP_SCOPE)
+                if k.startswith(ANNOUNCE_PREFIX)}
+
+    def _gc(self) -> None:
+        """Drop rebuild artifacts of finished epochs (state blobs and
+        ready acks below the current epoch) so a long-lived job's store
+        stays bounded."""
+        for key in list(self.server.scope_items(MEMBERSHIP_SCOPE)):
+            for prefix in (STATE_PREFIX, READY_PREFIX):
+                if key.startswith(prefix):
+                    epoch_s = key[len(prefix):].split(".", 1)[0]
+                    if epoch_s.isdigit() and int(epoch_s) < self.epoch:
+                        self.server.delete(MEMBERSHIP_SCOPE, key)
+
+    def poll(self) -> None:
+        """One supervision tick: advance the stability barrier, remove
+        lease-dead members, admit pending announcements."""
+        now = time.monotonic()
+        if not self._stable:
+            acked = self._ready_workers(self.epoch)
+            if set(self.world) <= acked:
+                self._stable = True
+            elif now - self._commit_time > self._timeout:
+                log.warning(
+                    "epoch %d stability timeout: %s never acked; "
+                    "proceeding without the barrier", self.epoch,
+                    sorted(set(self.world) - acked))
+                self._stable = True
+            if self._stable:
+                # the aborted epoch is fully drained: the flag and the
+                # old rebuild artifacts can go
+                self.server.clear_scope(ABORT_SCOPE)
+                self._gc()
+        # lease expiry (partitions, silent deaths of external members):
+        # enforced only on a STABLE epoch — mid-rebuild, a survivor may
+        # legitimately be silent for a whole step/save between observing
+        # the abort and restarting its heartbeat, and that silence must
+        # not read as a second failure
+        if self._stable and now - self._commit_time > 2.0 * self._hb_interval:
+            report = self.server.health_report()
+            # rank keys in the report refer to THIS roster; a mid-loop
+            # remove() re-assigns ranks densely, so indexing self.world
+            # with later keys would name the wrong (live) worker
+            roster = list(self.world)
+            for rank_s, info in report.get("ranks", {}).items():
+                if info.get("verdict") != "dead":
+                    continue
+                if not rank_s.isdigit() or int(rank_s) >= len(roster):
+                    continue  # a stale key from a previous epoch
+                worker = roster[int(rank_s)]
+                if worker in self.finished or worker not in self.world:
+                    continue  # exited 0 / already removed this pass
+                self.remove(worker, f"rank {rank_s} (worker {worker}) "
+                            "heartbeat lease expired")
+        if self._stable and self.failed_reason is None \
+                and not self.finished:
+            # no admissions once any member finished: the job is winding
+            # down, and a joiner would inherit a roster of exiting peers
+            announced = self._announced()
+            for w in sorted(announced & self.blocklist):
+                # a blocklisted flapper's announce can never be admitted;
+                # leaving the key would read as a forever-pending rejoin
+                self.server.delete(MEMBERSHIP_SCOPE, f"{ANNOUNCE_PREFIX}{w}")
+            pending = sorted(announced - set(self.world) - self.blocklist)
+            if pending:
+                for w in pending:
+                    self.server.delete(MEMBERSHIP_SCOPE,
+                                       f"{ANNOUNCE_PREFIX}{w}")
+                self.admit(pending)
+
+    # -- supervision ---------------------------------------------------------
+    def supervise(self, job, poll_interval: float = 0.2) -> int:
+        """Drive the job to completion: ``job.procs[i]`` is the child of
+        initial worker ``str(i)``.  Child failures shrink the world (or
+        fail the job below ``min_np``); externally admitted workers are
+        tracked through their leases only.  Returns 0 when every worker
+        still in the world exited cleanly."""
+        procs = job.procs
+        handled: set = set()
+        while True:
+            self.poll()
+            states = [p.poll() for p in procs]
+            for wid, code in enumerate(states):
+                w = str(wid)
+                if code is None or w in handled:
+                    continue
+                handled.add(w)
+                if code == 0:
+                    self.finished.add(w)
+                    continue
+                if w in self.world:
+                    if not self.remove(
+                            w, f"worker {w} exited with code {code}"):
+                        log.error("elastic give-up: %s", self.failed_reason)
+                        self._publish_giveup(self.failed_reason)
+                        job.kill_all()
+                        return code
+                else:
+                    log.info("already-removed worker %s exited with code "
+                             "%d", w, code)
+            if self.failed_reason is not None:
+                # a lease-expiry removal inside poll() hit the min_np
+                # floor: fail the job the fail-stop way
+                log.error("elastic give-up: %s", self.failed_reason)
+                self._publish_giveup(self.failed_reason)
+                job.kill_all()
+                return 1
+            if all(c is not None for c in states):
+                bad = [c for wid, c in enumerate(states)
+                       if str(wid) in self.world and c != 0]
+                if not bad:
+                    self._drain_external()
+                return bad[0] if bad else 0
+            time.sleep(poll_interval)
+
+    def _drain_external(self) -> None:
+        """Externally admitted joiners have no child process to wait on;
+        give them up to the elastic timeout to finish (their heartbeat
+        lease going dead is the exit signal) before the launcher tears
+        the rendezvous down from under them.  Their exit codes cannot be
+        observed — a joiner's failure does not change the job result."""
+        external = set(self.world) - self.initial - self.finished
+        if not external:
+            return
+        log.info("waiting up to %.0fs for externally admitted worker(s) "
+                 "%s to finish", self._timeout, sorted(external))
+        deadline = time.monotonic() + self._timeout
+        while time.monotonic() < deadline:
+            report = self.server.health_report()
+            live = set()
+            for w in external:
+                if w not in self.world:
+                    continue
+                info = report.get("ranks", {}).get(
+                    str(self.world.index(w)))
+                if info is not None and info.get("verdict") != "dead":
+                    live.add(w)
+            if not live:
+                return
+            time.sleep(0.5)
+        log.warning("externally admitted worker(s) still live at "
+                    "teardown: %s", sorted(external))
+
+    def _publish_giveup(self, reason: Optional[str]) -> None:
+        """An epoch-less abort flag: honored by EVERY epoch, so all
+        survivors (including external joiners) stop."""
+        flag = make_flag(reason or "elastic driver gave up", rank=None,
+                         source="elastic_driver")
+        self.server.put(ABORT_SCOPE, ABORT_KEY, json.dumps(flag).encode())
+
+    def shutdown(self) -> None:
+        if self.ctrl_server is not None:
+            self.ctrl_server.stop()
+            self.ctrl_server = None

@@ -10,13 +10,16 @@ the full failure-domain loop deterministically:
 * **dispatch** — every eager collective dispatch
   (eager._dispatch_guard);
 * **http** — the rendezvous HTTP client (run/http_client.py), to
-  exercise its retry/backoff path.
+  exercise its retry/backoff path;
+* **controller** — the eager-plane negotiation handshake
+  (runtime/eager_controller.negotiate).
 
 Grammar (specs separated by ``;``, fields by ``:``, ``key=value``)::
 
     HVD_FAULT_SPEC="rank=1:step=3:kind=crash"
     HVD_FAULT_SPEC="rank=*:kind=slow=200ms:prob=0.5;rank=0:step=10:kind=hang"
     HVD_FAULT_SPEC="kind=http_drop:prob=0.3:restart=*"
+    HVD_FAULT_SPEC="rank=1:step=4:kind=partition"
 
 Fields:
 
@@ -26,11 +29,17 @@ Fields:
 ``kind``     ``crash`` (``os._exit(17)`` — a sudden worker death),
              ``hang`` (sleep forever, the wedged-collective shape),
              ``slow=<dur>`` (inject ``<dur>`` latency, e.g. ``200ms`` /
-             ``1.5s``, then continue), or ``http_drop`` (raise
-             ``URLError`` from the HTTP client).
+             ``1.5s``, then continue), ``http_drop`` (raise
+             ``URLError`` from the HTTP client), or ``partition`` (a
+             network split: from the firing point on, EVERY rendezvous
+             HTTP request raises ``URLError`` and every controller
+             negotiation raises ``TimeoutError``, while the process
+             itself stays alive — heartbeat leases expire and the
+             elastic driver removes the rank without a process death).
 ``prob``     float in [0, 1] (default 1.0).
-``seam``     ``step`` / ``dispatch`` / ``http``; defaults to ``http``
-             for ``http_drop`` and ``step`` otherwise.
+``seam``     ``step`` / ``dispatch`` / ``http`` / ``controller``;
+             defaults to ``http`` for ``http_drop`` and ``step``
+             otherwise.
 ``restart``  int or ``*`` (default 0): the ``HVD_RESTART_COUNT``
              incarnation the fault applies to.  The default means a
              crash fires on the first run only, so a supervised restart
@@ -56,8 +65,8 @@ log = get_logger(__name__)
 #: in launcher logs and test assertions.
 FAULT_EXIT_CODE = 17
 
-KINDS = ("crash", "hang", "slow", "http_drop")
-SEAMS = ("step", "dispatch", "http")
+KINDS = ("crash", "hang", "slow", "http_drop", "partition")
+SEAMS = ("step", "dispatch", "http", "controller")
 
 _DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m)?$")
 _DUR_SCALE = {"ms": 1e-3, "s": 1.0, "m": 60.0, None: 1.0}
@@ -157,6 +166,9 @@ class FaultInjector:
         self.restart = int(restart)
         self._counts = {seam: 0 for seam in SEAMS}
         self._lock = threading.Lock()
+        # once a `partition` fault fires, this process's rendezvous +
+        # controller traffic is dropped for good (the network-split shape)
+        self.partitioned = False
 
     def fire(self, seam: str, detail: str = "") -> None:
         with self._lock:
@@ -189,6 +201,8 @@ class FaultInjector:
                 time.sleep(3600)
         elif f.kind == "slow":
             time.sleep(f.duration)
+        elif f.kind == "partition":
+            self.partitioned = True
         elif f.kind == "http_drop":
             import urllib.error
 
@@ -250,7 +264,26 @@ def on_dispatch(name: str) -> None:
 
 
 def on_http(path: str) -> None:
-    """The HTTP-client seam (run/http_client._request)."""
+    """The HTTP-client seam (run/http_client._request).  A partitioned
+    process drops every rendezvous request from the firing point on."""
     inj = instance()
     if inj is not None:
         inj.fire("http", detail=path)
+        if inj.partitioned:
+            import urllib.error
+
+            raise urllib.error.URLError(
+                f"injected partition: rendezvous traffic dropped ({path})")
+
+
+def on_controller(name: str) -> None:
+    """The controller-negotiation seam (runtime/eager_controller.
+    negotiate).  A partitioned process's negotiations time out the way a
+    real network split's would."""
+    inj = instance()
+    if inj is not None:
+        inj.fire("controller", detail=name)
+        if inj.partitioned:
+            raise TimeoutError(
+                f"injected partition: controller traffic dropped for "
+                f"{name!r}")

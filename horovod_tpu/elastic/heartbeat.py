@@ -46,7 +46,8 @@ class HeartbeatThread(threading.Thread):
 
     def __init__(self, rank: int, size: int, addr: str, port: int,
                  secret: Optional[bytes] = None,
-                 interval: Optional[float] = None):
+                 interval: Optional[float] = None, epoch: int = 0,
+                 renew: bool = True):
         super().__init__(daemon=True, name="hvd-heartbeat")
         self.rank = int(rank)
         self.size = int(size)
@@ -60,13 +61,27 @@ class HeartbeatThread(threading.Thread):
                 env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS,
             )
         )
+        # The membership epoch this lease belongs to: abort flags stamped
+        # with an OLDER epoch are stale (the elastic driver aborts epoch N
+        # to commit N+1; a rank already rebuilt into N+1 must not re-abort
+        # on the flag's way out) — see elastic/membership.py.
+        self.epoch = int(epoch)
+        # renew=False: abort-flag polling only.  A worker that is NOT in
+        # the committed world (evicted while booting, or a spare awaiting
+        # admission) must still observe the abort seam, but its rank key
+        # may now belong to a DIFFERENT worker — renewing it would keep
+        # the successor's lease alive and mask that worker's death.
+        self.renew = bool(renew)
         self.abort_info: Optional[dict] = None
         self.beats = 0
-        self._stop = threading.Event()
+        # NOT named _stop: threading.Thread has an internal _stop()
+        # method, and shadowing it with an Event makes is_alive()/join()
+        # on a finished thread raise TypeError
+        self._stop_event = threading.Event()
 
     def run(self) -> None:
         self.beat()  # publish the first lease before any wait
-        while not self._stop.wait(self.interval):
+        while not self._stop_event.wait(self.interval):
             self.beat()
 
     def beat(self) -> None:
@@ -82,8 +97,9 @@ class HeartbeatThread(threading.Thread):
             "pid": os.getpid(),
         }
         try:
-            put_kv(self.addr, self.port, HEALTH_SCOPE, str(self.rank),
-                   json.dumps(lease).encode(), secret=self.secret)
+            if self.renew:
+                put_kv(self.addr, self.port, HEALTH_SCOPE, str(self.rank),
+                       json.dumps(lease).encode(), secret=self.secret)
             self.beats += 1
             from .. import metrics
 
@@ -99,19 +115,34 @@ class HeartbeatThread(threading.Thread):
             return
         if raw is not None and self.abort_info is None:
             try:
-                self.abort_info = json.loads(raw)
+                info = json.loads(raw)
             except (ValueError, TypeError):
-                self.abort_info = {"reason": "<undecodable abort flag>",
-                                   "source": "unknown"}
+                info = {"reason": "<undecodable abort flag>",
+                        "source": "unknown"}
+            flag_epoch = info.get("epoch") if isinstance(info, dict) else None
+            try:
+                flag_epoch = int(flag_epoch) if flag_epoch is not None \
+                    else None
+            except (TypeError, ValueError):
+                flag_epoch = None  # malformed epoch: honor like epoch-less
+            if flag_epoch is not None and flag_epoch < self.epoch:
+                log.debug("ignoring stale abort flag for epoch %s "
+                          "(this rank is in epoch %d)", flag_epoch, self.epoch)
+                return
+            self.abort_info = info
             log.error("heartbeat observed %s", format_abort(self.abort_info))
             from .. import metrics
 
             if metrics.on():
                 metrics.ABORTS.labels("observed").inc()
-            self._stop.set()  # no point renewing a lease on a dead job
+            # Keep renewing the lease: an elastic survivor lives on and
+            # rebuilds, and the gap until it reaches the abort seam can
+            # be a whole step or checkpoint save — letting the lease die
+            # here reads as a SECOND failure to the driver.  Fail-stop
+            # jobs exit moments later and server-side expiry reaps them.
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_event.set()
 
 
 # ---------------------------------------------------------------------------
@@ -123,29 +154,35 @@ _lock = threading.Lock()
 
 def start(rank: int, size: int, addr: str, port: int,
           secret: Optional[bytes] = None,
-          interval: Optional[float] = None) -> HeartbeatThread:
+          interval: Optional[float] = None, epoch: int = 0,
+          renew: bool = True) -> HeartbeatThread:
     """Start (or replace) the process-wide heartbeat thread."""
     global _instance
     with _lock:
         if _instance is not None:
             _instance.stop()
         _instance = HeartbeatThread(rank, size, addr, port,
-                                    secret=secret, interval=interval)
+                                    secret=secret, interval=interval,
+                                    epoch=epoch, renew=renew)
         _instance.start()
-        log.info("heartbeat active: rank %d/%d via %s:%d every %.1fs",
-                 _instance.rank, _instance.size, addr, port,
-                 _instance.interval)
+        log.info("heartbeat active: rank %d/%d via %s:%d every %.1fs "
+                 "(epoch %d%s)", _instance.rank, _instance.size, addr, port,
+                 _instance.interval, _instance.epoch,
+                 "" if renew else ", abort-poll only")
         return _instance
 
 
 def start_from_env() -> Optional[HeartbeatThread]:
     """Launcher-driven activation: no-op unless this is a multi-process
     job with rendezvous wiring (tpurun / run() export it) and
-    ``HVD_HEARTBEAT_DISABLE`` is unset."""
+    ``HVD_HEARTBEAT_DISABLE`` is unset.  Elastic jobs (HVD_ELASTIC=1)
+    keep the heartbeat even at world size 1 — it is the channel through
+    which a later grow epoch interrupts the lone rank."""
     if env_util.get_bool(env_util.HVD_HEARTBEAT_DISABLE):
         return None
     size = env_util.get_int(env_util.HVD_NUM_PROCESSES, 1)
-    if size <= 1:
+    elastic = env_util.get_bool(env_util.HVD_ELASTIC)
+    if size <= 1 and not elastic:
         return None  # a single process has no peers to outlive it
     addr = env_util.get_str(env_util.HVD_METRICS_KV_ADDR)
     port = env_util.get_int(env_util.HVD_METRICS_KV_PORT, 0)
@@ -154,7 +191,22 @@ def start_from_env() -> Optional[HeartbeatThread]:
     secret_hex = env_util.get_str(env_util.HVD_METRICS_SECRET)
     secret = bytes.fromhex(secret_hex) if secret_hex else None
     rank = env_util.get_int(env_util.HVD_PROCESS_ID, 0)
-    return start(rank, size, addr, port, secret=secret)
+    epoch = 0
+    renew = True
+    if elastic:
+        from . import membership
+
+        epoch = membership.current_epoch()
+        rec = membership.current_record()
+        if rec is not None \
+                and membership.worker_id() not in rec.get("world", ()):
+            # not a member of the committed world (evicted while
+            # booting, or a spare awaiting admission): poll the abort
+            # flag so the seam can kill/redirect us, but do NOT renew a
+            # rank-keyed lease that may belong to a successor worker
+            renew = False
+    return start(rank, size, addr, port, secret=secret, epoch=epoch,
+                 renew=renew)
 
 
 def instance() -> Optional[HeartbeatThread]:

@@ -48,10 +48,51 @@ class ElasticState:
 
     def save(self, step: int) -> Optional[str]:
         """Checkpoint the current state as ``step_{step}`` (rank 0 writes;
-        returns the written path there, None elsewhere)."""
+        returns the written path there, None elsewhere).
+
+        Elastic jobs fence first: a partitioned ex-rank-0 that cannot
+        reach the rendezvous — or whose membership epoch was superseded —
+        must not keep writing checkpoints into the same directory as the
+        re-assigned rank 0 (split-brain double-writer)."""
+        if env_util.get_bool(env_util.HVD_ELASTIC) \
+                and env_util.get_int(env_util.HVD_PROCESS_ID, 0) == 0:
+            from . import membership
+
+            membership.check_fence()
         out = save_checkpoint(self.path, self.state, step=step)
         self.step = int(step)
         return out
+
+    def sync(self, epoch: Optional[int] = None) -> Tuple[Any, int]:
+        """Re-sync the live state across a membership epoch — the
+        shrink/grow path that loses ZERO committed steps: rank 0 (of the
+        NEW dense assignment) broadcasts its in-memory ``{state, step}``
+        through the rendezvous, everyone else (survivors and newcomers
+        alike) adopts it; no disk round trip.  Falls back to
+        :meth:`resume` (checkpoint restore) when no broadcast arrives —
+        e.g. a world where every member is new.  Returns
+        ``(state, step)``."""
+        from . import membership
+
+        if epoch is None:
+            epoch = membership.current_epoch()
+        rank = env_util.get_int(env_util.HVD_PROCESS_ID, 0)
+        if rank == 0:
+            membership.publish_state_blob(
+                epoch, {"state": self.state, "step": self.step})
+            log.info("elastic sync: rank 0 broadcast step %d for epoch %d",
+                     self.step, epoch)
+            return self.state, self.step
+        payload = membership.fetch_state_blob(epoch)
+        if payload is None:
+            log.warning("elastic sync: no rank-0 broadcast for epoch %d; "
+                        "falling back to checkpoint restore", epoch)
+            return self.resume()
+        self.state = payload["state"]
+        self.step = int(payload["step"])
+        log.info("elastic sync: adopted rank 0's step %d for epoch %d",
+                 self.step, epoch)
+        return self.state, self.step
 
     def resume(self) -> Tuple[Any, int]:
         """Restore the newest checkpoint under ``path`` and return

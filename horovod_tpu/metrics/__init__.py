@@ -43,6 +43,9 @@ hvd_aborts_total                counter    coordinated aborts, by ``source``
 hvd_http_retries_total          counter    rendezvous HTTP requests retried
 hvd_faults_injected_total       counter    HVD_FAULT_SPEC faults, by ``kind``
 hvd_restarts_total              counter    supervised job relaunches (launcher)
+hvd_membership_epochs_total     counter    elastic membership epochs committed
+hvd_ranks_removed_total         counter    workers removed from the world
+hvd_ranks_admitted_total        counter    workers admitted into the world
 ==============================  =========  ==================================
 """
 
@@ -156,6 +159,18 @@ RESTARTS = registry.counter(
     "hvd_restarts_total",
     "Supervised job relaunches performed by the tpurun restart policy "
     "(launcher-side).")
+MEMBERSHIP_EPOCHS = registry.counter(
+    "hvd_membership_epochs_total",
+    "Elastic membership epochs committed by the driver (launcher-side; "
+    "includes the initial world).")
+RANKS_REMOVED = registry.counter(
+    "hvd_ranks_removed_total",
+    "Workers removed from the elastic world (crashes, lease expiries, "
+    "partitions).")
+RANKS_ADMITTED = registry.counter(
+    "hvd_ranks_admitted_total",
+    "Workers admitted into the elastic world at epoch boundaries "
+    "(rejoins and spare hosts).")
 
 
 def on() -> bool:

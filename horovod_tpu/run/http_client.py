@@ -147,14 +147,28 @@ def get_sanitizer(addr: str, port: int,
         return json.loads(resp.read().decode())
 
 
-def get_health(addr: str, port: int,
-               secret: Optional[bytes] = None) -> dict:
+def get_health(addr: str, port: int, secret: Optional[bytes] = None,
+               timeout: float = 10.0) -> dict:
     """The failure-domain liveness view from ``GET /health``: per-rank
     heartbeat lease age + live/stale/dead verdict (computed on the
     server's clock) and the job-wide abort flag (None when unset)."""
     import json
 
-    with _request("GET", addr, port, "/health", secret=secret) as resp:
+    with _request("GET", addr, port, "/health", secret=secret,
+                  timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def get_membership(addr: str, port: int, secret: Optional[bytes] = None,
+                   timeout: float = 10.0) -> dict:
+    """The elastic-membership table from ``GET /membership``: the
+    committed epoch record (``epoch``/``world``/``controller_addr``),
+    pending rejoin announcements, per-epoch ready acks, and the
+    flapping-host blocklist (docs/fault_tolerance.md)."""
+    import json
+
+    with _request("GET", addr, port, "/membership", secret=secret,
+                  timeout=timeout) as resp:
         return json.loads(resp.read().decode())
 
 

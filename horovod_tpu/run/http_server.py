@@ -71,6 +71,19 @@ _HEALTH_PREFIX = f"/{HEALTH_SCOPE}/"
 ABORT_SCOPE = "abort"
 ABORT_KEY = "flag"
 
+# elastic membership (elastic/membership.py, elastic/driver.py): the
+# committed epoch record lives at /membership/epoch; workers announce
+# rejoin candidacy under announce.<worker>, acknowledge a rebuilt epoch
+# under ready.<epoch>.<worker>, and rank 0 broadcasts the live training
+# state under state.<epoch>.  GET /membership renders the whole table.
+MEMBERSHIP_SCOPE = "membership"
+_MEMBERSHIP_PREFIX = f"/{MEMBERSHIP_SCOPE}/"
+EPOCH_KEY = "epoch"
+BLOCKLIST_KEY = "blocklist"
+ANNOUNCE_PREFIX = "announce."
+READY_PREFIX = "ready."
+STATE_PREFIX = "state."
+
 #: lease-age verdict thresholds, in units of the lease's own renewal
 #: interval: a rank is ``stale`` past STALE_FACTOR missed intervals and
 #: ``dead`` past DEAD_FACTOR — the server-side lease expiry.
@@ -81,6 +94,80 @@ DEAD_FACTOR = 4.0
 def sign(secret: bytes, path: str, body: bytes = b"") -> str:
     mac = hmac.new(secret, path.encode() + b"|" + body, hashlib.sha256)
     return mac.hexdigest()
+
+
+def build_health_report(store: Dict[str, bytes],
+                        lease_times: Dict[str, float],
+                        now: Optional[float] = None) -> Dict[str, object]:
+    """Per-rank lease ages and verdicts from a store snapshot, computed on
+    the SERVER clock (lease expiry is server-side: a rank whose clock
+    drifts — or whose process died — cannot keep its own lease alive).
+    Shared by the GET /health handler and the in-process
+    :meth:`RendezvousServer.health_report` the elastic driver polls."""
+    now = time.monotonic() if now is None else now
+    leases = {k[len(_HEALTH_PREFIX):]: v for k, v in store.items()
+              if k.startswith(_HEALTH_PREFIX)}
+    abort_raw = store.get(f"/{ABORT_SCOPE}/{ABORT_KEY}")
+    ranks: Dict[str, object] = {}
+    for rank, raw in leases.items():
+        try:
+            lease = json.loads(raw)
+        except (ValueError, TypeError):
+            lease = {}
+        age = now - lease_times.get(_HEALTH_PREFIX + rank, now)
+        interval = float(lease.get("interval", 0.0)) or 1.0
+        if age <= STALE_FACTOR * interval:
+            verdict = "live"
+        elif age <= DEAD_FACTOR * interval:
+            verdict = "stale"
+        else:
+            verdict = "dead"
+        ranks[rank] = {
+            "age_seconds": round(age, 3),
+            "interval": interval,
+            "count": lease.get("count"),
+            "pid": lease.get("pid"),
+            "verdict": verdict,
+        }
+    abort = None
+    if abort_raw is not None:
+        try:
+            abort = json.loads(abort_raw)
+        except (ValueError, TypeError):
+            abort = {"reason": "<undecodable abort flag>"}
+    return {"ranks": ranks, "abort": abort}
+
+
+def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
+    """The elastic-membership table from a store snapshot: the committed
+    epoch record, pending rejoin announcements, per-epoch ready acks, and
+    the flapping-host blocklist (GET /membership)."""
+
+    def _load(raw):
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (ValueError, TypeError):
+            return "<undecodable>"
+
+    keys = {k[len(_MEMBERSHIP_PREFIX):]: v for k, v in store.items()
+            if k.startswith(_MEMBERSHIP_PREFIX)}
+    announces = {k[len(ANNOUNCE_PREFIX):]: _load(v)
+                 for k, v in keys.items() if k.startswith(ANNOUNCE_PREFIX)}
+    ready: Dict[str, list] = {}
+    for k in keys:
+        if k.startswith(READY_PREFIX):
+            epoch, _, worker = k[len(READY_PREFIX):].partition(".")
+            ready.setdefault(epoch, []).append(worker)
+    for workers in ready.values():
+        workers.sort()
+    return {
+        "epoch": _load(keys.get(EPOCH_KEY)),
+        "announces": announces,
+        "ready": ready,
+        "blocklist": _load(keys.get(BLOCKLIST_KEY)) or [],
+    }
 
 
 class KVStoreHandler(BaseHTTPRequestHandler):
@@ -146,46 +233,13 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         return table
 
     def _health_report(self) -> Dict[str, object]:
-        """Per-rank lease ages and verdicts, computed on the SERVER clock
-        (lease expiry is server-side: a rank whose clock drifts — or
-        whose process died — cannot keep its own lease alive).  Includes
-        the abort flag so one GET answers both "who is alive" and "is
-        the job aborting"."""
-        now = time.monotonic()
-        store: Dict[str, bytes] = self.server.store  # type: ignore
+        """Per-rank lease ages and verdicts plus the abort flag, so one
+        GET answers both "who is alive" and "is the job aborting"."""
         with self.server.lock:  # type: ignore
-            leases = {k[len(_HEALTH_PREFIX):]: v for k, v in store.items()
-                      if k.startswith(_HEALTH_PREFIX)}
-            stamps = dict(self.server.lease_times)  # type: ignore
-            abort_raw = store.get(f"/{ABORT_SCOPE}/{ABORT_KEY}")
-        ranks: Dict[str, object] = {}
-        for rank, raw in leases.items():
-            try:
-                lease = json.loads(raw)
-            except (ValueError, TypeError):
-                lease = {}
-            age = now - stamps.get(_HEALTH_PREFIX + rank, now)
-            interval = float(lease.get("interval", 0.0)) or 1.0
-            if age <= STALE_FACTOR * interval:
-                verdict = "live"
-            elif age <= DEAD_FACTOR * interval:
-                verdict = "stale"
-            else:
-                verdict = "dead"
-            ranks[rank] = {
-                "age_seconds": round(age, 3),
-                "interval": interval,
-                "count": lease.get("count"),
-                "pid": lease.get("pid"),
-                "verdict": verdict,
-            }
-        abort = None
-        if abort_raw is not None:
-            try:
-                abort = json.loads(abort_raw)
-            except (ValueError, TypeError):
-                abort = {"reason": "<undecodable abort flag>"}
-        return {"ranks": ranks, "abort": abort}
+            return build_health_report(
+                dict(self.server.store),  # type: ignore
+                dict(self.server.lease_times),  # type: ignore
+            )
 
     def do_GET(self) -> None:  # noqa: N802
         if not self._verify():
@@ -195,6 +249,12 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         if path == "/health":
             self._reply(200, json.dumps(self._health_report()).encode(),
                         content_type="application/json")
+            return
+        if path == "/membership":
+            with self.server.lock:  # type: ignore
+                store = dict(self.server.store)  # type: ignore
+            self._reply(200, json.dumps(build_membership_report(store))
+                        .encode(), content_type="application/json")
             return
         # Aggregated metrics routes.  No key collision with the KV store:
         # stored keys are always two-part /scope/key paths.
@@ -317,6 +377,38 @@ class RendezvousServer:
     def put(self, scope: str, key: str, value: bytes) -> None:
         with self._httpd.lock:  # type: ignore[attr-defined]
             self._httpd.store[f"/{scope}/{key}"] = value  # type: ignore
+
+    def delete(self, scope: str, key: str) -> None:
+        """Drop one key (e.g. the elastic driver revoking a dead rank's
+        /health lease)."""
+        path = f"/{scope}/{key}"
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.pop(path, None)  # type: ignore[attr-defined]
+            self._httpd.lease_times.pop(path, None)  # type: ignore
+
+    def scope_items(self, scope: str) -> Dict[str, bytes]:
+        """Snapshot of every key under ``scope`` (key names without the
+        scope prefix) — the elastic driver's poll of announces/acks."""
+        prefix = f"/{scope}/"
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return {k[len(prefix):]: v
+                    for k, v in self._httpd.store.items()  # type: ignore
+                    if k.startswith(prefix)}
+
+    def health_report(self) -> Dict[str, object]:
+        """In-process equivalent of GET /health (the elastic driver polls
+        lease verdicts without going through its own HTTP stack)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return build_health_report(
+                dict(self._httpd.store),  # type: ignore[attr-defined]
+                dict(self._httpd.lease_times),  # type: ignore[attr-defined]
+            )
+
+    def membership_report(self) -> Dict[str, object]:
+        """In-process equivalent of GET /membership."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return build_membership_report(
+                dict(self._httpd.store))  # type: ignore[attr-defined]
 
     def clear_scope(self, scope: str) -> None:
         """Drop every key under ``scope`` (the supervisor resets the

@@ -79,6 +79,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                              "exponential backoff; HVD_RESTART_COUNT is "
                              "exported so ElasticState.resume() restores "
                              "the latest checkpoint (docs/fault_tolerance.md)")
+    parser.add_argument("--elastic", action="store_true", dest="elastic",
+                        help="elastic membership: on a worker failure, "
+                             "shrink the world and let survivors rebuild "
+                             "in process (no relaunch) instead of killing "
+                             "the job; spare hosts that announce at the "
+                             "rendezvous are admitted at epoch boundaries "
+                             "(docs/fault_tolerance.md).  Composes with "
+                             "--restarts: a full relaunch only happens "
+                             "when the world would drop below --min-np")
+    parser.add_argument("--min-np", type=int, dest="min_np",
+                        help="elastic floor: give the job up (fail-stop) "
+                             "when the world would shrink below this many "
+                             "workers (default 1; HVD_ELASTIC_MIN_NP)")
     parser.add_argument("--controller", dest="controller",
                         choices=["auto", "xla", "native"], default="auto",
                         help="eager control plane: 'native' runs the C++ "
@@ -209,7 +222,8 @@ def _resolve_hosts(args) -> List[HostInfo]:
 
 def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
                 coordinator: str, *, controller: str = "auto",
-                controller_addr: Optional[str] = None) -> List[Dict[str, str]]:
+                controller_addr: Optional[str] = None,
+                elastic: bool = False) -> List[Dict[str, str]]:
     """Per-host worker env dicts (reference gloo_run.py:210-216 sets
     HOROVOD_RANK/SIZE/LOCAL_RANK/... per slot; here per host-process, with
     the slot table embedded for the chips it owns).
@@ -241,6 +255,11 @@ def worker_envs(slots: List[SlotInfo], base_env: Dict[str, str],
             env_util.HVD_CONTROLLER: controller,
             env_util.HVD_CPU_OPERATIONS: "xla",
         })
+        if elastic:
+            # membership identity: the worker id survives epoch changes
+            # while HVD_PROCESS_ID is re-assigned densely per epoch
+            env[env_util.HVD_ELASTIC] = "1"
+            env[env_util.HVD_ELASTIC_WORKER_ID] = str(pid)
         if controller == "native" and controller_addr:
             env["HVD_CONTROLLER_ADDR"] = controller_addr
             # the launcher hosts the server (port 0 bound locally — no
@@ -376,8 +395,10 @@ def _supervise(job: _Job, rdv_server: Optional[RendezvousServer],
 
 def _launch_attempt(args, hosts: List[str], envs: List[Dict[str, str]],
                     rdv_server: Optional[RendezvousServer],
-                    attempt: int = 0) -> int:
-    """Spawn one incarnation of the worker set and supervise it to exit."""
+                    attempt: int = 0, driver=None) -> int:
+    """Spawn one incarnation of the worker set and supervise it to exit.
+    With an elastic ``driver`` the supervision is membership-driven
+    (shrink/grow instead of kill-on-first-failure)."""
     job = _Job()
 
     def handler(signum, frame):
@@ -417,7 +438,8 @@ def _launch_attempt(args, hosts: List[str], envs: List[Dict[str, str]],
             t.start()
             threads.append(t)
 
-        rc = _supervise(job, rdv_server)
+        rc = driver.supervise(job) if driver is not None \
+            else _supervise(job, rdv_server)
         for t in threads:
             t.join(timeout=5)
         if job.interrupted and rc == 0:
@@ -505,7 +527,8 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
         controller_addr = "<launcher>:<bound-at-launch>" \
             if controller == "native" else None
         envs = worker_envs(slots, env, coordinator, controller=controller,
-                           controller_addr=controller_addr)
+                           controller_addr=controller_addr,
+                           elastic=bool(getattr(args, "elastic", False)))
         for pid, hostname in enumerate(hosts):
             print(f"[dry-run] process {pid} on {hostname}:")
             for k in sorted(set(envs[pid]) - set(env)):
@@ -513,6 +536,13 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
             print(f"  command: {' '.join(args.command)}")
         return 0
 
+    elastic = bool(getattr(args, "elastic", False))
+    if elastic and rdv_server is None:
+        raise RuntimeError(
+            "--elastic needs the launcher rendezvous plane: re-enable "
+            f"{env_util.HVD_METRICS} or heartbeats, and unset any external "
+            f"{env_util.HVD_METRICS_KV_ADDR} sink"
+        )
     restarts = getattr(args, "restarts", 0) or 0
     backoff_base = env_util.get_float(env_util.HVD_RESTART_BACKOFF_SECONDS,
                                       env_util.DEFAULT_RESTART_BACKOFF_SECONDS)
@@ -521,27 +551,45 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
         while True:
             # The native controller server is per-incarnation: a failed
             # attempt leaves half-negotiated state behind, and a restart
-            # must rendezvous from scratch.
+            # must rendezvous from scratch.  Elastic jobs go further —
+            # the driver owns a fresh ControllerServer per membership
+            # EPOCH, so the launcher-level server is skipped entirely.
             ctrl_server = None
             controller_addr = None
-            if controller == "native":
+            driver = None
+            ctrl_host = "127.0.0.1" \
+                if all(h in LOCAL_HOSTS for h in hosts) \
+                else socket.gethostname()
+            if elastic:
+                from ..elastic.driver import ElasticDriver
+
+                driver = ElasticDriver(
+                    rdv_server, [str(i) for i in range(len(hosts))],
+                    min_np=getattr(args, "min_np", None)
+                    or env_util.get_int(env_util.HVD_ELASTIC_MIN_NP, 1),
+                    controller=controller, controller_host=ctrl_host,
+                )
+                controller_addr = driver.controller_addr
+            elif controller == "native":
                 from ..runtime.controller import ControllerServer
 
                 ctrl_server = ControllerServer(len(hosts), port=0)
-                ctrl_host = "127.0.0.1" \
-                    if all(h in LOCAL_HOSTS for h in hosts) \
-                    else socket.gethostname()
                 controller_addr = f"{ctrl_host}:{ctrl_server.port}"
             env_attempt = dict(env)
             env_attempt[env_util.HVD_RESTART_COUNT] = str(attempt)
             envs = worker_envs(
                 slots, env_attempt, coordinator,
                 controller=controller, controller_addr=controller_addr,
+                elastic=elastic,
             )
             try:
                 rc = _launch_attempt(args, hosts, envs, rdv_server,
-                                     attempt=attempt)
+                                     attempt=attempt, driver=driver)
             finally:
+                if driver is not None:
+                    log.info("elastic: final epoch %d, world %s",
+                             driver.epoch, driver.world)
+                    driver.shutdown()
                 if ctrl_server is not None:
                     log.info(
                         "controller: %d cycles, %d cache hits, %d stall "
@@ -570,12 +618,18 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
             )
             time.sleep(delay)
             if rdv_server is not None:
-                # a stale abort flag or dead lease must not kill the
-                # fresh incarnation at its first heartbeat
-                from .http_server import ABORT_SCOPE, HEALTH_SCOPE
+                # a stale abort flag, dead lease, or last-attempt
+                # membership record must not kill the fresh incarnation
+                # at its first heartbeat
+                from .http_server import (
+                    ABORT_SCOPE,
+                    HEALTH_SCOPE,
+                    MEMBERSHIP_SCOPE,
+                )
 
                 rdv_server.clear_scope(ABORT_SCOPE)
                 rdv_server.clear_scope(HEALTH_SCOPE)
+                rdv_server.clear_scope(MEMBERSHIP_SCOPE)
     finally:
         if rdv_server is not None:
             rdv_server.stop()

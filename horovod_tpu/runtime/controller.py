@@ -40,6 +40,42 @@ def _dtype_code(dtype) -> int:
                        _DTYPES.get(str(dtype), 0))
 
 
+def _peer_status_suffix() -> str:
+    """Name the missing ranks on a negotiation timeout: the rendezvous
+    ``GET /health`` lease verdicts say which ranks are still renewing and
+    which went silent, so operators — and the elastic driver — can
+    identify the dead rank from the error itself instead of replaying the
+    job.  Best-effort: an un-wired or unreachable rendezvous yields an
+    empty suffix, never a second failure."""
+    try:
+        from ..elastic.abort import _rendezvous_from_env
+
+        wired = _rendezvous_from_env()
+        if wired is None:
+            return ""
+        from ..run.http_client import get_health
+
+        addr, port, secret = wired
+        report = get_health(addr, port, secret=secret, timeout=2.0)
+        ranks = report.get("ranks", {})
+        if not ranks:
+            return ""
+        by_verdict: dict = {}
+        for rank in sorted(ranks, key=lambda r: (len(r), r)):
+            verdict = ranks[rank].get("verdict", "unknown")
+            by_verdict.setdefault(verdict, []).append(rank)
+        detail = ", ".join(
+            f"{v}=[{','.join(by_verdict[v])}]"
+            for v in ("live", "stale", "dead", "unknown") if v in by_verdict
+        )
+        missing = by_verdict.get("dead", []) + by_verdict.get("stale", [])
+        hint = (f"; rank(s) {','.join(missing)} have not arrived"
+                if missing else "")
+        return f" (rank health: {detail}{hint})"
+    except Exception:  # noqa: BLE001 — diagnosis must not mask the timeout
+        return ""
+
+
 class ControllerServer:
     """Coordinator (rank 0 owns it; reference: the coordinator role in
     controller.cc:196-326)."""
@@ -168,7 +204,8 @@ class ControllerClient:
         if rc == 1:
             raise RuntimeError(err.value.decode())
         if rc == 2:
-            raise TimeoutError(f"negotiation of {name!r} timed out")
+            raise TimeoutError(
+                f"negotiation of {name!r} timed out{_peer_status_suffix()}")
         raise ConnectionError("controller connection lost")
 
     def submit_data(self, name: str, payload: bytes, *,
@@ -204,7 +241,8 @@ class ControllerClient:
         if rc == 1:
             raise RuntimeError(err.value.decode())
         if rc == 2:
-            raise TimeoutError(f"host collective {name!r} timed out")
+            raise TimeoutError(
+                f"host collective {name!r} timed out{_peer_status_suffix()}")
         raise ConnectionError("controller connection lost")
 
     def allreduce_data(self, name: str, arr: "np.ndarray",
@@ -306,7 +344,7 @@ class ControllerClient:
     def wait_join(self, timeout: float = 60.0) -> None:
         rc = self._lib.hvd_client_wait_join(self._h, timeout * 1000.0)
         if rc == 2:
-            raise TimeoutError("join timed out")
+            raise TimeoutError(f"join timed out{_peer_status_suffix()}")
         if rc == 3:
             raise ConnectionError("controller connection lost")
 

@@ -107,6 +107,9 @@ def negotiate(name: str, *, op: str, shape: Sequence[int], dtype,
     inactive (single controller)."""
     if _client is None:
         return None
+    from ..elastic import faults
+
+    faults.on_controller(name)  # HVD_FAULT_SPEC: partition/hang/slow here
     _client.submit(name, op=op, shape=tuple(int(d) for d in shape),
                    dtype=str(dtype), root_rank=root_rank)
     return _client.wait(name, timeout=timeout)

@@ -149,6 +149,17 @@ def make_train_step(
 
     pm = None
     box = {}
+
+    def _rebuild(threshold_b, hier):
+        """(Re)compile the SPMD step and remember the knobs + the core
+        mesh epoch it was built against, so a later elastic membership
+        change (core.reinit bumps the epoch and swaps the mesh) can
+        rebuild with the same knobs."""
+        box.update(
+            fn=_build(threshold_b, hier), threshold=threshold_b, hier=hier,
+            core_epoch=core._require_init().epoch,
+        )
+
     if autotune:
         from .optim.autotune import ParameterManager, TunableParams
 
@@ -160,13 +171,12 @@ def make_train_step(
         pm = ParameterManager(
             enabled=True, log_file=autotune_log_file, initial=initial,
         )
-        pm.on_update = lambda p: box.update(
-            fn=_build(p.fusion_threshold_bytes, p.hierarchical_allreduce)
-        )
-        box["fn"] = _build(initial.fusion_threshold_bytes,
-                           initial.hierarchical_allreduce)
+        pm.on_update = lambda p: _rebuild(p.fusion_threshold_bytes,
+                                          p.hierarchical_allreduce)
+        _rebuild(initial.fusion_threshold_bytes,
+                 initial.hierarchical_allreduce)
     else:
-        box["fn"] = _build(threshold_bytes, hierarchical)
+        _rebuild(threshold_bytes, hierarchical)
 
     from . import metrics
     from .timeline.timeline import timeline
@@ -213,6 +223,11 @@ def make_train_step(
             # HVD_FAULT_SPEC harness injects its step-seam faults.
             _heartbeat.maybe_raise_abort()
             _faults.on_step()
+            # Elastic rebuild seam: after a membership epoch the mesh is
+            # new (core.reinit) and the compiled step — shard_map captured
+            # the old mesh at build — must re-trace over it.
+            if box["core_epoch"] != core._require_init().epoch:
+                _rebuild(box["threshold"], box["hier"])
         if not under_trace and metrics.on():
             _record_step_metrics(x)
         if timeline.active and not under_trace:

@@ -22,6 +22,14 @@ from typing import Any, Optional
 
 from .. import core
 
+#: Commit sentinel written NEXT TO a ``step_N`` dir (``step_N.COMMITTED``)
+#: after a successful save — a sibling, not inside the dir, so orbax's
+#: own directory layout stays untouched.  ``latest_step`` only considers
+#: committed dirs, so a rank-0 crash mid-save can never be resumed from
+#: a torn checkpoint: the half-written dir simply does not exist for
+#: restore purposes.
+COMMIT_MARKER_SUFFIX = ".COMMITTED"
+
 
 def _checkpointer():
     import orbax.checkpoint as ocp
@@ -29,23 +37,89 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def commit_marker_path(path: str, step: int) -> str:
+    return os.path.join(path, f"step_{step}{COMMIT_MARKER_SUFFIX}")
+
+
+def write_commit_marker(path: str, step: int) -> None:
+    """Stamp ``step_{step}`` as fully written.  Goes through fsspec so
+    remote stores (gs://, memory://) commit the same way local dirs do;
+    falls back to plain open() when fsspec is unavailable."""
+    marker = commit_marker_path(path, step)
+    try:
+        import fsspec
+
+        with fsspec.open(marker, "wb") as f:
+            f.write(b"1")
+    except ImportError:
+        with open(marker, "wb") as f:
+            f.write(b"1")
+
+
+def clear_commit_marker(path: str, step: int) -> None:
+    """Best-effort removal of the sentinel (the un-commit half of an
+    overwrite)."""
+    marker = commit_marker_path(path, step)
+    try:
+        import fsspec
+
+        fs, marker_path = fsspec.core.url_to_fs(marker)
+        if fs.exists(marker_path):
+            fs.rm(marker_path)
+    except ImportError:
+        try:
+            os.remove(marker)
+        except FileNotFoundError:
+            pass
+    except (FileNotFoundError, OSError):
+        pass
+
+
+def is_committed(path: str, step: int) -> bool:
+    """True when ``step_{step}`` under ``path`` carries the commit
+    sentinel (a save that ran to completion)."""
+    marker = commit_marker_path(path, step)
+    try:
+        import fsspec
+
+        fs, marker_path = fsspec.core.url_to_fs(marker)
+        return bool(fs.exists(marker_path))
+    except ImportError:
+        return os.path.exists(marker)
+    except (FileNotFoundError, OSError):
+        return False
+
+
 def save_checkpoint(path: str, state: Any, *, step: Optional[int] = None,
                     force: bool = True) -> Optional[str]:
     """Write ``state`` (any pytree of arrays) from the root process only
     (reference idiom: rank-0-gated ModelCheckpoint).  Returns the
-    written path on the root, None elsewhere."""
+    written path on the root, None elsewhere.
+
+    Step saves are committed atomically-enough for crash safety: the
+    ``COMMITTED`` sentinel is written only after orbax finishes, and
+    ``latest_step`` ignores uncommitted dirs."""
     target = os.path.join(path, f"step_{step}") if step is not None else path
     if core.is_initialized() and core.process_rank() != 0:
         return None
     import jax
 
     state = jax.device_get(state)  # host copy; orbax owns the layout
+    if step is not None:
+        # proper commit protocol on overwrite: un-commit first, so a
+        # crash while orbax rewrites the dir leaves it uncommitted too
+        clear_commit_marker(path, step)
     _checkpointer().save(target, state, force=force)
+    if step is not None:
+        write_commit_marker(path, step)
     return target
 
 
 def latest_step(path: str) -> Optional[int]:
-    """Largest ``step_N`` under ``path`` (None if no step dirs).
+    """Largest *committed* ``step_N`` under ``path`` (None if no step
+    dirs).  Dirs without the ``COMMITTED`` sentinel are torn writes (the
+    saver died mid-save) and are skipped — resuming from one would load
+    a checkpoint that never finished.
 
     Lists through fsspec so remote stores (gs://, memory://) work the
     same as local directories — ``os.listdir`` would raise on URLs and
@@ -67,7 +141,24 @@ def latest_step(path: str) -> Optional[int]:
         return None
     steps = [int(d[len("step_"):]) for d in names
              if d.startswith("step_") and d[len("step_"):].isdigit()]
-    return max(steps) if steps else None
+    # the sentinel names are in the SAME listing — no per-step remote
+    # existence probe (a gs:// dir with hundreds of steps would other-
+    # wise pay one round trip each on every resume)
+    name_set = set(names)
+    committed = [s for s in steps
+                 if f"step_{s}{COMMIT_MARKER_SUFFIX}" in name_set]
+    if steps and not committed:
+        from .logging import get_logger
+
+        get_logger(__name__).warning(
+            "checkpoint dir %s has step dirs %s but no %s sentinels — "
+            "they are either torn writes or pre-commit-marker "
+            "checkpoints; refusing to resume from them (touch "
+            "step_N%s to bless a checkpoint you trust)",
+            path, sorted(steps), COMMIT_MARKER_SUFFIX.lstrip("."),
+            COMMIT_MARKER_SUFFIX,
+        )
+    return max(committed) if committed else None
 
 
 def restore_checkpoint(path: str, like: Any, *, step: Optional[int] = None,

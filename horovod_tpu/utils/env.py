@@ -101,6 +101,13 @@ HVD_HTTP_BACKOFF_MS = "HVD_HTTP_BACKOFF_MS"            # base retry backoff, ms 
 HVD_FAULT_SPEC = "HVD_FAULT_SPEC"                      # fault-injection spec (elastic/faults.py)
 HVD_RESTART_COUNT = "HVD_RESTART_COUNT"                # incarnation index set by the supervisor
 HVD_RESTART_BACKOFF_SECONDS = "HVD_RESTART_BACKOFF_SECONDS"  # restart backoff base (default 1)
+# elastic membership (elastic/membership.py + elastic/driver.py;
+# docs/fault_tolerance.md): shrink/grow worlds without relaunch
+HVD_ELASTIC = "HVD_ELASTIC"                            # 1 = elastic driver supervises the job
+HVD_ELASTIC_WORKER_ID = "HVD_ELASTIC_WORKER_ID"        # stable worker identity across epochs
+HVD_ELASTIC_MIN_NP = "HVD_ELASTIC_MIN_NP"              # floor world size before giving up (default 1)
+HVD_ELASTIC_TIMEOUT_SECONDS = "HVD_ELASTIC_TIMEOUT_SECONDS"  # epoch wait/rebuild budget (default 60)
+HVD_ELASTIC_MAX_FLAPS = "HVD_ELASTIC_MAX_FLAPS"        # removals before a worker is blocklisted (default 3)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -111,6 +118,8 @@ DEFAULT_TERM_GRACE_SECONDS = 5.0                   # run/run.py SIGTERM→SIGKIL
 DEFAULT_HTTP_RETRIES = 2                           # run/http_client.py retry budget
 DEFAULT_HTTP_BACKOFF_MS = 50.0                     # run/http_client.py backoff base
 DEFAULT_RESTART_BACKOFF_SECONDS = 1.0              # run/run.py restart backoff base
+DEFAULT_ELASTIC_TIMEOUT_SECONDS = 60.0             # elastic epoch wait/rebuild budget
+DEFAULT_ELASTIC_MAX_FLAPS = 3                      # elastic/driver.py blocklist threshold
 
 
 def get_int(name: str, default: int) -> int:

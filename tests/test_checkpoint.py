@@ -26,6 +26,8 @@ def test_latest_step_missing_path_is_none(tmp_path):
 def test_latest_step_picks_numeric_max_and_ignores_junk(tmp_path):
     for name in ("step_1", "step_10", "step_2", "step_x", "other", "step_"):
         (tmp_path / name).mkdir()
+    for step in (1, 10, 2):
+        ck.write_commit_marker(str(tmp_path), step)
     assert ck.latest_step(str(tmp_path)) == 10
 
 
@@ -33,9 +35,46 @@ def test_latest_step_empty_dir_is_none(tmp_path):
     assert ck.latest_step(str(tmp_path)) is None
 
 
+def test_latest_step_skips_uncommitted_dirs(tmp_path):
+    """The torn-checkpoint contract: a step dir without the COMMITTED
+    sentinel is a save that died mid-write — resume must never pick it,
+    even when it is the numerically newest."""
+    for name in ("step_4", "step_7"):
+        (tmp_path / name).mkdir()
+    ck.write_commit_marker(str(tmp_path), 4)  # step_7 stays uncommitted
+    assert ck.latest_step(str(tmp_path)) == 4
+    # committing it flips the answer; un-committing (the overwrite
+    # protocol's first half) flips it back
+    ck.write_commit_marker(str(tmp_path), 7)
+    assert ck.latest_step(str(tmp_path)) == 7
+    ck.clear_commit_marker(str(tmp_path), 7)
+    assert ck.latest_step(str(tmp_path)) == 4
+
+
+def test_latest_step_all_uncommitted_is_none(tmp_path):
+    (tmp_path / "step_3").mkdir()
+    assert ck.latest_step(str(tmp_path)) is None
+
+
+def test_crash_mid_save_never_resumed(tmp_path):
+    """End to end: a real save commits step 4; a simulated rank-0 crash
+    mid-save leaves step_5 torn (dir exists, no sentinel); resume comes
+    back from 4, not the torn 5."""
+    path = str(tmp_path)
+    saved = ck.save_checkpoint(path, {"w": np.full(2, 4.0)}, step=4)
+    assert saved is not None and ck.is_committed(path, 4)
+    (tmp_path / "step_5").mkdir()          # orbax died before finishing
+    (tmp_path / "step_5" / "half").write_bytes(b"torn")
+    assert not ck.is_committed(path, 5)
+    assert ck.latest_step(path) == 4
+    out = ck.restore_checkpoint(path, {"w": np.zeros(2)}, broadcast=False)
+    np.testing.assert_array_equal(out["w"], np.full(2, 4.0))
+
+
 def test_latest_step_remote_memory_url():
     """Remote stores list through fsspec — os.listdir would raise on a
-    URL and silently retarget restore at the run root."""
+    URL and silently retarget restore at the run root; commit markers
+    ride the same fsspec path."""
     import fsspec
 
     fs = fsspec.filesystem("memory")
@@ -43,6 +82,8 @@ def test_latest_step_remote_memory_url():
     with fs.open("/ckroot/step_3/marker", "wb") as f:
         f.write(b"1")
     try:
+        assert ck.latest_step("memory://ckroot") is None  # uncommitted
+        ck.write_commit_marker("memory://ckroot", 3)
         assert ck.latest_step("memory://ckroot") == 3
         assert ck.latest_step("memory://ckroot-missing") is None
     finally:

@@ -1,0 +1,920 @@
+"""Elastic membership (docs/fault_tolerance.md): epoch commits +
+GET /membership, dense rank re-assignment, blocklisting, the worker-side
+rebuild path (wait_for_epoch/apply_epoch/elastic.run), rank-0 in-memory
+state sync, partition-driven lease removal, heartbeat/abort lifecycle
+across re-init, and the end-to-end shrink (tier-1) and shrink+grow
+(slow) drives.
+
+The reference's elastic runtime (horovod/run/elastic/driver.py +
+common/elastic.py) discovers hosts and restarts collectives via Gloo;
+here the same contract — variable worker sets, state restore, rank
+re-assignment — is expressed through the rendezvous server the repo
+already runs for metrics/heartbeats."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic import faults as faults_mod
+from horovod_tpu.elastic import heartbeat as hb_mod
+from horovod_tpu.elastic import membership
+from horovod_tpu.elastic.abort import HorovodAbortError, make_flag
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.membership import RemovedFromWorldError
+from horovod_tpu.elastic.state import ElasticState
+from horovod_tpu.run.http_client import get_membership
+from horovod_tpu.run.http_server import (
+    ABORT_KEY,
+    ABORT_SCOPE,
+    RendezvousServer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+@pytest.fixture()
+def rdv(monkeypatch):
+    """A live rendezvous server with the worker-side env wired at it,
+    plus teardown of every module-level singleton the tests touch."""
+    secret = b"membership-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", str(port))
+    monkeypatch.setenv("HVD_METRICS_SECRET", secret.hex())
+    monkeypatch.setenv("HVD_ELASTIC", "1")
+    monkeypatch.setenv("HVD_ELASTIC_TIMEOUT_SECONDS", "5")
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.1")
+    membership._reset_for_tests()
+    yield server, "127.0.0.1", port, secret
+    hb_mod.stop()
+    faults_mod.reset()
+    membership._reset_for_tests()
+    server.stop()
+
+
+def _as_worker(monkeypatch, wid, rank, nproc):
+    monkeypatch.setenv("HVD_ELASTIC_WORKER_ID", str(wid))
+    monkeypatch.setenv("HVD_PROCESS_ID", str(rank))
+    monkeypatch.setenv("HVD_NUM_PROCESSES", str(nproc))
+    membership._reset_for_tests()
+
+
+# -- driver: epoch commits ---------------------------------------------------
+def test_commit_publishes_record_and_get_membership(rdv):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1, controller="xla")
+    rep = get_membership(addr, port, secret=secret)
+    rec = rep["epoch"]
+    assert rec["epoch"] == 0 and rec["world"] == ["0", "1", "2"]
+    assert rec["size"] == 3 and rec["reason"] == "initial world"
+    assert rep["blocklist"] == [] and rep["announces"] == {}
+    drv.shutdown()
+
+
+def test_remove_reassigns_ranks_densely_and_revokes_lease(rdv):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1, controller="xla")
+    server.put("health", "1", b"{}")  # the doomed rank's lease
+    assert drv.remove("1", "worker 1 exited with code 17")
+    rec = json.loads(server.get("membership", "epoch"))
+    # survivors keep relative order; ranks are dense: old rank 2 -> 1
+    assert rec["epoch"] == 1 and rec["world"] == ["0", "2"]
+    assert rec["removed"] == ["1"]
+    # the abort flag is stamped with the ABORTED epoch (0)
+    flag = json.loads(server.get(ABORT_SCOPE, ABORT_KEY))
+    assert flag["epoch"] == 0 and flag["source"] == "elastic_driver"
+    assert flag["rank"] == 1  # the old dense rank of the dead worker
+    # health scope was reset (stale old-rank leases must not read as
+    # deaths in the new epoch)
+    assert server.get("health", "1") is None
+    drv.shutdown()
+
+
+def test_remove_below_min_np_gives_up(rdv):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=2, controller="xla")
+    assert not drv.remove("1", "worker 1 died")
+    assert "min_np" in drv.failed_reason
+    assert drv.epoch == 0 and drv.world == ["0", "1"]  # no shrink commit
+    drv.shutdown()
+
+
+def test_flapping_worker_is_blocklisted_and_not_readmitted(rdv):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla",
+                        max_flaps=2)
+    assert drv.remove("1", "crash #1")
+    assert drv.admit(["1"]) is not None          # first rejoin is fine
+    assert drv.remove("1", "crash #2")           # second removal: flapping
+    assert "1" in drv.blocklist
+    assert drv.admit(["1"]) is None              # barred from rejoining
+    rep = server.membership_report()
+    assert rep["blocklist"] == ["1"]
+    # a blocklisted flapper's announce is purged, not left as a
+    # forever-pending rejoin in GET /membership
+    drv._stable = True
+    server.put("membership", "announce.1", b"{}")
+    drv.poll()
+    assert server.membership_report()["announces"] == {}
+    assert "1" not in drv.world
+    drv.shutdown()
+
+
+def test_admit_interrupts_current_epoch_via_abort_flag(rdv):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0"], min_np=1, controller="xla")
+    rec = drv.admit(["7"], reason="spare host")
+    assert rec["epoch"] == 1 and rec["world"] == ["0", "7"]
+    assert rec["admitted"] == ["7"]
+    flag = json.loads(server.get(ABORT_SCOPE, ABORT_KEY))
+    assert flag["epoch"] == 0 and "admitting" in flag["reason"]
+    drv.shutdown()
+
+
+def test_poll_admits_announced_worker_once_stable(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "0", 0, 1)
+    membership.attach()                          # worker 0 acks epoch 0
+    drv.poll()
+    assert drv._stable
+    _as_worker(monkeypatch, "9", 0, 1)
+    membership.announce()
+    drv.poll()
+    assert drv.world == ["0", "9"] and drv.epoch == 1
+    rep = server.membership_report()
+    assert rep["announces"] == {}                # consumed at admission
+    drv.shutdown()
+
+
+def test_poll_clears_abort_scope_once_all_acked(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    assert drv.remove("1", "crash")
+    assert server.get(ABORT_SCOPE, ABORT_KEY) is not None
+    drv.poll()
+    assert not drv._stable                       # survivor has not acked
+    _as_worker(monkeypatch, "0", 0, 1)
+    membership.ack(1)
+    drv.poll()
+    assert drv._stable
+    assert server.get(ABORT_SCOPE, ABORT_KEY) is None
+    drv.shutdown()
+
+
+def test_native_controller_rebuilt_per_epoch(rdv):
+    """Each epoch gets a FRESH ControllerServer sized to the new world —
+    half-negotiated state from the dead epoch can never leak in."""
+    from horovod_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip("native controller library not built")
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1,
+                        controller="native")
+    addr0 = drv.controller_addr
+    assert addr0 and addr0.startswith("127.0.0.1:")
+    first_server = drv.ctrl_server
+    assert drv.remove("2", "crash")
+    assert drv.controller_addr != addr0            # a new port, new server
+    assert drv.ctrl_server is not first_server
+    rec = json.loads(server.get("membership", "epoch"))
+    assert rec["controller_addr"] == drv.controller_addr
+    drv.shutdown()
+    assert drv.ctrl_server is None
+
+
+# -- worker side: rebuild path -----------------------------------------------
+def test_attach_adopts_epoch_and_acks(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "1", 1, 2)
+    rec = membership.attach()
+    assert rec["epoch"] == 0 and membership.current_epoch() == 0
+    assert membership.world_size() == 2
+    assert drv._ready_workers(0) == {"1"}
+    drv.shutdown()
+
+
+def test_attach_applies_world_that_moved_before_startup(rdv, monkeypatch):
+    """A shrink that races interpreter start-up: the record this worker
+    reads at attach no longer matches its spawn-time env.  Attach must
+    APPLY the committed assignment (env rewrite, dense rank), not ack a
+    world the process does not actually run in."""
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1, controller="xla")
+    assert drv.remove("1", "crashed before peers started")
+    _as_worker(monkeypatch, "2", 2, 3)           # spawn-time env: rank 2/3
+    rec = membership.attach()
+    assert rec["epoch"] == 1
+    assert os.environ["HVD_PROCESS_ID"] == "1"   # densely re-assigned
+    assert os.environ["HVD_NUM_PROCESSES"] == "2"
+    assert drv._ready_workers(1) == {"2"}        # acked the REAL epoch
+    drv.shutdown()
+
+
+def test_apply_epoch_rewrites_env_and_restarts_heartbeat(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "2", 2, 3)
+    membership.attach()
+    assert drv.remove("1", "crash")
+    rec = membership.wait_for_epoch(1)
+    new_rank = membership.apply_epoch(rec)
+    assert new_rank == 1                          # dense: old 2 -> new 1
+    assert os.environ["HVD_PROCESS_ID"] == "1"
+    assert os.environ["HVD_NUM_PROCESSES"] == "2"
+    hb = hb_mod.instance()
+    assert hb is not None and hb.rank == 1 and hb.epoch == 1
+    drv.shutdown()
+
+
+def test_apply_epoch_raises_for_evicted_worker(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "1", 1, 2)
+    assert drv.remove("1", "partitioned")
+    rec = membership.wait_for_epoch(1)
+    with pytest.raises(RemovedFromWorldError, match="worker 1"):
+        membership.apply_epoch(rec)
+    drv.shutdown()
+
+
+def test_wait_for_epoch_times_out_to_none(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    ElasticDriver(server, ["0"], min_np=1, controller="xla").shutdown()
+    _as_worker(monkeypatch, "0", 0, 1)
+    t0 = time.monotonic()
+    assert membership.wait_for_epoch(5, timeout=0.5) is None
+    assert time.monotonic() - t0 < 3.0
+
+
+# -- state sync: rank-0 in-memory broadcast ----------------------------------
+def test_state_sync_broadcasts_from_rank0_without_disk(rdv, monkeypatch,
+                                                       tmp_path):
+    server, addr, port, secret = rdv
+    _as_worker(monkeypatch, "0", 0, 2)
+    es0 = ElasticState(str(tmp_path / "never-written"),
+                       {"w": np.arange(4.0)})
+    es0.step = 11
+    state, step = es0.sync(epoch=3)
+    assert step == 11                              # rank 0: identity
+    _as_worker(monkeypatch, "1", 1, 2)
+    es1 = ElasticState(str(tmp_path / "never-written"),
+                       {"w": np.zeros(4)})
+    state, step = es1.sync(epoch=3)
+    assert step == 11 and es1.step == 11
+    np.testing.assert_array_equal(state["w"], np.arange(4.0))
+    # zero disk involved: the checkpoint path never existed
+    assert not (tmp_path / "never-written").exists()
+
+
+def test_state_sync_falls_back_to_checkpoint_restore(rdv, monkeypatch,
+                                                     tmp_path):
+    server, addr, port, secret = rdv
+    monkeypatch.setenv("HVD_ELASTIC_TIMEOUT_SECONDS", "0.3")
+    _as_worker(monkeypatch, "1", 1, 2)
+    es = ElasticState(str(tmp_path), {"w": np.zeros(2)})
+    resumed = []
+    monkeypatch.setattr(
+        ElasticState, "resume",
+        lambda self: (resumed.append(1) or (self.state, 0)))
+    state, step = es.sync(epoch=9)                 # nobody broadcast 9
+    assert resumed == [1] and step == 0
+
+
+def test_fencing_refuses_rank0_saves_on_stale_epoch(rdv, monkeypatch,
+                                                    tmp_path):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "0", 0, 2)
+    membership.attach()
+    drv.commit(["0"], removed=["1"], reason="moved on")  # epoch 1 behind
+    es = ElasticState(str(tmp_path), {"w": np.zeros(2)})  # our back
+    with pytest.raises(HorovodAbortError, match="fencing"):
+        es.save(3)
+    assert not any(p.name.startswith("step_") for p in tmp_path.iterdir()) \
+        if tmp_path.exists() else True
+    drv.shutdown()
+
+
+def test_fencing_refuses_when_rendezvous_unreachable(rdv, monkeypatch,
+                                                     tmp_path):
+    server, addr, port, secret = rdv
+    _as_worker(monkeypatch, "0", 0, 1)
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", "1")   # nothing listens here
+    monkeypatch.setenv("HVD_HTTP_RETRIES", "0")
+    es = ElasticState(str(tmp_path), {"w": np.zeros(2)})
+    with pytest.raises(HorovodAbortError, match="fencing"):
+        es.save(1)
+
+
+# -- the elastic.run wrapper -------------------------------------------------
+def test_run_wrapper_rebuilds_and_retries(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "0", 0, 2)
+    calls = []
+    resizes = []
+
+    def fn(state):
+        calls.append(membership.current_epoch())
+        if len(calls) == 1:
+            # shrink commits while "training" is mid-step, then the seam
+            # raises — the order the real driver produces
+            drv.remove("1", "worker 1 exited with code 17")
+            raise HorovodAbortError("coordinated abort: worker 1 died")
+        return "done"
+
+    out = membership.run(
+        fn, None,
+        on_world_change=lambda s, old, new: resizes.append((old, new)))
+    assert out == "done"
+    assert calls == [0, 1]                        # retried in the new epoch
+    assert resizes == [(2, 1)]
+    assert os.environ["HVD_NUM_PROCESSES"] == "1"
+    drv.shutdown()
+
+
+def test_run_wrapper_propagates_when_job_is_dead(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    ElasticDriver(server, ["0"], min_np=1, controller="xla").shutdown()
+    monkeypatch.setenv("HVD_ELASTIC_TIMEOUT_SECONDS", "0.4")
+    _as_worker(monkeypatch, "0", 0, 1)
+
+    def fn(state):
+        raise HorovodAbortError("no driver will ever commit epoch 1")
+
+    with pytest.raises(HorovodAbortError, match="ever commit"):
+        membership.run(fn, None)
+
+
+def test_run_wrapper_raises_removed_for_evicted_worker(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "1", 1, 2)
+
+    def fn(state):
+        drv.remove("1", "lease expired (partition)")
+        raise HorovodAbortError("coordinated abort: lease expired")
+
+    with pytest.raises(RemovedFromWorldError):
+        membership.run(fn, None)
+    drv.shutdown()
+
+
+def test_join_world_announce_then_admission(rdv, monkeypatch):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0"], min_np=1, controller="xla")
+    drv._stable = True                             # epoch 0 settled
+    stop = threading.Event()
+
+    def driver_loop():
+        while not stop.is_set():
+            drv.poll()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=driver_loop, daemon=True)
+    t.start()
+    try:
+        _as_worker(monkeypatch, "5", 0, 1)
+        rec = membership.join_world(timeout=5.0)
+        assert rec["world"] == ["0", "5"]
+        assert os.environ["HVD_PROCESS_ID"] == "1"  # appended after "0"
+        assert membership.world_size() == 2
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        drv.shutdown()
+
+
+# -- partition faults drive lease-based removal ------------------------------
+def test_partition_fault_drops_http_and_controller_traffic(monkeypatch):
+    from horovod_tpu.elastic.faults import Fault, FaultInjector
+    import urllib.error
+
+    inj = FaultInjector([Fault(kind="partition", seam="step", step=2,
+                               restart=None)], rank=0, restart=0)
+    monkeypatch.setattr(faults_mod, "_instance", inj)
+    faults_mod.on_http("/health/0")                # pre-partition: fine
+    faults_mod.on_controller("allreduce.1")
+    inj.fire("step")                               # 0
+    inj.fire("step")                               # 1
+    inj.fire("step")                               # 2 -> partitioned
+    assert inj.partitioned
+    with pytest.raises(urllib.error.URLError, match="partition"):
+        faults_mod.on_http("/health/0")
+    with pytest.raises(TimeoutError, match="partition"):
+        faults_mod.on_controller("allreduce.2")
+
+
+def test_parse_spec_accepts_partition_and_controller_seam():
+    from horovod_tpu.elastic.faults import FaultSpecError, parse_spec
+
+    (f,) = parse_spec("rank=1:step=4:kind=partition")
+    assert f.kind == "partition" and f.seam == "step" and f.step == 4
+    (f,) = parse_spec("kind=hang:seam=controller")
+    assert f.seam == "controller"
+    with pytest.raises(FaultSpecError):
+        parse_spec("kind=partition=now")           # takes no argument
+
+
+def test_partitioned_rank_is_removed_via_lease_expiry(rdv, monkeypatch):
+    """The membership change under a network split, end to end in one
+    process: the partitioned rank stays ALIVE but its lease renewals are
+    dropped, the server-side verdict flips to dead, and the driver's
+    poll removes it from the world — no process death involved."""
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    # both workers acked epoch 0 (the attach barrier): lease enforcement
+    # only runs on a stable epoch — mid-rebuild silence is not death
+    server.put("membership", "ready.0.0", b"{}")
+    server.put("membership", "ready.0.1", b"{}")
+    monkeypatch.setenv("HVD_PROCESS_ID", "1")
+    monkeypatch.setenv("HVD_FAULT_SPEC",
+                       "rank=1:step=4:kind=partition:seam=http")
+    faults_mod.reset()
+    hb = hb_mod.start(1, 2, addr, port, secret=secret, interval=0.1)
+    assert _wait_for(lambda: hb.beats >= 1)
+    assert _wait_for(lambda: faults_mod.instance().partitioned, timeout=5.0)
+    assert _wait_for(
+        lambda: (drv.poll() or drv.world == ["0"]), timeout=10.0)
+    assert drv.epoch == 1
+    assert hb.is_alive()                           # the process never died
+    rec = json.loads(server.get("membership", "epoch"))
+    assert rec["removed"] == ["1"] and "lease expired" in rec["reason"]
+
+
+def test_remove_drains_finished_workers_from_roster(rdv):
+    """End-of-training skew: a worker that exited 0 can never ack or
+    heartbeat again, so a later shrink must drain it from the roster in
+    the same commit — otherwise the stability barrier hangs and rank 0
+    can land on an exited process."""
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1, controller="xla")
+    drv.finished.add("0")                        # exited 0 already
+    assert drv.remove("1", "worker 1 exited with code 17")
+    rec = json.loads(server.get("membership", "epoch"))
+    assert rec["world"] == ["2"]                 # live members only
+    assert "drained finished worker(s) ['0']" in rec["reason"]
+    drv.shutdown()
+
+
+def test_no_admissions_once_a_member_finished(rdv):
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0"], min_np=1, controller="xla")
+    drv._stable = True
+    drv.finished.add("0")
+    server.put("membership", "announce.9", b"{}")
+    drv.poll()
+    assert drv.world == ["0"] and drv.epoch == 0  # winding down: no grow
+    drv.shutdown()
+
+
+def test_attach_keeps_prior_epoch_floor_for_evicted_worker(rdv,
+                                                          monkeypatch):
+    """An evicted-at-startup worker must still honor the abort flag of
+    the epoch it was removed from: attach adopts the PREVIOUS epoch as
+    its floor, so the heartbeat's staleness filter does not discard the
+    flag and the worker dies at the seam instead of zombie-training."""
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    assert drv.remove("1", "crashed while booting")   # flag epoch=0
+    _as_worker(monkeypatch, "1", 1, 2)
+    rec = membership.attach()
+    assert rec["epoch"] == 1
+    assert membership.current_epoch() == 0            # floor stays behind
+    assert drv._ready_workers(1) == set()             # and no false ack
+    hb = hb_mod.start_from_env()
+    assert hb is not None and hb.epoch == 0
+    assert _wait_for(lambda: hb.abort_info is not None)  # flag honored
+    with pytest.raises(HorovodAbortError):
+        hb_mod.maybe_raise_abort()
+    drv.shutdown()
+
+
+def test_nonmember_heartbeat_polls_abort_but_never_renews(rdv,
+                                                          monkeypatch):
+    """A worker outside the committed world (evicted while booting, or a
+    spare awaiting admission) must observe the abort seam but NOT renew
+    a rank-keyed lease — its stale rank may belong to a successor, and
+    renewing it would keep that worker's lease alive and mask its death
+    from the driver."""
+    server, addr, port, secret = rdv
+    ElasticDriver(server, ["0"], min_np=1, controller="xla").shutdown()
+    monkeypatch.setenv("HVD_ELASTIC_WORKER_ID", "9")
+    monkeypatch.delenv("HVD_PROCESS_ID", raising=False)
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "2")
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.05")
+    membership._reset_for_tests()
+    membership.attach()
+    hb = hb_mod.start_from_env()
+    assert hb is not None and not hb.renew
+    assert _wait_for(lambda: hb.beats >= 3)
+    assert server.get("health", "0") is None       # no lease published
+    # ...but the abort seam still works for it
+    server.put(ABORT_SCOPE, ABORT_KEY,
+               json.dumps(make_flag("job death",
+                                    source="launcher")).encode())
+    assert _wait_for(lambda: hb.abort_info is not None)
+
+
+def test_heartbeat_survives_malformed_epoch_in_flag(rdv):
+    """beat()'s never-raises contract: an abort flag with a decodable
+    but non-int epoch must be honored like an epoch-less flag, not kill
+    the daemon thread."""
+    server, addr, port, secret = rdv
+    server.put(ABORT_SCOPE, ABORT_KEY,
+               json.dumps({"reason": "bad epoch", "source": "api",
+                           "epoch": "not-a-number"}).encode())
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.05,
+                      epoch=3)
+    assert _wait_for(lambda: hb.abort_info is not None)
+    assert hb.is_alive()                           # daemon did not die
+
+
+def test_lease_expiry_not_enforced_mid_rebuild(rdv):
+    """Regression (caught by a live tpurun drive): a survivor can spend
+    a whole step or first-time orbax save between observing the abort
+    and restarting its heartbeat.  That silence, during an UNSTABLE
+    epoch, must not be read as a second failure — the old driver removed
+    the lone survivor and collapsed the world below min_np."""
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    assert drv.remove("1", "worker 1 exited")      # epoch 1, not stable
+    # the survivor's pre-abort lease, long dead on the server clock
+    server.put("health", "0",
+               json.dumps({"rank": 0, "interval": 0.01, "count": 3,
+                           "pid": 1}).encode())
+    with server._httpd.lock:
+        server._httpd.lease_times["/health/0"] = time.monotonic() - 60.0
+    deadline = time.monotonic() + 0.6              # past the 2x gate
+    while time.monotonic() < deadline:
+        drv.poll()
+        time.sleep(0.05)
+    assert drv.world == ["0"]                      # survivor kept
+    assert drv.failed_reason is None
+    drv.shutdown()
+
+
+def test_heartbeat_keeps_renewing_after_abort_observed(rdv):
+    """The other half of the same regression: the heartbeat must keep
+    the lease alive after observing an abort — the elastic survivor
+    lives on and rebuilds; only explicit stop() ends renewals."""
+    server, addr, port, secret = rdv
+    server.put(ABORT_SCOPE, ABORT_KEY,
+               json.dumps(make_flag("shrink", source="elastic_driver",
+                                    epoch=0)).encode())
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.05,
+                      epoch=0)
+    assert _wait_for(lambda: hb.abort_info is not None)
+    seen = hb.beats
+    assert _wait_for(lambda: hb.beats >= seen + 3)  # renewals continue
+
+
+# -- heartbeat/abort lifecycle across re-init --------------------------------
+def test_heartbeat_stop_is_idempotent(rdv):
+    server, addr, port, secret = rdv
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.1)
+    hb_mod.stop()
+    hb_mod.stop()                                  # second stop: no-op
+    hb.stop()                                      # thread-level too
+    assert hb_mod.instance() is None
+
+
+def test_heartbeat_restart_clears_observed_abort(rdv):
+    """The per-epoch abort scope contract: a NEW heartbeat (the re-init
+    path) starts with a clean abort_info even while the old flag is
+    still on the wire — the epoch filter keeps it out."""
+    server, addr, port, secret = rdv
+    server.put(ABORT_SCOPE, ABORT_KEY,
+               json.dumps(make_flag("epoch-0 failure", rank=1,
+                                    source="elastic_driver",
+                                    epoch=0)).encode())
+    hb0 = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.05,
+                       epoch=0)
+    assert _wait_for(lambda: hb0.abort_info is not None)
+    hb1 = hb_mod.start(0, 1, addr, port, secret=secret, interval=0.05,
+                       epoch=1)
+    assert _wait_for(lambda: hb1.beats >= 3)
+    assert hb1.abort_info is None                  # stale flag ignored
+    # an epoch-less flag (launcher/api source) is honored by every epoch
+    server.put(ABORT_SCOPE, ABORT_KEY,
+               json.dumps(make_flag("real job death",
+                                    source="launcher")).encode())
+    assert _wait_for(lambda: hb1.abort_info is not None)
+    with pytest.raises(HorovodAbortError, match="real job death"):
+        hb_mod.maybe_raise_abort()
+
+
+def test_heartbeat_honors_current_epoch_flag(rdv):
+    server, addr, port, secret = rdv
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.05,
+                      epoch=2)
+    assert _wait_for(lambda: hb.beats >= 1)
+    server.put(ABORT_SCOPE, ABORT_KEY,
+               json.dumps(make_flag("epoch-2 shrink", source="elastic_driver",
+                                    epoch=2)).encode())
+    assert _wait_for(lambda: hb.abort_info is not None)
+
+
+def test_heartbeat_survives_core_reinit_cycles(rdv, monkeypatch,
+                                               cpu_devices):
+    """The prerequisite for core.reinit(): the heartbeat daemon restarts
+    across shutdown() → init() cycles, carrying the membership epoch."""
+    import horovod_tpu as hvd
+    from horovod_tpu import core
+
+    server, addr, port, secret = rdv
+    drv = ElasticDriver(server, ["0", "1"], min_np=1, controller="xla")
+    _as_worker(monkeypatch, "0", 0, 2)
+    monkeypatch.delenv("HVD_CONTROLLER", raising=False)
+    hvd.shutdown()
+    try:
+        hvd.init(devices=cpu_devices[:4], local_size=2)
+        hb1 = hb_mod.instance()
+        assert hb1 is not None and hb1.epoch == 0 and hb1.rank == 0
+        size1 = core.size()
+        # a shrink epoch: env is rewritten, then core.reinit() replays
+        # the same device selection and restarts the daemons
+        assert drv.remove("1", "crash")
+        rec = membership.wait_for_epoch(1)
+        membership.apply_epoch(rec)
+        hb2 = hb_mod.instance()
+        assert hb2 is not None and hb2 is not hb1 and hb2.epoch == 1
+        assert not hb1.is_alive() or hb1._stop_event.is_set()
+        assert core.size() == size1                # same devices replayed
+        assert core.process_size() == 1            # env identity shrunk
+        # plain shutdown drops the daemon; init restores it
+        hvd.shutdown()
+        assert hb_mod.instance() is None
+        hvd.init(devices=cpu_devices[:4], local_size=2)
+        assert hb_mod.instance() is not None
+    finally:
+        hvd.shutdown()
+        drv.shutdown()
+
+
+# -- controller timeouts name the missing ranks ------------------------------
+def test_peer_status_suffix_names_dead_ranks(rdv):
+    from horovod_tpu.runtime.controller import _peer_status_suffix
+
+    server, addr, port, secret = rdv
+    hb = hb_mod.start(0, 2, addr, port, secret=secret, interval=0.1)
+    assert _wait_for(lambda: hb.beats >= 1)
+    # rank 1 registered once, then went silent long past DEAD_FACTOR
+    server.put("health", "1",
+               json.dumps({"rank": 1, "interval": 0.01, "count": 1,
+                           "pid": 4242}).encode())
+    with server._httpd.lock:
+        server._httpd.lease_times["/health/1"] = time.monotonic() - 60.0
+    suffix = _peer_status_suffix()
+    assert "live=[0]" in suffix and "dead=[1]" in suffix
+    assert "rank(s) 1 have not arrived" in suffix
+
+
+def test_peer_status_suffix_empty_without_wiring(monkeypatch):
+    from horovod_tpu.runtime.controller import _peer_status_suffix
+
+    monkeypatch.delenv("HVD_METRICS_KV_ADDR", raising=False)
+    monkeypatch.delenv("HVD_METRICS_KV_PORT", raising=False)
+    assert _peer_status_suffix() == ""
+
+
+# -- end to end --------------------------------------------------------------
+_WORKER_SRC = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from horovod_tpu.elastic import faults, heartbeat, membership
+from horovod_tpu.elastic.state import ElasticState
+from horovod_tpu.run.http_client import get_kv, put_kv
+
+TOTAL = int(os.environ["TEST_TOTAL_STEPS"])
+TICK = float(os.environ.get("TEST_TICK_SECONDS", "0.15"))
+wid = os.environ["HVD_ELASTIC_WORKER_ID"]
+addr = os.environ["HVD_METRICS_KV_ADDR"]
+port = int(os.environ["HVD_METRICS_KV_PORT"])
+secret = bytes.fromhex(os.environ["HVD_METRICS_SECRET"])
+es = ElasticState(os.environ["TEST_CKPT"],
+                  {{"w": np.zeros(2, np.float32)}})
+if os.environ.get("TEST_SPARE") == "1":
+    rec = membership.join_world(es)
+    print("JOIN", wid, "epoch", rec["epoch"], "rank",
+          os.environ["HVD_PROCESS_ID"], flush=True)
+else:
+    membership.attach()
+    heartbeat.start_from_env()
+    # start barrier: interpreter start-up skew must not let one worker
+    # crash before its peers have begun
+    peers = os.environ["TEST_BARRIER_WORKERS"].split(",")
+    put_kv(addr, port, "sync", f"ready.{{wid}}", b"1", secret)
+    for p in peers:
+        assert get_kv(addr, port, "sync", f"ready.{{p}}", secret,
+                      wait=True, timeout=120) is not None
+    es.resume()
+print("START", wid, os.getpid(), flush=True)
+
+def train(es):
+    while es.step < TOTAL:
+        heartbeat.maybe_raise_abort()
+        faults.on_step()
+        time.sleep(TICK)
+        es.state["w"] = es.state["w"] + 1.0
+        es.step += 1
+    return es.state
+
+out = membership.run(
+    train, es,
+    on_world_change=lambda s, old, new: print(
+        "RESIZE", wid, old, "->", new, flush=True))
+print("DONE", wid, float(out["w"][0]), membership.world_size(), flush=True)
+"""
+
+
+def _spawn_worker(script, wid, rank, nproc, port, secret, tmp_path, *,
+                  spare=False, fault_spec="", total_steps=8, tick=0.15):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HVD_METRICS_KV_ADDR": "127.0.0.1",
+        "HVD_METRICS_KV_PORT": str(port),
+        "HVD_METRICS_SECRET": secret.hex(),
+        "HVD_ELASTIC": "1",
+        "HVD_ELASTIC_WORKER_ID": str(wid),
+        "HVD_PROCESS_ID": str(rank),
+        "HVD_NUM_PROCESSES": str(nproc),
+        "HVD_HEARTBEAT_INTERVAL_SECONDS": "0.2",
+        "HVD_ELASTIC_TIMEOUT_SECONDS": "60",
+        "HVD_METRICS_PUSH_SECONDS": "3600",
+        "TEST_TOTAL_STEPS": str(total_steps),
+        "TEST_TICK_SECONDS": str(tick),
+        "TEST_CKPT": str(tmp_path / "ckpt"),
+        "TEST_BARRIER_WORKERS": "0,1,2",
+    })
+    if spare:
+        env["TEST_SPARE"] = "1"
+        env.pop("HVD_PROCESS_ID")
+    if fault_spec:
+        env["HVD_FAULT_SPEC"] = fault_spec
+    return subprocess.Popen(
+        [sys.executable, str(script)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+@pytest.mark.slow
+def test_shrink_then_grow_without_relaunch(tmp_path):
+    """The acceptance drive: 3 ranks; rank 2 crashes at step 3 via
+    HVD_FAULT_SPEC; survivors commit a new epoch and rebuild as a 2-rank
+    world WITHOUT process relaunch, losing zero committed steps (the
+    in-memory broadcast carries the live step counter); a spare host
+    then announces and is admitted at an epoch boundary, and every rank
+    reports a world of 3."""
+    from horovod_tpu.run.run import _Job
+
+    secret = b"e2e-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SRC.format(repo=REPO))
+    total = 60
+    drv = ElasticDriver(server, ["0", "1", "2"], min_np=1, controller="xla")
+    procs = [
+        _spawn_worker(script, i, i, 3, port, secret, tmp_path,
+                      fault_spec="rank=2:step=3:kind=crash",
+                      total_steps=total)
+        for i in range(3)
+    ]
+    job = _Job()
+    job.procs = procs
+    spare_box = {}
+
+    def spawn_spare_after_shrink():
+        if not _wait_for(
+                lambda: (server.membership_report()["epoch"] or {})
+                .get("epoch", -1) >= 1, timeout=60.0, interval=0.1):
+            return
+        spare_box["proc"] = _spawn_worker(
+            script, 3, 0, 1, port, secret, tmp_path, spare=True,
+            total_steps=total)
+
+    spawner = threading.Thread(target=spawn_spare_after_shrink, daemon=True)
+    spawner.start()
+    try:
+        rc = drv.supervise(job)
+        outs = {str(i): p.communicate(timeout=30)[0]
+                for i, p in enumerate(procs)}
+        spawner.join(timeout=60)
+        spare = spare_box.get("proc")
+        assert spare is not None, "shrink epoch never committed"
+        spare_rc = spare.wait(timeout=120)
+        spare_out = spare.communicate()[0]
+    finally:
+        for p in procs + list(spare_box.values()):
+            if p.poll() is None:
+                p.kill()
+        drv.shutdown()
+        server.stop()
+
+    assert rc == 0, outs
+    assert procs[2].returncode == 17               # the injected crash
+    assert spare_rc == 0, spare_out
+    # survivors never relaunched: exactly one START line each
+    for wid in ("0", "1"):
+        assert outs[wid].count(f"START {wid} ") == 1, outs[wid]
+        # both membership changes hit them in process
+        assert f"RESIZE {wid} 3 -> 2" in outs[wid], outs[wid]
+        assert f"RESIZE {wid} 2 -> 3" in outs[wid], outs[wid]
+        # zero committed steps lost: the full step count ran
+        assert f"DONE {wid} {float(total)} 3" in outs[wid], outs[wid]
+    assert "JOIN 3" in spare_out
+    # the newcomer adopted the live state mid-run and finished the same
+    # schedule; size() is 3 on every rank after the grow epoch
+    assert f"DONE 3 {float(total)} 3" in spare_out, spare_out
+    # the spare was admitted into the committed world (it may be drained
+    # again post-finish if its lease expires before the children exit)
+    assert "3" in drv.flaps or "3" in drv.world
+
+
+def test_tpurun_elastic_shrinks_without_relaunch(tmp_path, monkeypatch,
+                                                 capsys):
+    """tpurun --elastic end to end (tier-1 sized): rank 1 crashes; the
+    survivor rebuilds as a 1-rank world in process (no relaunch — the
+    restart counter stays 0 and START appears once), finishes every
+    step, and tpurun exits 0."""
+    from horovod_tpu.run.run import run_commandline
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "from horovod_tpu.elastic import faults, heartbeat, membership\n"
+        "from horovod_tpu.elastic.state import ElasticState\n"
+        "from horovod_tpu.run.http_client import get_kv, put_kv\n"
+        "wid = os.environ['HVD_ELASTIC_WORKER_ID']\n"
+        "membership.attach()\n"
+        "heartbeat.start_from_env()\n"
+        "addr = os.environ['HVD_METRICS_KV_ADDR']\n"
+        "port = int(os.environ['HVD_METRICS_KV_PORT'])\n"
+        "secret = bytes.fromhex(os.environ['HVD_METRICS_SECRET'])\n"
+        "put_kv(addr, port, 'sync', f'ready.{wid}', b'1', secret)\n"
+        "for p in ('0', '1'):\n"
+        "    assert get_kv(addr, port, 'sync', f'ready.{p}', secret,\n"
+        "                  wait=True, timeout=120) is not None\n"
+        "es = ElasticState(os.environ['TEST_CKPT'],\n"
+        "                  {'w': np.zeros(2, np.float32)})\n"
+        "es.resume()\n"
+        "print('START', wid, os.environ['HVD_RESTART_COUNT'], flush=True)\n"
+        "def train(es):\n"
+        "    while es.step < 6:\n"
+        "        heartbeat.maybe_raise_abort()\n"
+        "        faults.on_step()\n"
+        "        time.sleep(0.2)\n"
+        "        es.state['w'] = es.state['w'] + 1.0\n"
+        "        es.step += 1\n"
+        "    return es.state\n"
+        "out = membership.run(train, es, on_world_change=lambda s, o, n:\n"
+        "                     print('RESIZE', wid, o, '->', n, flush=True))\n"
+        "print('DONE', wid, float(out['w'][0]), membership.world_size(),\n"
+        "      flush=True)\n"
+    )
+    monkeypatch.setenv("TEST_CKPT", str(tmp_path / "ckpt"))
+    monkeypatch.setenv("HVD_FAULT_SPEC", "rank=1:step=2:kind=crash")
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.3")
+    monkeypatch.setenv("HVD_ELASTIC_TIMEOUT_SECONDS", "30")
+    monkeypatch.setenv("HVD_TERM_GRACE_SECONDS", "2")
+    monkeypatch.setenv("HVD_METRICS_PUSH_SECONDS", "3600")
+
+    rc = run_commandline([
+        "-np", "2", "-H", "localhost:1,127.0.0.1:1", "--controller", "xla",
+        "--elastic", "--min-np", "1",
+        sys.executable, str(script),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out[-3000:]
+    # the survivor rebuilt in process: one START, incarnation 0, and the
+    # world change arrived as a resize — not a relaunch
+    assert out.count("START 0 0") == 1, out[-3000:]
+    assert "RESIZE 0 2 -> 1" in out, out[-3000:]
+    # zero committed steps lost: all 6 increments survive the shrink
+    assert "DONE 0 6.0 1" in out, out[-3000:]
+    # the dead rank is named by the epoch record path (driver logs)
+    assert "worker 1 exited with code 17" in out, out[-3000:]
