@@ -827,16 +827,28 @@ class ControllerClient {
  public:
   ControllerClient(const std::string& host, int port, int rank)
       : rank_(rank) {
-    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
     ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    // A refused connect() leaves the socket in an error state on Linux —
+    // every later connect() on the same fd fails instantly — so each
+    // attempt gets a FRESH socket.  Without this, a worker that dials
+    // the coordinator before process 0 has bound the listener burns all
+    // 100 retries in microseconds and comes up controller-less, leaving
+    // its peers to starve in the first host collective (the
+    // hetero-NIC/ring-setup startup race).
     for (int attempt = 0; attempt < 100; ++attempt) {
-      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
-          0) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd_ >= 0 &&
+          ::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
         connected_ = true;
         break;
+      }
+      if (fd_ >= 0) {
+        ::close(fd_);
+        fd_ = -1;
       }
       std::this_thread::sleep_for(std::chrono::milliseconds(20));
     }

@@ -46,6 +46,12 @@ hvd_restarts_total              counter    supervised job relaunches (launcher)
 hvd_membership_epochs_total     counter    elastic membership epochs committed
 hvd_ranks_removed_total         counter    workers removed from the world
 hvd_ranks_admitted_total        counter    workers admitted into the world
+hvd_autotune_predicted_speedup  gauge      replay-predicted speedup of the
+                                           applied fusion plan (percent)
+hvd_autotune_realized_speedup   gauge      realized speedup of the applied
+                                           plan vs its baseline window (pct)
+hvd_autotune_plans_applied_total counter   profile-guided plans applied live
+hvd_autotune_rollbacks_total    counter    plans rolled back past guard band
 ==============================  =========  ==================================
 """
 
@@ -171,6 +177,22 @@ RANKS_ADMITTED = registry.counter(
     "hvd_ranks_admitted_total",
     "Workers admitted into the elastic world at epoch boundaries "
     "(rejoins and spare hosts).")
+
+AUTOTUNE_PREDICTED_SPEEDUP = registry.gauge(
+    "hvd_autotune_predicted_speedup",
+    "Replay-predicted speedup (percent) of the currently applied "
+    "profile-guided fusion plan (optim/profile_guided.py).")
+AUTOTUNE_REALIZED_SPEEDUP = registry.gauge(
+    "hvd_autotune_realized_speedup",
+    "Realized speedup (percent) of the applied plan's verify window "
+    "against its baseline window.")
+AUTOTUNE_PLANS_APPLIED = registry.counter(
+    "hvd_autotune_plans_applied_total",
+    "Profile-guided fusion plans applied live through the re-jit seam.")
+AUTOTUNE_ROLLBACKS = registry.counter(
+    "hvd_autotune_rollbacks_total",
+    "Applied plans rolled back because realized speedup lagged the "
+    "prediction past the guard band.")
 
 
 def on() -> bool:

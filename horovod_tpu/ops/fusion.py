@@ -34,14 +34,41 @@ from .compression import Compression
 
 
 class FusionPlan:
-    """A static bucketing of a fixed list of (shape, dtype) leaves."""
+    """A static bucketing of a fixed list of (shape, dtype) leaves.
 
-    def __init__(self, leaves: Sequence[Any], threshold_bytes: Optional[int] = None):
+    Two construction modes:
+
+    * **threshold** (the default, the reference's single global knob):
+      greedy same-dtype packing under ``threshold_bytes``;
+    * **explicit** (``explicit_buckets`` — the profile-guided planner's
+      vector-of-buckets knob, optim/profile_guided.py): the caller names
+      exactly which leaves fuse together, in which dispatch order.
+      Buckets are split by dtype where members mix (one ``concatenate``
+      per dtype), and leaves no bucket claims ride as singletons
+      appended after the plan — an explicit plan can therefore never
+      drop a gradient.
+
+    ``buckets`` is the dispatch order: ``fused_allreduce`` launches
+    bucket 0's collective first, which under XLA's latency-hiding
+    scheduler is the overlap hook — the planner orders buckets so early
+    gradients go on the wire while later compute still runs.
+    """
+
+    def __init__(self, leaves: Sequence[Any],
+                 threshold_bytes: Optional[int] = None,
+                 explicit_buckets: Optional[Sequence[Sequence[int]]] = None):
         if threshold_bytes is None:
             threshold_bytes = env_util.fusion_threshold_bytes()
         self.threshold_bytes = max(int(threshold_bytes), 1)
-        # bucket := list of leaf indices, all same dtype, total bytes <= threshold
+        self.explicit = explicit_buckets is not None
         self.buckets: List[List[int]] = []
+        if explicit_buckets is not None:
+            self._build_explicit(leaves, explicit_buckets)
+        else:
+            self._build_threshold(leaves)
+
+    def _build_threshold(self, leaves: Sequence[Any]) -> None:
+        # bucket := list of leaf indices, all same dtype, total bytes <= threshold
         current: dict = {}  # dtype -> (bucket_idx, bytes_so_far)
         for i, leaf in enumerate(leaves):
             dt = jnp.result_type(leaf)
@@ -54,8 +81,79 @@ class FusionPlan:
                 self.buckets.append([i])
                 current[dt] = (len(self.buckets) - 1, nbytes)
 
+    def _build_explicit(self, leaves: Sequence[Any],
+                        explicit: Sequence[Sequence[int]]) -> None:
+        n = len(leaves)
+        seen: set = set()
+        for bucket in explicit:
+            by_dtype: dict = {}  # dtype -> list of indices, order kept
+            for i in bucket:
+                i = int(i)
+                if not 0 <= i < n:
+                    raise ValueError(
+                        f"fusion plan references leaf {i} but only {n} "
+                        "leaves exist")
+                if i in seen:
+                    raise ValueError(
+                        f"fusion plan assigns leaf {i} to two buckets")
+                seen.add(i)
+                by_dtype.setdefault(jnp.result_type(leaves[i]),
+                                    []).append(i)
+            self.buckets.extend(b for b in by_dtype.values() if b)
+        # unclaimed leaves: singletons, appended in leaf order
+        self.buckets.extend([i] for i in range(n) if i not in seen)
+
+    @classmethod
+    def from_named_buckets(cls, leaves: Sequence[Any],
+                           names: Sequence[str],
+                           named_buckets: Sequence[Sequence[str]]
+                           ) -> "FusionPlan":
+        """Explicit plan from tensor NAMES (the vocabulary of the replay
+        plan payload) matched against this call's leaf names: exact
+        match first, then path-suffix either way (trace span names are
+        often the trailing component of ``a/b/kernel`` manifest names).
+        Unmatched plan names are ignored — the trace may mention tensors
+        this step doesn't carry — and unmatched leaves fall out as
+        appended singletons (explicit-plan semantics above)."""
+        index: dict = {str(nm): i for i, nm in enumerate(names)}
+
+        def match(name: str) -> Optional[int]:
+            if name in index:
+                return index[name]
+            for nm, i in index.items():
+                if nm.endswith("/" + name) or name.endswith("/" + nm):
+                    return i
+            return None
+
+        used: set = set()
+        explicit: List[List[int]] = []
+        for bucket in named_buckets:
+            idxs = []
+            for name in bucket:
+                i = match(str(name))
+                if i is not None and i not in used:
+                    used.add(i)
+                    idxs.append(i)
+            if idxs:
+                explicit.append(idxs)
+        return cls(leaves, explicit_buckets=explicit)
+
     def num_buckets(self) -> int:
         return len(self.buckets)
+
+
+def tree_leaf_names(tree, *, is_leaf=None) -> List[str]:
+    """Slash-joined key paths of a pytree's leaves (``params/dense/kernel``
+    vocabulary — matches the Recorder's gradient manifest names)."""
+    paths = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)[0]
+
+    def key_str(k) -> str:
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                return str(getattr(k, attr))
+        return str(k)
+
+    return ["/".join(key_str(k) for k in path) for path, _leaf in paths]
 
 
 def _reduce_flat(flat, *, op, axes, groups, group_size):
@@ -75,10 +173,15 @@ def fused_allreduce(
     compression=Compression.none,
     process_set=None,
     threshold_bytes: Optional[int] = None,
+    plan: Optional[FusionPlan] = None,
 ):
     """Allreduce a list of tensors with static bucketing; returns the list in
     the original order (reference semantics: grouped allreduce results are
-    per-input, horovod/common/controller.cc FuseResponses)."""
+    per-input, horovod/common/controller.cc FuseResponses).  ``plan``
+    overrides the threshold bucketing with an explicit
+    :class:`FusionPlan` (profile-guided tuning); buckets dispatch in plan
+    order, which is the overlap schedule under XLA's latency-hiding
+    scheduler."""
     axes = core._spmd_axes()
     if axes is None:
         raise RuntimeError("fused_allreduce must run inside an SPMD region")
@@ -94,7 +197,15 @@ def fused_allreduce(
         compressed.append(c)
         ctxs.append(ctx)
 
-    plan = FusionPlan(compressed, threshold_bytes)
+    if plan is None:
+        plan = FusionPlan(compressed, threshold_bytes)
+    elif {i for b in plan.buckets for i in b} != set(range(len(compressed))):
+        # exact coverage both ways: a stale plan (model gained or lost a
+        # parameter since it was built) must fail loudly, not silently
+        # return None in place of the uncovered gradients
+        raise ValueError(
+            f"fusion plan covers {sum(len(b) for b in plan.buckets)} "
+            f"tensors but the call passed {len(compressed)}")
     out: List[Any] = [None] * len(tensors)
     for bucket in plan.buckets:
         if len(bucket) == 1:
@@ -126,12 +237,18 @@ def allreduce_pytree(
     process_set=None,
     threshold_bytes: Optional[int] = None,
     sparse_as_dense: bool = False,
+    named_buckets: Optional[Sequence[Sequence[str]]] = None,
 ):
     """Fused allreduce over every array leaf of a pytree (gradients).
 
     ``IndexedSlices`` leaves take the sparse allgather path (reference
     tensorflow/__init__.py:75-90) unless ``sparse_as_dense`` (reference
-    DistributedOptimizer option) densifies them first."""
+    DistributedOptimizer option) densifies them first.
+
+    ``named_buckets`` applies an explicit profile-guided fusion plan
+    (lists of tensor names in dispatch order, the replay plan payload's
+    vocabulary) matched against the tree's slash-joined leaf paths —
+    see :meth:`FusionPlan.from_named_buckets` for the matching rules."""
     from .sparse import (
         allreduce_indexed_slices, is_indexed_slices, to_dense,
     )
@@ -139,14 +256,18 @@ def allreduce_pytree(
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=is_indexed_slices
     )
+    names = tree_leaf_names(tree, is_leaf=is_indexed_slices) \
+        if named_buckets else [""] * len(leaves)
     dense_idx = []
     dense_leaves = []
+    dense_names = []
     out: list = [None] * len(leaves)
     for i, leaf in enumerate(leaves):
         if is_indexed_slices(leaf):
             if sparse_as_dense:
                 dense_idx.append(i)
                 dense_leaves.append(to_dense(leaf))
+                dense_names.append(names[i])
             else:
                 out[i] = allreduce_indexed_slices(
                     leaf, op=op, process_set=process_set
@@ -154,9 +275,13 @@ def allreduce_pytree(
         else:
             dense_idx.append(i)
             dense_leaves.append(leaf)
+            dense_names.append(names[i])
+    plan = FusionPlan.from_named_buckets(
+        dense_leaves, dense_names, named_buckets) if named_buckets else None
     reduced = fused_allreduce(
         dense_leaves, op=op, compression=compression,
         process_set=process_set, threshold_bytes=threshold_bytes,
+        plan=plan,
     )
     for i, r in zip(dense_idx, reduced):
         out[i] = r

@@ -20,6 +20,7 @@ Pure NumPy GP (RBF kernel + jitter, Cholesky solves) — no SciPy needed.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from dataclasses import dataclass, field
@@ -89,7 +90,18 @@ def expected_improvement(mu: np.ndarray, sigma: np.ndarray,
 
 class BayesianOptimization:
     """Sequential EI maximization over a normalized box with optional
-    categorical dimensions enumerated exhaustively."""
+    categorical dimensions enumerated exhaustively.
+
+    Prior points (``observe_prior``) live on their own list because the
+    warm-start model scores in different units than live observations
+    (the α–β prior predicts comm-only bytes/sec; ``record_step`` scores
+    whole-step bytes/sec, compute included, typically orders of
+    magnitude smaller).  Mixing them raw would let the prior win every
+    argmax and make real measurements unable to override the model.
+    ``set_prior_scale`` anchors the prior into live units (the
+    ParameterManager sets it from the first live sample); until the
+    scale is known, priors are used alone (scale cancels in an argmax
+    over priors only) and dropped from any mix with live data."""
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
                  noise: float = 1e-3, seed: int = 0):
@@ -97,6 +109,9 @@ class BayesianOptimization:
         self.gp = GaussianProcessRegressor(length_scale=0.3, noise=noise)
         self.xs: List[np.ndarray] = []
         self.ys: List[float] = []
+        self.prior_xs: List[np.ndarray] = []
+        self.prior_ys: List[float] = []
+        self.prior_scale: Optional[float] = None
         self._rng = np.random.default_rng(seed)
 
     def _norm(self, x):
@@ -107,37 +122,104 @@ class BayesianOptimization:
         lo, hi = self.bounds[:, 0], self.bounds[:, 1]
         return lo + np.asarray(u) * (hi - lo)
 
+    def _merged(self) -> Tuple[List[np.ndarray], List[float]]:
+        if self.prior_ys and (self.prior_scale is not None or not self.ys):
+            s = self.prior_scale if self.prior_scale is not None else 1.0
+            return (self.prior_xs + self.xs,
+                    [y * s for y in self.prior_ys] + self.ys)
+        return self.xs, self.ys
+
+    def _refit(self) -> None:
+        xs, ys = self._merged()
+        if xs:
+            self.gp.fit(np.stack(xs), np.asarray(ys))
+
     def observe(self, x, y: float) -> None:
         self.xs.append(self._norm(x))
         self.ys.append(float(y))
-        self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
+        self._refit()
+
+    def observe_prior(self, x, y: float) -> None:
+        self.prior_xs.append(self._norm(x))
+        self.prior_ys.append(float(y))
+        self._refit()
+
+    def prior_at(self, x) -> Optional[float]:
+        """Raw (unscaled) prior value at the prior point nearest ``x`` —
+        the anchor the ParameterManager rescales against."""
+        if not self.prior_xs:
+            return None
+        u = self._norm(x)
+        d = [float(((u - p) ** 2).sum()) for p in self.prior_xs]
+        return self.prior_ys[int(np.argmin(d))]
+
+    def set_prior_scale(self, s: float) -> None:
+        self.prior_scale = float(s)
+        self._refit()
 
     def suggest(self, n_candidates: int = 256):
-        if len(self.xs) < 2:
+        xs, ys = self._merged()
+        if len(xs) < 2:
             return self._denorm(self._rng.uniform(size=len(self.bounds)))
         cand = self._rng.uniform(size=(n_candidates, len(self.bounds)))
         mu, sigma = self.gp.predict(cand)
-        ei = expected_improvement(mu, sigma, max(self.ys))
+        ei = expected_improvement(mu, sigma, max(ys))
         return self._denorm(cand[int(np.argmax(ei))])
 
     def best(self):
-        if not self.xs:
+        # Live observations only: the prior scale anchors ONE point into
+        # live units, so elsewhere on the curve a scaled prior can still
+        # outrank every real measurement — the final argmax must never
+        # pin a never-measured model prediction (priors shape suggest()'s
+        # EI, nothing more).  Priors alone are the fallback when nothing
+        # was measured at all.
+        xs, ys = (self.xs, self.ys) if self.ys else self._merged()
+        if not xs:
             return None, None
-        i = int(np.argmax(self.ys))
-        return self._denorm(self.xs[i]), self.ys[i]
+        i = int(np.argmax(ys))
+        return self._denorm(xs[i]), ys[i]
 
 
 @dataclass
 class TunableParams:
-    """The knob set (reference ParameterManager's tunables, translated)."""
+    """The knob set (reference ParameterManager's tunables, translated).
+
+    The GP encoding is split in two, and the split is part of the
+    contract:
+
+    * :meth:`as_vector` — the CONTINUOUS dimensions only (today: log2 of
+      the fusion threshold).  Categorical flags are deliberately NOT
+      encoded here: an RBF kernel over a {0,1} coordinate would smear
+      observations across categories that share nothing.
+    * :meth:`category` — the categorical coordinates
+      (``hierarchical_allreduce``), which select WHICH per-category GP an
+      observation lands in (the reference enumerates categorical
+      combinations the same way).  A flipped flag therefore always maps
+      to a different GP; it can never silently share one.
+
+    ``fusion_plan`` pins an explicit profile-guided plan
+    (optim/profile_guided.py FusionPlanSpec): while set, the plan's
+    bucket vector overrides the scalar threshold in the training step's
+    rebuild, and the GP loop is paused (the planner owns the knobs).
+    """
 
     fusion_threshold_bytes: int = env_util.DEFAULT_FUSION_THRESHOLD_BYTES
     hierarchical_allreduce: bool = False
+    fusion_plan: Optional[object] = None
+
+    #: dimension inventory backing the split (documentation + tests)
+    CONTINUOUS_DIMS = ("fusion_threshold_bytes",)
+    CATEGORICAL_DIMS = ("hierarchical_allreduce",)
 
     def as_vector(self) -> np.ndarray:
-        # log2 of threshold in MB-ish units for a smooth GP landscape
+        # log2 of threshold in MB-ish units for a smooth GP landscape;
+        # continuous dims ONLY — see the class docstring
         return np.array([np.log2(max(self.fusion_threshold_bytes, 1024))],
                         np.float64)
+
+    def category(self) -> Tuple[bool, ...]:
+        """The per-category-GP key (one GP per value of this tuple)."""
+        return (bool(self.hierarchical_allreduce),)
 
 
 class ParameterManager:
@@ -179,14 +261,25 @@ class ParameterManager:
         self.log_file = log_file or env_util.get_str(env_util.HVD_AUTOTUNE_LOG)
         self.on_update = on_update
 
-        # log2(threshold bytes) in [log2(1MB), log2(256MB)]
-        self._categories = [False, True] if tune_hierarchical else [False]
+        # log2(threshold bytes) in [log2(1MB), log2(256MB)]; one GP per
+        # categorical combination (TunableParams.category) — the
+        # explicit split a flipped flag can't cross
+        self._noise = noise
+        self.current = initial if initial is not None else TunableParams()
+        # proposal rotation: both flag settings when the flag is tuned,
+        # otherwise ONLY the pinned initial category — an untuned flag
+        # must never be flipped by the rotation (tune_hierarchical=False
+        # with hierarchical=True would otherwise alternate the flag
+        # every sample, re-jitting and overriding the caller's pin)
+        self._categories: List[Tuple[bool, ...]] = \
+            [(False,), (True,)] if tune_hierarchical \
+            else [self.current.category()]
         self._bo = {
             cat: BayesianOptimization([(20.0, 28.0)], noise=noise, seed=17 + i)
             for i, cat in enumerate(self._categories)
         }
         self._cat_idx = 0
-        self.current = initial if initial is not None else TunableParams()
+        self._plan_prev_frozen: Optional[bool] = None
         self._samples_seen = 0
         self._warmup_left = self.warmup_samples
         self._step_scores: List[float] = []
@@ -230,7 +323,7 @@ class ParameterManager:
                 cat = self._native_lib.hvd_tuner_category(self._native)
                 self._set_params(TunableParams(
                     fusion_threshold_bytes=int(2 ** float(x)),
-                    hierarchical_allreduce=self._categories[cat],
+                    hierarchical_allreduce=self._categories[cat][0],
                 ))
                 self._log(self._native_lib.hvd_tuner_last_score(self._native))
             if self._native_lib.hvd_tuner_frozen(self._native):
@@ -252,8 +345,27 @@ class ParameterManager:
         if self._warmup_left > 0:
             self._warmup_left -= 1
             return
-        cat = self._categories[self._cat_idx]
-        self._bo[cat].observe(self.current.as_vector(), score)
+        # the observation lands in the GP selected by the CURRENT params'
+        # categorical coordinates — not by loop position, so a flag that
+        # moved out-of-band still scores against its own surface (an
+        # unseen category gets its own GP without joining the proposal
+        # rotation — scoring must never start flipping an untuned flag)
+        cat = self.current.category()
+        bo = self._bo.get(cat)
+        if bo is None:
+            bo = self._bo[cat] = BayesianOptimization(
+                [(20.0, 28.0)], noise=self._noise, seed=17 + len(self._bo))
+        if bo.prior_ys and bo.prior_scale is None:
+            # anchor the warm-start prior into live units: the model's
+            # prediction at the point we just measured is declared equal
+            # to the measurement, so the prior contributes its SHAPE but
+            # can never outrank reality by unit mismatch alone.  One
+            # scale for every category (same score_fn units).
+            ref = bo.prior_at(self.current.as_vector())
+            if ref and ref > 0 and score > 0:
+                for b in self._bo.values():
+                    b.set_prior_scale(score / ref)
+        bo.observe(self.current.as_vector(), score)
         self._log(score)
         self._samples_seen += 1
         if self._samples_seen >= self.max_samples:
@@ -265,7 +377,7 @@ class ParameterManager:
         vec = self._bo[nxt_cat].suggest()
         self._set_params(TunableParams(
             fusion_threshold_bytes=int(2 ** float(vec[0])),
-            hierarchical_allreduce=nxt_cat,
+            hierarchical_allreduce=nxt_cat[0],
         ))
 
     def _freeze(self) -> None:
@@ -277,17 +389,71 @@ class ParameterManager:
         if best_vec is not None:
             self._set_params(TunableParams(
                 fusion_threshold_bytes=int(2 ** float(best_vec[0])),
-                hierarchical_allreduce=bool(best_cat),
+                hierarchical_allreduce=bool(best_cat[0]),
             ))
         self.frozen = True
         log.info("autotune frozen: threshold=%d hierarchical=%s (score %.3g)",
                  self.current.fusion_threshold_bytes,
                  self.current.hierarchical_allreduce, best_y)
 
+    # -- profile-guided seams ------------------------------------------------
+    def warm_start(self, score_fn: Callable[[TunableParams], float],
+                   n_points: int = 8) -> int:
+        """Seed every per-category GP with ``score_fn``'s predicted score
+        over a threshold grid (optim/profile_guided.py feeds the α–β
+        model's bytes/sec here), so Bayesian exploration starts near the
+        simulator's predicted optimum instead of at a random draw.  Prior
+        points do NOT consume the ``max_samples`` budget — warm-started
+        runs converge in fewer real observations — and they live on the
+        GP's separate prior list: the first live sample anchors their
+        scale into measured units (comm-only model bytes/sec vs
+        whole-step live bytes/sec differ by orders of magnitude), so the
+        model contributes shape, never an unbeatable score.  Returns the
+        number of prior points injected."""
+        if self._native is not None:
+            log.info("autotune warm start: falling back to the python "
+                     "tuner (the native state machine takes no priors)")
+            self._native = None
+        injected = 0
+        for cat, bo in self._bo.items():
+            lo, hi = bo.bounds[0]
+            for x in np.linspace(lo, hi, n_points):
+                p = TunableParams(fusion_threshold_bytes=int(2 ** float(x)),
+                                  hierarchical_allreduce=bool(cat[0]))
+                try:
+                    y = float(score_fn(p))
+                except Exception as e:  # noqa: BLE001
+                    log.warning("warm start scorer failed at %s: %s", p, e)
+                    continue
+                if np.isfinite(y):
+                    bo.observe_prior(p.as_vector(), y)
+                    injected += 1
+        return injected
+
+    def apply_plan(self, plan) -> None:
+        """Pin an explicit profile-guided fusion plan: fires
+        ``on_update`` with the plan attached and pauses GP exploration
+        (the planner owns the knobs until :meth:`clear_plan`)."""
+        if self._plan_prev_frozen is None:
+            self._plan_prev_frozen = self.frozen
+        self.frozen = True
+        self._set_params(dataclasses.replace(self.current, fusion_plan=plan))
+
+    def clear_plan(self) -> None:
+        """Roll the pinned plan back to threshold bucketing; GP
+        exploration resumes in whatever state it was paused in."""
+        if self.current.fusion_plan is None:
+            return
+        self._set_params(dataclasses.replace(self.current, fusion_plan=None))
+        if self._plan_prev_frozen is not None:
+            self.frozen = self._plan_prev_frozen
+            self._plan_prev_frozen = None
+
     def _set_params(self, p: TunableParams) -> None:
         changed = (
             p.fusion_threshold_bytes != self.current.fusion_threshold_bytes
             or p.hierarchical_allreduce != self.current.hierarchical_allreduce
+            or p.fusion_plan is not self.current.fusion_plan
         )
         self.current = p
         if changed and self.on_update:

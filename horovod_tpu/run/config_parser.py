@@ -29,6 +29,9 @@ _CONFIG_SCHEMA = {
         "steps_per_sample": "autotune_steps_per_sample",
         "bayes_opt_max_samples": "autotune_bayes_opt_max_samples",
         "gaussian_process_noise": "autotune_gaussian_process_noise",
+        "profile_guided": "profile_guided",
+        "window_steps": "autotune_window_steps",
+        "guard_band_pct": "autotune_guard_band_pct",
     },
     "timeline": {
         "filename": "timeline_filename",
@@ -98,6 +101,15 @@ def env_from_args(args) -> Dict[str, str]:
         ]:
             if getattr(args, attr, None) is not None:
                 env[var] = str(getattr(args, attr))
+
+    setb(env_util.HVD_AUTOTUNE_PROFILE_GUIDED,
+         getattr(args, "profile_guided", False))
+    if getattr(args, "autotune_window_steps", None) is not None:
+        env[env_util.HVD_AUTOTUNE_WINDOW_STEPS] = str(
+            args.autotune_window_steps)
+    if getattr(args, "autotune_guard_band_pct", None) is not None:
+        env[env_util.HVD_AUTOTUNE_GUARD_BAND_PCT] = str(
+            args.autotune_guard_band_pct)
 
     if getattr(args, "timeline_filename", None):
         env[env_util.HVD_TIMELINE] = str(args.timeline_filename)

@@ -194,6 +194,30 @@ def put_replay_summary(addr: str, port: int, summary: dict,
            json.dumps(summary).encode(), secret=secret)
 
 
+def put_autotune_plan(addr: str, port: int, seq: int, record: dict,
+                      secret: Optional[bytes] = None) -> None:
+    """Publish one profile-guided plan record (applied / verified /
+    rolled_back — optim/profile_guided.py) under the rendezvous
+    ``autotune`` scope so ``GET /autotune`` renders the per-plan table.
+    Single writer (the tuner), last-writer-wins → safe to retry."""
+    import json
+
+    put_kv(addr, port, "autotune", f"plan.{int(seq)}",
+           json.dumps(record).encode(), secret=secret, retry=True)
+
+
+def get_autotune(addr: str, port: int, secret: Optional[bytes] = None,
+                 timeout: float = 10.0) -> dict:
+    """The profile-guided tuning table from ``GET /autotune``: every
+    pushed plan record plus the latest predicted/realized speedup pair
+    (docs/autotune.md artifact contract)."""
+    import json
+
+    with _request("GET", addr, port, "/autotune", secret=secret,
+                  timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
 def get_replay(addr: str, port: int,
                secret: Optional[bytes] = None) -> Optional[dict]:
     """The latest replay summary from ``GET /replay`` (None if nothing

@@ -62,6 +62,14 @@ _SANITIZER_PREFIX = f"/{SANITIZER_SCOPE}/"
 REPLAY_SCOPE = "replay"
 REPLAY_SUMMARY_KEY = "summary"
 
+# profile-guided autotune loop (optim/profile_guided.py): the tuner (or
+# scripts/hvd_autotune.py --push) publishes one record per plan event
+# under plan.<n>; GET /autotune renders the per-plan table plus the
+# latest predicted/realized speedup pair (docs/autotune.md contract)
+AUTOTUNE_SCOPE = "autotune"
+_AUTOTUNE_PREFIX = f"/{AUTOTUNE_SCOPE}/"
+AUTOTUNE_PLAN_PREFIX = "plan."
+
 # failure-domain runtime (elastic/heartbeat.py, elastic/abort.py): ranks
 # renew leases under /health/<rank>; the server stamps each PUT on ITS
 # clock and GET /health renders per-rank lease age + live/stale/dead
@@ -168,6 +176,40 @@ def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
         "ready": ready,
         "blocklist": _load(keys.get(BLOCKLIST_KEY)) or [],
     }
+
+
+def build_autotune_report(store: Dict[str, bytes]) -> Dict[str, object]:
+    """The profile-guided tuning table from a store snapshot: every
+    pushed plan record in sequence order, the latest record as
+    ``current``, and the headline predicted/realized speedup pair —
+    ``GET /autotune``'s body (docs/autotune.md)."""
+    plans = []
+    for k, v in store.items():
+        if not k.startswith(_AUTOTUNE_PREFIX):
+            continue
+        key = k[len(_AUTOTUNE_PREFIX):]
+        if not key.startswith(AUTOTUNE_PLAN_PREFIX):
+            continue
+        seq_s = key[len(AUTOTUNE_PLAN_PREFIX):]
+        try:
+            seq = int(seq_s)
+        except ValueError:
+            continue
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            rec = "<undecodable>"
+        plans.append({"seq": seq, "record": rec})
+    plans.sort(key=lambda p: p["seq"])
+    current = plans[-1]["record"] if plans else None
+    report: Dict[str, object] = {"plans": plans, "current": current}
+    if isinstance(current, dict):
+        report["predicted_speedup_pct"] = current.get(
+            "predicted_speedup_pct")
+        report["realized_speedup_pct"] = current.get(
+            "realized_speedup_pct")
+        report["outcome"] = current.get("outcome")
+    return report
 
 
 class KVStoreHandler(BaseHTTPRequestHandler):
@@ -293,6 +335,12 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             else:
                 self._reply(200, val, content_type="application/json")
             return
+        if path == "/autotune":
+            with self.server.lock:  # type: ignore
+                store = dict(self.server.store)  # type: ignore
+            self._reply(200, json.dumps(build_autotune_report(store))
+                        .encode(), content_type="application/json")
+            return
         store: Dict[str, bytes] = self.server.store  # type: ignore
         with self.server.lock:  # type: ignore
             val = store.get(self.path)
@@ -408,6 +456,12 @@ class RendezvousServer:
         """In-process equivalent of GET /membership."""
         with self._httpd.lock:  # type: ignore[attr-defined]
             return build_membership_report(
+                dict(self._httpd.store))  # type: ignore[attr-defined]
+
+    def autotune_report(self) -> Dict[str, object]:
+        """In-process equivalent of GET /autotune."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return build_autotune_report(
                 dict(self._httpd.store))  # type: ignore[attr-defined]
 
     def clear_scope(self, scope: str) -> None:

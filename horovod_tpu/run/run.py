@@ -142,6 +142,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                           dest="autotune_bayes_opt_max_samples")
     group_at.add_argument("--autotune-gaussian-process-noise", type=float,
                           dest="autotune_gaussian_process_noise")
+    group_at.add_argument("--profile-guided", action="store_true",
+                          dest="profile_guided",
+                          help="close the replay->autotune loop: plan "
+                               "fusion buckets from the job's own trace "
+                               "window, apply live, verify predicted vs "
+                               "realized (docs/autotune.md; needs "
+                               "--timeline-filename)")
+    group_at.add_argument("--autotune-window-steps", type=int,
+                          dest="autotune_window_steps")
+    group_at.add_argument("--autotune-guard-band-pct", type=float,
+                          dest="autotune_guard_band_pct")
 
     group_tl = parser.add_argument_group("timeline arguments")
     group_tl.add_argument("--timeline-filename", dest="timeline_filename")

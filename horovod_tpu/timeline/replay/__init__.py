@@ -67,13 +67,22 @@ def _cost_model_from_env(world: int) -> CostModel:
 
 
 def analyze(trace_dir: str, *, step: Optional[int] = None,
-            cost_model: Optional[CostModel] = None) -> ReplayResult:
+            last_steps: Optional[int] = None,
+            cost_model: Optional[CostModel] = None,
+            plan_search: bool = True) -> ReplayResult:
     """Stitch ``trace_dir``, replay every step (or just ``step``), and
     assemble the summary: per-step critical path + attribution +
     ranked what-ifs, a per-tensor cost-model table (predicted vs
     measured, via comm_report.per_tensor_table — the SAME α–β model the
-    what-ifs use), and cross-step recommendations."""
-    art, dags = stitch(trace_dir)
+    what-ifs use), and cross-step recommendations.
+
+    ``last_steps`` replays only the N most recent steps — the in-job
+    profile-guided tuner passes 1: SPMD steps share one DAG shape, so
+    the latest step's plan stands for all, and a window-cadence caller
+    must not pay a whole-history replay (incl. the per-step bucket
+    search) that grows with the trace."""
+    art, dags = stitch(trace_dir,
+                       last_steps=last_steps if step is None else None)
     if step is not None:
         dags = [d for d in dags if d.step == step]
         if not dags:
@@ -93,7 +102,7 @@ def analyze(trace_dir: str, *, step: Optional[int] = None,
         scheds[dag.step] = sched
         path = critical_path(dag, sched)
         attr = attribute(dag, sched)
-        wi = what_if(dag, cm)
+        wi = what_if(dag, cm, plan_search=plan_search)
         measured = dag.measured_step_us
         # aggregate per tensor: a tensor collected k times in the step
         # (microbatch accumulation) contributes k calls and k measured

@@ -47,10 +47,18 @@ class Schedule:
     overlap: bool
 
 
-def build_edges(dag: StepDAG, overlap: bool = False
+def build_edges(dag: StepDAG, overlap: bool = False,
+                extra_preds: Optional[Dict[int, List[int]]] = None,
                 ) -> Tuple[Dict[int, List[int]], Dict[int, int], int]:
     """``(preds, rank_end_sentinels, sink)`` — sentinel ids live past
-    ``len(dag.nodes)`` and have zero duration."""
+    ``len(dag.nodes)`` and have zero duration.
+
+    ``extra_preds`` merges additional dependency edges into the derived
+    set — the simulator's comm-CHANNEL serialization: under
+    ``overlap=True`` collectives stop blocking host threads, but a real
+    ICI domain still runs one collective at a time, so the bucketed
+    what-ifs chain their bucket nodes here (bucket ``i+1`` cannot enter
+    the wire before bucket ``i`` leaves it)."""
     preds: Dict[int, List[int]] = {n.nid: [] for n in dag.nodes}
     next_id = len(dag.nodes)
     rank_end: Dict[int, int] = {}
@@ -88,14 +96,20 @@ def build_edges(dag: StepDAG, overlap: bool = False
                                  if c not in preds[end_id])
     sink = next_id
     preds[sink] = list(rank_end.values())
+    if extra_preds:
+        for nid, ps in extra_preds.items():
+            cur = preds.setdefault(nid, [])
+            cur.extend(p for p in ps if p not in cur)
     return preds, rank_end, sink
 
 
 def schedule(dag: StepDAG, *, overlap: bool = False,
              dur_overrides: Optional[Dict[int, float]] = None,
-             base_overrides: Optional[Dict[int, float]] = None) -> Schedule:
+             base_overrides: Optional[Dict[int, float]] = None,
+             extra_preds: Optional[Dict[int, List[int]]] = None) -> Schedule:
     """Kahn-order discrete-event pass over the DAG."""
-    preds, rank_end, sink = build_edges(dag, overlap=overlap)
+    preds, rank_end, sink = build_edges(dag, overlap=overlap,
+                                        extra_preds=extra_preds)
     durs = {n.nid: n.dur_us for n in dag.nodes}
     if dur_overrides:
         durs.update(dur_overrides)

@@ -88,6 +88,97 @@ def _events_rank1() -> List[dict]:
     ]
 
 
+#: --- autotune ground truth (scripts/hvd_autotune.py --check) -------------
+#:
+#: A second hand-computed 2-rank trace, symmetric across ranks (no
+#: straggler, no clock skew) so the interesting structure is entirely in
+#: the fusion/overlap economics.  Three gradients, hop latency 10 µs
+#: (α = 2 hops × 10 = 20 µs per 2-rank ring all-reduce), calibrated
+#: β = measured − α:
+#:
+#: ::
+#:
+#:     both ranks:  [A 100][g0 120][B 80][g1 50][C 20][g2 50][tail 20]
+#:                  0     100     220   300    350   370    420    440
+#:
+#: Two-thread replay (compute thread ∥ one serialized comm channel):
+#: computes run back-to-back (A 0–100, B 100–180, C 180–200, tail
+#: 200–220) and each bucket launches at max(its fill time, channel
+#: free):
+#:
+#: * 3 buckets (no fusion):   g0 100→220, g1 220→270, g2 270→320 → 320
+#: * 2 buckets {g0},{g1,g2}:  g0 100→220, {g1,g2} = α20+β60 = 80,
+#:   220→300 → **300 µs** (the optimum the loop must recover)
+#: * 1 bucket  {g0,g1,g2}:    fills at 200, α20+β160 = 180 → 380
+#: * fuse_all_comm (serial):  200 compute + 180 bucket + 20 tail = 400
+#: * overlap_comm (free channels, unimplementable upper bound): 250
+AUTOTUNE_TENSORS = ("g0", "g1", "g2")
+AUTOTUNE_SHAPES = {"g0": [1024, 1024], "g1": [256, 256], "g2": [256, 256]}
+AUTOTUNE_STEP_NO = 1
+AUTOTUNE_HOP_US = 10.0
+
+AUTOTUNE_EXPECTED: Dict[str, object] = {
+    "baseline_us": 440.0,
+    "optimal_num_buckets": 2,
+    "optimal_buckets": [["g0"], ["g1", "g2"]],
+    "predicted_step_us": 300.0,
+    "predicted_speedup_pct": 31.82,
+    "bucket_search_us": {1: 380.0, 2: 300.0, 3: 320.0},
+    "fuse_all_us": 400.0,
+    "overlap_us": 250.0,
+    "hop_latency_us": AUTOTUNE_HOP_US,
+    "tensor_bytes": {"g0": 1024 * 1024 * 4, "g1": 256 * 256 * 4,
+                     "g2": 256 * 256 * 4},
+}
+
+
+def _autotune_events() -> List[dict]:
+    """One rank's step (both ranks are identical): serial comm blocks the
+    host, negotiation is instantaneous (B == E == span start)."""
+    evs: List[dict] = [
+        {"name": "STEP", "cat": f"step_{AUTOTUNE_STEP_NO}", "ph": "X",
+         "ts": 0.0, "dur": 440.0, "tid": "step"},
+    ]
+    for tensor, ts, dur in (("g0", 100.0, 120.0), ("g1", 300.0, 50.0),
+                            ("g2", 370.0, 50.0)):
+        evs += [
+            {"name": "NEGOTIATE_ALLREDUCE", "cat": tensor, "ph": "B",
+             "ts": ts, "tid": tensor},
+            {"name": "NEGOTIATE_ALLREDUCE", "cat": tensor, "ph": "E",
+             "ts": ts, "tid": tensor},
+            {"name": "ALLREDUCE", "cat": tensor, "ph": "X", "ts": ts,
+             "dur": dur, "tid": tensor},
+        ]
+    return evs
+
+
+def write_autotune_fixture_trace(trace_dir: str) -> Dict[str, object]:
+    """Materialize the autotune ground-truth trace (both ranks identical,
+    offsets 0) and return :data:`AUTOTUNE_EXPECTED`."""
+    names = list(AUTOTUNE_TENSORS)
+    for rank in (0, 1):
+        d = os.path.join(trace_dir, str(rank))
+        os.makedirs(d, exist_ok=True)
+        evs = [dict(ev, pid=rank) for ev in _autotune_events()]
+        with open(os.path.join(d, "comm.json"), "w") as f:
+            json.dump(evs, f, indent=1)
+        with open(os.path.join(d, "clock_sync.json"), "w") as f:
+            json.dump({"offset_us": 0.0, "rtt_us": 4.0, "samples": 8,
+                       "rank": rank, "method": "fixture"}, f, indent=1)
+        with open(os.path.join(d, "tensor_shapes.json"), "w") as f:
+            json.dump(AUTOTUNE_SHAPES, f, indent=1)
+        with open(os.path.join(d, "tensor_dtypes.json"), "w") as f:
+            json.dump({t: "float32" for t in names}, f, indent=1)
+        with open(os.path.join(d, "gradient_name_list.json"), "w") as f:
+            json.dump(names, f, indent=1)
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            json.dump({"rank": rank, "size": 2,
+                       "model": "autotune-fixture"}, f, indent=1)
+        nodes, edges = structure_dag(names)
+        write_gml(nodes, edges, os.path.join(d, "dag.gml"))
+    return dict(AUTOTUNE_EXPECTED)
+
+
 def write_fixture_trace(trace_dir: str) -> Dict[str, object]:
     """Materialize the fixture (comm.json + clock_sync.json +
     tensor_shapes/dtypes + gradient manifest + dag.gml + metadata per

@@ -22,7 +22,17 @@ same DAG (critical_path.schedule):
 * ``fuse_all_comm`` — all collectives in the step re-batched into one
   bucket: one α, summed β, readiness gated by the LAST gradient — the
   fusion-buffer ceiling (bucket re-batching is the reference's whole
-  fusion rationale).
+  fusion rationale);
+* ``fuse_buckets_<k>`` — the *implementable* middle ground the
+  profile-guided planner (optim/profile_guided.py) consumes: the step's
+  collectives re-batched into ``k`` explicit buckets that dispatch on a
+  serialized comm channel while compute proceeds (two-thread model: one
+  host/compute thread per rank, ONE wire).  The bucket search is
+  agglomerative — start from singletons in gradient-ready order, merge
+  the adjacent pair that most improves the replayed makespan — and every
+  ``fuse_buckets_*`` scenario carries a machine-readable ``plan``
+  payload (bucket membership by tensor name, dispatch order, predicted
+  step µs) so the planner can turn the ranking into live knob settings.
 
 Predictions are *calibrated replays*: the baseline is the DAG replayed
 with measured durations, so a scenario's delta isolates exactly the
@@ -190,27 +200,219 @@ def fused_dag(dag: StepDAG, cm: CostModel) -> Optional[StepDAG]:
     )
 
 
+def comm_channel_order(dag: StepDAG) -> List[int]:
+    """Comm node ids in collective dispatch order.  Ranks dispatch
+    collectives in one consistent order (anything else deadlocks the real
+    job and the linter/sanitizer reject it), so the lowest rank's chain
+    order IS the wire order; comm nodes a subset rank never joined are
+    appended in nid order."""
+    first = min(dag.chains) if dag.chains else None
+    order = [nid for nid in dag.chains.get(first, ())
+             if dag.nodes[nid].kind == "comm"]
+    seen = set(order)
+    order.extend(n.nid for n in dag.nodes
+                 if n.kind == "comm" and n.nid not in seen)
+    return order
+
+
+def bucketed_dag(dag: StepDAG, cm: CostModel,
+                 buckets: List[List[int]]):
+    """The step DAG with the given comm nodes re-batched into explicit
+    buckets (each a list of original comm node ids): per rank a bucket
+    node sits where its LAST member sat, earlier members vanish, and the
+    bucket costs one α (the members' max) plus the summed calibrated β.
+    Readiness per rank is the last compute segment preceding the bucket's
+    last member — a bucket can't launch before it fills.
+
+    Returns ``(new_dag, bucket_ids, chain_edges)`` where ``chain_edges``
+    serializes the bucket nodes on one comm channel in dispatch order —
+    pass it as ``schedule(..., overlap=True, extra_preds=chain_edges)``
+    for the two-thread (compute ∥ wire) replay the profile-guided plans
+    are priced with."""
+    order = comm_channel_order(dag)
+    pos = {nid: i for i, nid in enumerate(order)}
+    bucket_of: Dict[int, int] = {}
+    for bi, members in enumerate(buckets):
+        for nid in members:
+            bucket_of[nid] = bi
+    # comm nodes not covered by any bucket ride as singletons
+    for nid in order:
+        if nid not in bucket_of:
+            buckets = buckets + [[nid]]
+            bucket_of[nid] = len(buckets) - 1
+
+    nodes: List[Node] = []
+    chains: Dict[int, List[int]] = {}
+    ready_pred: Dict[int, Dict[int, Optional[int]]] = {}
+    bucket_ids: Dict[int, int] = {}         # bucket index -> new node id
+
+    def bucket_node(bi: int) -> Node:
+        members = [dag.nodes[nid] for nid in buckets[bi]]
+        alpha = max(cm.alpha_us(m) for m in members)
+        beta = sum(cm.calibrated_beta_us(m) for m in members)
+        nbytes = sum(m.nbytes or 0 for m in members) or None
+        names = ",".join(m.tensor or m.label for m in members)
+        return Node(0, "comm", alpha + beta, tensor=f"<bucket{bi}>",
+                    op=members[0].op or "all-reduce", nbytes=nbytes,
+                    label=f"comm:<bucket{bi}:{names}>",
+                    ranks=tuple(sorted({r for m in members
+                                        for r in m.ranks})))
+
+    for rank, chain in dag.chains.items():
+        # the member that appears LAST in this rank's chain, per bucket
+        last_member: Dict[int, int] = {}
+        for nid in chain:
+            if nid in bucket_of:
+                last_member[bucket_of[nid]] = nid
+        new_chain: List[int] = []
+        last_compute: Optional[int] = None
+        for nid in chain:
+            node = dag.nodes[nid]
+            if node.kind == "compute":
+                new = dataclasses.replace(node, nid=len(nodes))
+                nodes.append(new)
+                new_chain.append(new.nid)
+                last_compute = new.nid
+                continue
+            bi = bucket_of[nid]
+            if last_member.get(bi) != nid:
+                continue                    # folded into a later position
+            if bi not in bucket_ids:
+                bn = bucket_node(bi)
+                bn.nid = len(nodes)
+                nodes.append(bn)
+                bucket_ids[bi] = bn.nid
+                ready_pred[bn.nid] = {}
+            bid = bucket_ids[bi]
+            ready_pred[bid][rank] = last_compute
+            new_chain.append(bid)
+        chains[rank] = new_chain
+
+    # wire order: buckets sorted by their last member's dispatch position
+    wire = sorted(bucket_ids,
+                  key=lambda bi: max(pos[nid] for nid in buckets[bi]))
+    chain_edges: Dict[int, List[int]] = {}
+    for prev_bi, next_bi in zip(wire, wire[1:]):
+        chain_edges[bucket_ids[next_bi]] = [bucket_ids[prev_bi]]
+    new_dag = StepDAG(
+        step=dag.step, t0_us=dag.t0_us, nodes=nodes, chains=chains,
+        ready_pred=ready_pred, rank_base_us=dict(dag.rank_base_us),
+        measured_span_us=dict(dag.measured_span_us), world=dag.world,
+    )
+    ordered_ids = [bucket_ids[bi] for bi in wire]
+    return new_dag, ordered_ids, chain_edges
+
+
+def _bucket_plan(dag: StepDAG, partition: List[List[int]],
+                 predicted_us: float) -> dict:
+    """Machine-readable plan payload for one bucketing — the contract
+    optim/profile_guided.py consumes (docs/autotune.md)."""
+    order = comm_channel_order(dag)
+    pos = {nid: i for i, nid in enumerate(order)}
+    wire = sorted(partition, key=lambda b: max(pos[n] for n in b))
+    return {
+        "num_buckets": len(wire),
+        "buckets": [[dag.nodes[n].tensor or dag.nodes[n].label
+                     for n in sorted(b, key=pos.get)] for b in wire],
+        "bucket_bytes": [sum(dag.nodes[n].nbytes or 0 for n in b) or None
+                         for b in wire],
+        "overlap": True,
+        "predicted_step_us": round(predicted_us, 3),
+    }
+
+
+def bucket_plan_search(dag: StepDAG, cm: CostModel,
+                       max_initial: int = 64,
+                       patience: int = 8) -> List[dict]:
+    """Agglomerative search over contiguous bucketings of the comm
+    sequence: start from singletons in dispatch order, repeatedly merge
+    the adjacent pair whose fusion most improves the two-thread replayed
+    makespan, and record the best partition seen at every bucket count.
+    Returns one row per bucket count (``num_buckets``,
+    ``predicted_step_us``, ``plan``), best-first.
+
+    The descent stops early once ``patience`` consecutive merge levels
+    fail to improve on the best makespan seen — past the optimum, every
+    further merge only serializes more payload behind one α, so the
+    abandoned tail of the table is diagnostics we already know lose
+    (bounds the O(n²) full-DAG replays on big traces; the fixture's
+    3-level table is far under the patience and stays complete)."""
+    order = comm_channel_order(dag)
+    if len(order) < 2:
+        return []
+    parts: List[List[int]] = [[nid] for nid in order]
+    # very long steps: pre-merge the cheapest adjacent pairs so the
+    # O(n^2) greedy stays bounded (the dropped granularity is logged in
+    # the plan's num_buckets, not silently hidden)
+    while len(parts) > max_initial:
+        betas = [sum(cm.calibrated_beta_us(dag.nodes[n]) for n in b)
+                 for b in parts]
+        i = min(range(len(parts) - 1),
+                key=lambda j: betas[j] + betas[j + 1])
+        parts[i:i + 2] = [parts[i] + parts[i + 1]]
+
+    def evaluate(partition: List[List[int]]) -> float:
+        bdag, _ids, chain = bucketed_dag(dag, cm, partition)
+        return schedule(bdag, overlap=True, extra_preds=chain).makespan
+
+    results: List[dict] = []
+
+    def record(partition: List[List[int]], makespan: float) -> None:
+        results.append(_bucket_plan(dag, partition, makespan))
+
+    best_seen = evaluate(parts)
+    record(parts, best_seen)
+    cur, stale = parts, 0
+    while len(cur) > 1 and stale < patience:
+        best: Optional[tuple] = None
+        for i in range(len(cur) - 1):
+            cand = cur[:i] + [cur[i] + cur[i + 1]] + cur[i + 2:]
+            m = evaluate(cand)
+            if best is None or m < best[0]:
+                best = (m, cand)
+        cur = best[1]
+        record(cur, best[0])
+        if best[0] < best_seen:
+            best_seen, stale = best[0], 0
+        else:
+            stale += 1
+    results.sort(key=lambda r: (r["predicted_step_us"], r["num_buckets"]))
+    return results
+
+
 # ---------------------------------------------------------------------------
 # the what-if driver
 # ---------------------------------------------------------------------------
 def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
-            bandwidth_factors: tuple = (2.0, 4.0)) -> dict:
-    """Baseline replay + every scenario, ranked by predicted speedup."""
+            bandwidth_factors: tuple = (2.0, 4.0),
+            plan_search: bool = True) -> dict:
+    """Baseline replay + every scenario, ranked by predicted speedup.
+
+    ``plan_search=False`` skips the agglomerative bucket search (the
+    `fuse_buckets_<k>` scenario + `bucket_search` table) — it is the
+    expensive part on big traces (O(n²) full-DAG replays, patience-
+    bounded), and a consumer after a straggler report doesn't need a
+    fusion plan (`hvd_replay.py --no-plan-search`)."""
     cm = cm or CostModel(world=dag.world)
     base = schedule(dag)
     baseline_us = base.makespan
     scenarios: List[dict] = []
 
-    def add(name: str, sched_: Schedule, detail: str) -> None:
-        predicted = sched_.makespan
-        scenarios.append({
+    def add(name: str, sched_, detail: str, plan: Optional[dict] = None
+            ) -> None:
+        predicted = sched_.makespan if isinstance(sched_, Schedule) \
+            else float(sched_)
+        row = {
             "scenario": name,
             "predicted_step_us": round(predicted, 3),
             "speedup_pct": round(
                 (baseline_us - predicted) / baseline_us * 100.0, 2)
             if baseline_us > 0 else 0.0,
             "detail": detail,
-        })
+        }
+        if plan is not None:
+            row["plan"] = plan
+        scenarios.append(row)
 
     straggler = identify_straggler(dag, base)
     if straggler is not None:
@@ -233,6 +435,15 @@ def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
         add("fuse_all_comm", schedule(fdag),
             "all collectives re-batched into one bucket: one α, "
             "summed β, launch gated by the last gradient")
+    search = bucket_plan_search(dag, cm) if plan_search else []
+    if search:
+        best = search[0]
+        add(f"fuse_buckets_{best['num_buckets']}",
+            best["predicted_step_us"],
+            f"{best['num_buckets']} explicit fusion buckets dispatched "
+            "on a serialized comm channel overlapping compute — the "
+            "implementable plan the profile-guided tuner applies",
+            plan=best)
     scenarios.sort(key=lambda s: s["predicted_step_us"])
     return {
         "baseline_replay_us": round(baseline_us, 3),
@@ -243,6 +454,7 @@ def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
             "hop_latency_us": cm.hop_latency_us,
         },
         "scenarios": scenarios,
+        "bucket_search": search,
     }
 
 

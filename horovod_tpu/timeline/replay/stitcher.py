@@ -402,10 +402,18 @@ def build_step_dag(art: Artifacts, step_no: int,
     )
 
 
-def stitch(trace_dir: str) -> Tuple[Artifacts, List[StepDAG]]:
+def stitch(trace_dir: str,
+           last_steps: Optional[int] = None
+           ) -> Tuple[Artifacts, List[StepDAG]]:
     """Artifacts + one StepDAG per step observed on EVERY rank (a step
     captured on a subset of ranks — a truncated trace — can't be
-    globally replayed and is dropped)."""
+    globally replayed and is dropped).
+
+    ``last_steps`` builds DAGs for only the N newest common steps — the
+    in-job tuner's window-cadence path, where constructing the whole
+    accumulated history each window would grow with the job.  (The
+    per-rank event files are still parsed in full; the DAG builds are
+    the dominant cost.)"""
     art = load_artifacts(trace_dir)
     per_rank_windows: Dict[int, Dict[int, Tuple[float, float]]] = {}
     for rank in art.ranks:
@@ -416,8 +424,11 @@ def stitch(trace_dir: str) -> Tuple[Artifacts, List[StepDAG]]:
     common = None
     for rank, wins in per_rank_windows.items():
         common = set(wins) if common is None else common & set(wins)
+    wanted = sorted(common or ())
+    if last_steps is not None and last_steps > 0:
+        wanted = wanted[-last_steps:]
     dags = []
-    for step_no in sorted(common or ()):
+    for step_no in wanted:
         windows = {r: per_rank_windows[r][step_no] for r in art.ranks}
         dags.append(build_step_dag(art, step_no, windows))
     return art, dags

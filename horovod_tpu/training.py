@@ -64,6 +64,7 @@ def make_train_step(
     hierarchical: bool = False,
     autotune: Optional[bool] = None,
     autotune_log_file: Optional[str] = None,
+    profile_guided: Optional[bool] = None,
     in_graph_steps: int = 1,
 ):
     """Returns ``step(state, batch, labels) -> (state, loss)`` compiled SPMD
@@ -81,6 +82,15 @@ def make_train_step(
       reference's "new parameters take effect next cycle"
       (parameter_manager.cc Update/Tune).  The returned function exposes
       the manager as ``step.parameter_manager``.
+    * ``profile_guided`` (default: the HVD_AUTOTUNE_PROFILE_GUIDED env)
+      closes the replay→autotune loop (docs/autotune.md): every
+      ``HVD_AUTOTUNE_WINDOW_STEPS`` steps the job's own trace window is
+      stitched + replayed, the winning what-if becomes an explicit
+      fusion-bucket plan applied through the same re-jit seam, and the
+      next window verifies realized against predicted speedup (rollback
+      past the guard band).  Exposed as ``step.profile_guided_tuner``.
+      The GP prior is warm-started from the α–β cost model
+      (HVD_AUTOTUNE_WARM_START=0 disables).
     * ``in_graph_steps > 1`` compiles a ``lax.scan`` of that many
       optimizer steps over the SAME batch into one program, so host
       dispatch is amortized away (the synthetic-benchmark mode: the
@@ -91,7 +101,7 @@ def make_train_step(
     from .ops import collectives
     from .parallel.hierarchical import hierarchical_allreduce
 
-    def _build(threshold_b, hier):
+    def _build(threshold_b, hier, named_buckets=None):
         def per_rank_step(state: TrainState, x, y):
             def compute_loss(params):
                 variables = {"params": params, **state.model_state}
@@ -115,6 +125,7 @@ def make_train_step(
                 grads = allreduce_pytree(
                     grads, op=op, compression=compression,
                     threshold_bytes=threshold_b,
+                    named_buckets=named_buckets,
                 )
             loss = collectives.allreduce(loss, op=Average)
 
@@ -150,13 +161,21 @@ def make_train_step(
     pm = None
     box = {}
 
-    def _rebuild(threshold_b, hier):
+    def _rebuild(threshold_b, hier, plan=None):
         """(Re)compile the SPMD step and remember the knobs + the core
         mesh epoch it was built against, so a later elastic membership
         change (core.reinit bumps the epoch and swaps the mesh) can
-        rebuild with the same knobs."""
+        rebuild with the same knobs.  ``plan`` is a profile-guided
+        FusionPlanSpec: its explicit bucket vector overrides the scalar
+        threshold (optim/profile_guided.py)."""
+        named = plan.buckets if plan is not None else None
+        # An explicit bucket plan owns the comm layout: the hierarchical
+        # path reduces per leaf and would silently drop named_buckets
+        # while the tuner reports the plan applied.  box keeps the
+        # original hier so rollback (plan=None) restores it.
         box.update(
-            fn=_build(threshold_b, hier), threshold=threshold_b, hier=hier,
+            fn=_build(threshold_b, hier and plan is None, named),
+            threshold=threshold_b, hier=hier, plan=plan,
             core_epoch=core._require_init().epoch,
         )
 
@@ -172,7 +191,8 @@ def make_train_step(
             enabled=True, log_file=autotune_log_file, initial=initial,
         )
         pm.on_update = lambda p: _rebuild(p.fusion_threshold_bytes,
-                                          p.hierarchical_allreduce)
+                                          p.hierarchical_allreduce,
+                                          p.fusion_plan)
         _rebuild(initial.fusion_threshold_bytes,
                  initial.hierarchical_allreduce)
     else:
@@ -204,15 +224,16 @@ def make_train_step(
         except (AttributeError, IndexError, TypeError):
             pass  # batch without a leading dim: samples stay uncounted
 
-    def _invoke(state, x, y):
+    def _invoke(state, x, y, _under_trace=None):
         # Host-side step record: advances the trace window (reference
         # BYTEPS_TRACE_START/END_STEP semantics) and emits a STEP dispatch
         # span.  On the compiled path collective timing lives inside XLA;
         # this records the per-step cadence the tracer windows key on.
         # Skipped while under a jax trace (e.g. Recorder.record_step_function
         # running make_jaxpr) so abstract evaluation doesn't consume window
-        # steps or emit phantom spans.
-        under_trace = any(
+        # steps or emit phantom spans.  The autotuned wrapper passes its
+        # already-computed verdict so big pytrees are scanned once.
+        under_trace = _under_trace if _under_trace is not None else any(
             isinstance(leaf, jax.core.Tracer)
             for leaf in jax.tree_util.tree_leaves((state, x, y))
         )
@@ -227,7 +248,7 @@ def make_train_step(
             # new (core.reinit) and the compiled step — shard_map captured
             # the old mesh at build — must re-trace over it.
             if box["core_epoch"] != core._require_init().epoch:
-                _rebuild(box["threshold"], box["hier"])
+                _rebuild(box["threshold"], box["hier"], box.get("plan"))
         if not under_trace and metrics.on():
             _record_step_metrics(x)
         if timeline.active and not under_trace:
@@ -237,12 +258,79 @@ def make_train_step(
                 return box["fn"](state, x, y)
         return box["fn"](state, x, y)
 
-    if pm is None:
+    # Profile-guided loop (optim/profile_guided.py): analyze the job's
+    # own trace window, apply the winning bucket plan through the same
+    # rebuild seam, verify realized-vs-predicted next window.
+    if profile_guided is None:
+        profile_guided = env_util.get_bool(
+            env_util.HVD_AUTOTUNE_PROFILE_GUIDED)
+    tuner = None
+    if profile_guided:
+        from .optim.profile_guided import tuner_from_env
+
+        trace_dir = env_util.get_str(env_util.HVD_TIMELINE) or \
+            env_util.get_str(env_util.HVD_TRACE_DIR)
+
+        def _analyze():
+            if not trace_dir:
+                return None
+            from .timeline.replay import analyze
+
+            # latest step only: SPMD steps share one DAG shape, and a
+            # per-window caller must not replay the whole accumulated
+            # trace history (it grows with the job)
+            return analyze(trace_dir, last_steps=1).summary
+
+        def _apply_plan(plan):
+            if pm is not None:
+                if plan is not None:
+                    pm.apply_plan(plan)
+                else:
+                    pm.clear_plan()
+            else:
+                _rebuild(box["threshold"], box["hier"], plan)
+
+        tuner = tuner_from_env(_analyze, _apply_plan)
+        if not trace_dir:
+            from .utils.logging import get_logger
+
+            get_logger(__name__).warning(
+                "profile-guided tuning enabled without HVD_TIMELINE/"
+                "HVD_TRACE_DIR: no trace window to analyze, the tuner "
+                "will idle in its baseline phase")
+
+    if pm is None and tuner is None:
         return _invoke
 
+    warm_start = env_util.get_bool(env_util.HVD_AUTOTUNE_WARM_START, True)
+    pg_last = [0.0]
+
     def step_autotuned(state, x, y):
-        if pm.frozen:
-            return _invoke(state, x, y)
+        under_trace = any(
+            isinstance(leaf, jax.core.Tracer)
+            for leaf in jax.tree_util.tree_leaves((state, x, y))
+        )
+        if tuner is not None and tuner.active and not under_trace:
+            # dispatch-to-dispatch interval: real step time in steady
+            # state with zero added synchronization (same honesty
+            # argument as hvd_step_seconds)
+            now = _time.perf_counter()
+            if pg_last[0]:
+                tuner.on_step(now - pg_last[0])
+            pg_last[0] = now
+        if pm is None or pm.frozen:
+            state, loss = _invoke(state, x, y, _under_trace=under_trace)
+            if tuner is not None and tuner.measuring and not under_trace:
+                # honest timing while the PG loop measures: the GP path
+                # below blocks on the result every step, so without this
+                # the baseline window (GP active) would measure serialized
+                # step time but the verify window (apply_plan froze the
+                # GP) pipelined dispatch time — a "speedup" any plan
+                # would pass.  Gated on the MEASURING phases: a steady
+                # (plan-pinned) window only counts steps and must keep
+                # the async dispatch pipeline the plan bought.
+                jax.device_get(loss)
+            return state, loss
         if "grad_bytes" not in box:
             import math
 
@@ -252,8 +340,19 @@ def make_train_step(
                 math.prod(l.shape) * l.dtype.itemsize
                 for l in jax.tree_util.tree_leaves(state.params)
             )) * max(in_graph_steps, 1)
+        if warm_start and not under_trace and not box.get("warm_started"):
+            # seed the GP with the α–β model's predicted scores so
+            # exploration starts near the simulator's optimum.  Gated on
+            # its own flag, not the grad_bytes cache: the first call is
+            # often a jax trace (Recorder.record_step_function), which
+            # fills grad_bytes from tracer leaves but must not burn the
+            # only warm-start opportunity.
+            box["warm_started"] = True
+            from .optim.profile_guided import warm_start_manager
+
+            warm_start_manager(pm, box["grad_bytes"])
         t0 = _time.perf_counter()
-        state, loss = _invoke(state, x, y)
+        state, loss = _invoke(state, x, y, _under_trace=under_trace)
         # honest timing while tuning: force the step chain to complete
         # (block_until_ready can return early on tunneled platforms)
         jax.device_get(loss)
@@ -276,6 +375,7 @@ def make_train_step(
         return state, loss
 
     step_autotuned.parameter_manager = pm
+    step_autotuned.profile_guided_tuner = tuner
     return step_autotuned
 
 

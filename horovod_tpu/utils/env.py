@@ -31,6 +31,16 @@ HVD_AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
 HVD_AUTOTUNE_STEPS_PER_SAMPLE = "HVD_AUTOTUNE_STEPS_PER_SAMPLE"
 HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HVD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+# profile-guided tuning loop (optim/profile_guided.py, docs/autotune.md):
+# replay what-ifs planned into explicit fusion buckets, applied live and
+# verified predicted-vs-realized with automatic rollback
+HVD_AUTOTUNE_PROFILE_GUIDED = "HVD_AUTOTUNE_PROFILE_GUIDED"  # 1 enables the loop
+HVD_AUTOTUNE_WINDOW_STEPS = "HVD_AUTOTUNE_WINDOW_STEPS"      # steps per measure/verify window (default 20)
+HVD_AUTOTUNE_GUARD_BAND_PCT = "HVD_AUTOTUNE_GUARD_BAND_PCT"  # realized-vs-predicted tolerance (default 10)
+HVD_AUTOTUNE_ROLLBACK = "HVD_AUTOTUNE_ROLLBACK"              # 0 keeps regressed plans (debug; default 1)
+HVD_AUTOTUNE_WARM_START = "HVD_AUTOTUNE_WARM_START"          # 0 skips the α–β GP prior (default 1)
+HVD_AUTOTUNE_CYCLE_FLUSH_STEPS = "HVD_AUTOTUNE_CYCLE_FLUSH_STEPS"  # re-plan a verified plan every N steps (0 = pin forever)
+HVD_BENCH_AUTOTUNE = "HVD_BENCH_AUTOTUNE"                    # 0 skips bench.py's autotuned second run
 HVD_LOG_LEVEL = "HVD_LOG_LEVEL"
 HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
 HVD_HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
@@ -120,6 +130,9 @@ DEFAULT_HTTP_BACKOFF_MS = 50.0                     # run/http_client.py backoff 
 DEFAULT_RESTART_BACKOFF_SECONDS = 1.0              # run/run.py restart backoff base
 DEFAULT_ELASTIC_TIMEOUT_SECONDS = 60.0             # elastic epoch wait/rebuild budget
 DEFAULT_ELASTIC_MAX_FLAPS = 3                      # elastic/driver.py blocklist threshold
+DEFAULT_AUTOTUNE_WINDOW_STEPS = 20                 # profile-guided measure/verify window
+DEFAULT_AUTOTUNE_GUARD_BAND_PCT = 10.0             # rollback when realized lags predicted by more
+DEFAULT_AUTOTUNE_CYCLE_FLUSH_STEPS = 0             # verified plans pinned forever unless set
 
 
 def get_int(name: str, default: int) -> int:

@@ -1,0 +1,226 @@
+"""Profile-guided autotune, offline: trace dir in → recommended plan out.
+
+The same pipeline the in-job loop runs (optim/profile_guided.py): stitch
+``<trace_dir>/<rank>/comm.json`` into per-step global DAGs, replay the
+bucket-plan search (timeline/replay/simulator.py), and print the winning
+explicit fusion-bucket plan — which tensors fuse together, in which
+dispatch order, and what step time the simulator predicts.  Apply it in
+a job via ``make_train_step(..., profile_guided=True)`` or feed the
+bucket list to ``allreduce_pytree(named_buckets=...)``.
+
+Run::
+
+    python scripts/hvd_autotune.py <trace_dir> \
+        [--step N] [--json] [--out plan.json] \
+        [--hop-us F] [--ici-gbps F] \
+        [--push host:port [--secret HEX]]    # serve via GET /autotune
+    python scripts/hvd_autotune.py --check   # fixture self-test (tier-1)
+
+``--check`` replays the hand-computed autotune fixture
+(timeline/replay/fixture.py AUTOTUNE_EXPECTED): the loop must recover
+the known-optimal 2-bucket plan at the exact predicted step time, the
+verify phase must land realized within the guard band of predicted, and
+an injected regression must trigger rollback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.optim.profile_guided import (  # noqa: E402
+    FusionPlanSpec, ProfileGuidedTuner, plan_from_summary,
+)
+from horovod_tpu.timeline.replay import analyze  # noqa: E402
+from horovod_tpu.timeline.replay.simulator import CostModel  # noqa: E402
+
+
+def run_check() -> int:
+    """Closed-loop self-test on the hand-computed autotune fixture."""
+    from horovod_tpu.timeline.replay.fixture import (
+        AUTOTUNE_EXPECTED, write_autotune_fixture_trace,
+    )
+
+    errors = []
+    with tempfile.TemporaryDirectory(prefix="hvd_autotune_check_") as d:
+        exp = write_autotune_fixture_trace(d)
+        cm = CostModel(world=2, hop_latency_us=exp["hop_latency_us"])
+        summary = analyze(d, cost_model=cm).summary
+        plan = plan_from_summary(summary)
+
+        # 1. plan recovery: exact buckets, exact predicted step time
+        if plan is None:
+            print("hvd_autotune --check FAILED: no plan recovered",
+                  file=sys.stderr)
+            return 1
+        if plan.buckets != exp["optimal_buckets"]:
+            errors.append(f"buckets {plan.buckets} != "
+                          f"{exp['optimal_buckets']}")
+        if abs(plan.predicted_step_us - exp["predicted_step_us"]) > 1e-3:
+            errors.append(f"predicted {plan.predicted_step_us} != "
+                          f"{exp['predicted_step_us']}")
+        if abs(plan.baseline_step_us - exp["baseline_us"]) > 1e-3:
+            errors.append(f"baseline {plan.baseline_step_us} != "
+                          f"{exp['baseline_us']}")
+        search = summary["steps"][0]["what_if"].get("bucket_search", [])
+        got_k = {r["num_buckets"]: r["predicted_step_us"] for r in search}
+        for k, us in exp["bucket_search_us"].items():
+            if abs(got_k.get(int(k), -1.0) - us) > 1e-3:
+                errors.append(f"bucket_search[{k}] {got_k.get(int(k))} "
+                              f"!= {us}")
+
+        # 2. closed loop, verified: the simulated job realizes the
+        # predicted step time — realized speedup must land inside the
+        # guard band and the plan must stay applied
+        applied: list = []
+        tuner = ProfileGuidedTuner(
+            analyze_fn=lambda: summary,
+            apply_fn=applied.append,
+            window_steps=4, guard_band_pct=10.0, rollback=True)
+        for _ in range(4):                      # baseline window: 440 µs
+            tuner.on_step(exp["baseline_us"] * 1e-6)
+        if not applied or not isinstance(applied[-1], FusionPlanSpec):
+            errors.append("loop did not apply a plan after the baseline "
+                          "window")
+        else:
+            for _ in range(4):                  # verify window: 300 µs
+                tuner.on_step(exp["predicted_step_us"] * 1e-6)
+            last = tuner.history[-1]
+            if last.get("outcome") != "verified":
+                errors.append(f"verify outcome {last.get('outcome')!r}, "
+                              "want 'verified'")
+            realized = last.get("realized_speedup_pct", 0.0)
+            predicted = exp["predicted_speedup_pct"]
+            if abs(realized - predicted) > 10.0:
+                errors.append(f"realized {realized}% not within guard "
+                              f"band of predicted {predicted}%")
+
+        # 3. closed loop, regression: a job that does NOT realize the
+        # prediction must roll the plan back
+        applied2: list = []
+        tuner2 = ProfileGuidedTuner(
+            analyze_fn=lambda: summary,
+            apply_fn=applied2.append,
+            window_steps=4, guard_band_pct=10.0, rollback=True)
+        for _ in range(4):
+            tuner2.on_step(exp["baseline_us"] * 1e-6)
+        for _ in range(4):                      # regressed: still 440 µs
+            tuner2.on_step(exp["baseline_us"] * 1e-6)
+        if not (tuner2.history
+                and tuner2.history[-1].get("outcome") == "rolled_back"
+                and applied2 and applied2[-1] is None):
+            errors.append("injected regression did not roll the plan back")
+
+    if errors:
+        print("hvd_autotune --check FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"hvd_autotune --check OK: recovered "
+          f"{exp['optimal_num_buckets']}-bucket plan "
+          f"{exp['optimal_buckets']} at {exp['predicted_step_us']:.0f} us "
+          f"(hand-computed), verified in-band, rollback exercised")
+    return 0
+
+
+def _print_text(plan: FusionPlanSpec, summary: dict) -> None:
+    print(f"analyzed {summary['trace_dir']}  ranks={summary['ranks']}")
+    print(f"baseline replay: {plan.baseline_step_us:.1f} us")
+    print(f"recommended plan (from step {plan.source_step}): "
+          f"{plan.num_buckets} buckets, predicted "
+          f"{plan.predicted_step_us:.1f} us "
+          f"({plan.predicted_speedup_pct:+.1f}%)")
+    for i, bucket in enumerate(plan.buckets):
+        print(f"  bucket {i}: {', '.join(bucket)}")
+    print(f"overlap: {plan.overlap}  "
+          f"cycle_flush_steps: {plan.cycle_flush_steps}")
+    print("\napply live: make_train_step(..., profile_guided=True) "
+          "with HVD_AUTOTUNE_PROFILE_GUIDED=1")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="profile-guided fusion/overlap plan from a merged "
+                    "trace dir")
+    p.add_argument("trace_dir", nargs="?",
+                   help="timeline dir (HVD_TIMELINE target)")
+    p.add_argument("--step", type=int, default=None,
+                   help="plan only from this step number")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable plan on stdout")
+    p.add_argument("--out", default=None,
+                   help="also write the plan JSON here")
+    p.add_argument("--hop-us", type=float, default=None,
+                   help="cost-model hop latency, µs (default "
+                        "HVD_REPLAY_HOP_US or 1)")
+    p.add_argument("--ici-gbps", type=float, default=None,
+                   help="cost-model link bandwidth, GB/s (default "
+                        "HVD_REPLAY_ICI_GBPS or 186)")
+    p.add_argument("--push", default=None, metavar="HOST:PORT",
+                   help="publish the plan to the rendezvous server so "
+                        "GET /autotune serves it")
+    p.add_argument("--secret", default=None,
+                   help="hex HMAC secret for --push")
+    p.add_argument("--check", action="store_true",
+                   help="self-test on the built-in hand-computed fixture")
+    args = p.parse_args(argv)
+
+    if args.check:
+        sys.exit(run_check())
+    if not args.trace_dir:
+        p.error("trace_dir is required (or use --check)")
+    push_host = push_port = None
+    if args.push:
+        push_host, _, port_s = args.push.partition(":")
+        if not push_host or not port_s.isdigit():
+            p.error(f"--push wants HOST:PORT, got {args.push!r}")
+        push_port = int(port_s)
+
+    cm = None
+    if args.hop_us is not None or args.ici_gbps is not None:
+        from horovod_tpu.timeline.replay import _cost_model_from_env
+        from horovod_tpu.timeline.merge import discover_ranks
+
+        cm = _cost_model_from_env(len(discover_ranks(args.trace_dir)))
+        if args.hop_us is not None:
+            cm.hop_latency_us = args.hop_us
+        if args.ici_gbps is not None:
+            cm.ici_bytes_per_sec = args.ici_gbps * 1e9
+    summary = analyze(args.trace_dir, step=args.step, cost_model=cm).summary
+    plan = plan_from_summary(summary)
+    if plan is None:
+        print("no applicable fusion plan: fewer than two collectives per "
+              "step (nothing to bucket)", file=sys.stderr)
+        return None
+
+    record = dict(plan.to_dict(), outcome="recommended",
+                  trace_dir=summary["trace_dir"])
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+    if args.push:
+        from horovod_tpu.run.http_client import put_autotune_plan
+
+        secret = bytes.fromhex(args.secret) if args.secret else None
+        # epoch-ms seq: repeated offline pushes accumulate in the
+        # GET /autotune table instead of overwriting one slot, and never
+        # collide with the in-job tuner's small history-length seqs
+        put_autotune_plan(push_host, push_port, int(time.time() * 1000),
+                          record, secret=secret)
+        print(f"pushed plan -> GET http://{args.push}/autotune",
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(record, indent=2))
+    else:
+        _print_text(plan, summary)
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -124,6 +124,10 @@ def main(argv=None):
                         "equivalent)")
     p.add_argument("--check", action="store_true",
                    help="self-test on the built-in hand-computed fixture")
+    p.add_argument("--no-plan-search", action="store_true",
+                   help="skip the fusion bucket search (the expensive "
+                        "what-if on big traces) — straggler/attribution "
+                        "reports only")
     args = p.parse_args(argv)
 
     if args.check:
@@ -137,7 +141,8 @@ def main(argv=None):
             p.error(f"--push wants HOST:PORT, got {args.push!r}")
         push_port = int(port_s)
 
-    result = analyze(args.trace_dir, step=args.step)
+    result = analyze(args.trace_dir, step=args.step,
+                     plan_search=not args.no_plan_search)
     summary = result.summary
     if args.out:
         with open(args.out, "w") as f:

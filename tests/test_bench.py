@@ -59,7 +59,7 @@ def test_probe_classifies_cpu_backend(monkeypatch):
 
 def test_successful_run_passes_result_through(monkeypatch, capsys):
     """When the child run emits a RESULT line, main() prints exactly its
-    JSON payload and nothing else."""
+    JSON payload (the autotune tail disabled here; covered below)."""
     bench = _load_bench()
     payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
                "value": 2700.0, "unit": "images/sec/chip",
@@ -71,11 +71,75 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
         stderr = ""
 
     monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
     out = capsys.readouterr().out.strip()
     assert json.loads(out) == payload
+
+
+def test_autotune_delta_merged_into_tail(monkeypatch, capsys):
+    """The autotuned comparison leg's number lands in the JSON tail as
+    autotuned_img_sec_per_chip + autotune_delta_pct (BENCH_r06 captures
+    whether the loop moved the MFU number)."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        def __init__(self, line):
+            self.returncode = 0
+            self.stdout = "RESULT " + line + "\n"
+            self.stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        if "--child-autotune" in cmd:
+            return FakeProc(json.dumps({"img_sec_per_chip": 2808.0}))
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_AUTOTUNE", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["autotuned_img_sec_per_chip"] == 2808.0
+    assert out["autotune_delta_pct"] == 4.0
+    assert any("--child-autotune" in c for c in calls)
+
+
+def test_autotune_leg_failure_cannot_cost_the_main_number(monkeypatch,
+                                                          capsys):
+    """A hung autotuned leg degrades to autotune_delta_pct: None — the
+    default number still publishes."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        returncode = 0
+        stdout = "RESULT " + json.dumps(payload) + "\n"
+        stderr = ""
+
+    def fake_run(cmd, *a, **k):
+        if "--child-autotune" in cmd:
+            raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+        return FakeProc()
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_AUTOTUNE", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["autotune_delta_pct"] is None
+    assert "timeout" in out["autotune_error"]
 
 
 def test_run_timeout_retries_then_skips(monkeypatch, capsys):
