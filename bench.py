@@ -47,6 +47,7 @@ V5E_PEAK_FLOPS = 197e12                     # bf16 per chip
 PROBE_TIMEOUT_S = 90       # jax.devices() normally returns in seconds
 RUN_TIMEOUT_S = 560        # compile (~40 s) + 3 measured iters, generous
 AUTOTUNE_TIMEOUT_S = 420   # autotuned comparison run (re-jits a few times)
+COMPRESSION_TIMEOUT_S = 420  # compressed comparison run (one compile)
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -110,6 +111,63 @@ def _measure_autotuned() -> None:
     result = run(args)
     print("RESULT " + json.dumps(
         {"img_sec_per_chip": round(result["img_sec_per_chip"], 2)}))
+
+
+def _measure_compressed() -> None:
+    """Child-process entry for the compressed comparison leg: the same
+    synthetic benchmark with error-feedback int8 gradient compression
+    (docs/compression.md) — the wire-efficiency tier's headline delta.
+    Single-chip, so the delta isolates the quantize/dequantize overhead
+    (the wire saving needs a multi-chip run to show up); a shorter run,
+    same contract as the autotune leg: the point is the delta, not a
+    second absolute number."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        raise RuntimeError("refusing to benchmark compression on CPU")
+    from examples.synthetic_benchmark import parse_args, run
+
+    args = parse_args([
+        "--batch-size", "128",
+        "--num-in-graph-steps", "100",
+        "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "1",
+        "--num-iters", "2",
+        "--compression", "int8",
+    ])
+    result = run(args)
+    print("RESULT " + json.dumps(
+        {"img_sec_per_chip": round(result["img_sec_per_chip"], 2)}))
+
+
+def _compression_delta(default_per_chip: float) -> dict:
+    """The compressed-vs-default tail fields, from a separately-timed
+    child so a hung or failed compression leg can never cost the main
+    number (HVD_BENCH_COMPRESSION=0 skips).  Returns the fields to
+    merge into the RESULT payload — ``compression_delta_pct`` is null
+    on any failure, same contract as the autotune leg."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_COMPRESSION, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled or default_per_chip <= 0:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-compression",
+                                     COMPRESSION_TIMEOUT_S)
+        if payload is not None:
+            at = float(payload["img_sec_per_chip"])
+            return {
+                "compressed_img_sec_per_chip": round(at, 2),
+                "compression_delta_pct": round(
+                    (at - default_per_chip) / default_per_chip * 100.0, 2),
+            }
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"compression_delta_pct": None, "compression_error": reason}
 
 
 def _run_child(flag: str, timeout_s: float):
@@ -200,6 +258,9 @@ def main() -> None:
             # autotuned-vs-default tail (HVD_BENCH_AUTOTUNE=0 skips):
             # did the profile-guided/Bayesian loop move the MFU number?
             out.update(_autotune_delta(float(out.get("value", 0.0))))
+            # compressed-vs-default tail (HVD_BENCH_COMPRESSION=0 skips):
+            # what does error-feedback int8 cost/buy on this chip?
+            out.update(_compression_delta(float(out.get("value", 0.0))))
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -221,6 +282,8 @@ def main() -> None:
 if __name__ == "__main__":
     if "--child-autotune" in sys.argv:
         _measure_autotuned()
+    elif "--child-compression" in sys.argv:
+        _measure_compressed()
     elif "--child" in sys.argv:
         _measure()
     else:

@@ -37,6 +37,12 @@ def parse_args(argv=None):
     )
     parser.add_argument("--fp16-allreduce", action="store_true", default=False,
                         help="use bf16 compression during allreduce")
+    parser.add_argument("--compression", type=str, default=None,
+                        choices=["none", "bf16", "fp16", "int8", "fp8",
+                                 "fp8_e5m2"],
+                        help="gradient wire format (quantized formats "
+                             "carry the error-feedback residual; "
+                             "default: the HVD_COMPRESSION env knob)")
     parser.add_argument("--model", type=str, default="ResNet50",
                         help="model to benchmark")
     parser.add_argument("--batch-size", type=int, default=32,
@@ -98,13 +104,25 @@ def run(args) -> dict:
             logits, labels
         ).mean()
 
+    if args.compression:
+        from horovod_tpu.ops.compression import Compression as _C
+        from horovod_tpu.utils import env as _env
+
+        compression = _C.lookup(
+            args.compression,
+            error_feedback=_env.get_bool(
+                _env.HVD_COMPRESSION_ERROR_FEEDBACK, True))
+    elif args.fp16_allreduce:
+        compression = hvd.Compression.fp16
+    else:
+        compression = None   # make_train_step resolves HVD_COMPRESSION
+
     step = make_train_step(
         apply_fn=model.apply,
         loss_fn=loss_fn,
         optimizer=opt,
         op=hvd.Adasum if args.adasum else hvd.Average,
-        compression=hvd.Compression.fp16 if args.fp16_allreduce
-        else hvd.Compression.none,
+        compression=compression,
         has_batch_stats=True,
         hierarchical=args.hierarchical,
         autotune=args.autotune or None,
@@ -112,9 +130,14 @@ def run(args) -> dict:
         in_graph_steps=args.num_in_graph_steps,
     )
 
+    from horovod_tpu.ops.compression import ErrorFeedback as _EF
+    from horovod_tpu.ops.compression import from_env as _comp_from_env
+
+    eff = compression if compression is not None else _comp_from_env()
     state = init_train_state(
         model, opt, jnp.zeros((2, args.image_size, args.image_size, 3)),
         has_batch_stats=True,
+        compression=eff if isinstance(eff, _EF) else None,
     )
     x = shard_batch(data)
     y = shard_batch(target)

@@ -83,6 +83,8 @@ from .ops import (  # noqa: F401
     allreduce_gradients,
     Compression,
 )
+from .ops.compression import ErrorFeedback  # noqa: F401
+from .parallel.hierarchical import two_level_allreduce  # noqa: F401
 from .ops.collectives import ProcessSet  # noqa: F401
 from .ops.sparse import (  # noqa: F401
     IndexedSlices,

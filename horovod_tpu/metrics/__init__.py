@@ -194,6 +194,21 @@ AUTOTUNE_ROLLBACKS = registry.counter(
     "Applied plans rolled back because realized speedup lagged the "
     "prediction past the guard band.")
 
+COMPRESSION_RESIDUAL_NORM = registry.gauge(
+    "hvd_compression_residual_norm",
+    "Global L2 norm of the error-feedback residual pytree, sampled every "
+    "HVD_COMPRESSION_GUARD_STEPS steps (ops/compression.py; a healthy EF "
+    "loop keeps this bounded by the per-step quantization error).")
+COMPRESSION_FALLBACKS = registry.counter(
+    "hvd_compression_fallbacks_total",
+    "Automatic fall-backs to uncompressed allreduce after the error-"
+    "feedback residual diverged (training.py convergence guard).")
+TWO_LEVEL_FALLBACKS = registry.counter(
+    "hvd_two_level_fallbacks_total",
+    "two_level_allreduce degradations to flat allreduce (non-power-of-two "
+    "cross-host group or trivial topology); counted per compiled program, "
+    "not per step.")
+
 
 def on() -> bool:
     """The hot-path gate: one attribute read."""

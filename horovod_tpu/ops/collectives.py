@@ -132,6 +132,7 @@ def allreduce(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     hierarchical: bool = False,
+    two_level: bool = False,
 ):
     """Allreduce a per-rank tensor across all ranks.
 
@@ -140,7 +141,10 @@ def allreduce(
     Min / Max; ``compression`` casts before the wire and back after
     (reference horovod/torch/compression.py).  ``hierarchical`` selects the
     two-level local/cross decomposition (the reference's
-    HOROVOD_HIERARCHICAL_ALLREDUCE knob, common.h:72).
+    HOROVOD_HIERARCHICAL_ALLREDUCE knob, common.h:72).  ``two_level``
+    selects the compressed two-level path instead — reduce-scatter on
+    ICI, ``compression`` applied to the cross-stage payload only
+    (parallel/hierarchical.py two_level_allreduce, HVD_TWO_LEVEL_ALLREDUCE).
     """
     axes = _axes()
     groups, group_size = _group_args(process_set)
@@ -148,12 +152,28 @@ def allreduce(
     # collective inventory a scrape can compare against the step cadence.
     _metrics.record_traced("allreduce", tensor)
 
+    if two_level and op in (Average, Sum, Adasum) and len(axes) == 1:
+        if process_set is not None:
+            raise ValueError(
+                "two-level allreduce over a process subset is unsupported"
+            )
+        from ..parallel.hierarchical import two_level_allreduce
+
+        t = tensor * prescale_factor if prescale_factor != 1.0 else tensor
+        out = two_level_allreduce(t, op=op, compression=compression)
+        return out * postscale_factor if postscale_factor != 1.0 else out
+
     if op == Adasum:
         from .adasum import adasum_allreduce
 
-        compressed, ctx = compression.compress(tensor)
+        # prescale BEFORE the wire cast: scaling a quantized int8/fp8
+        # payload would silently promote its dtype (and re-bias the
+        # quantization grid)
         if prescale_factor != 1.0:
-            compressed = compressed * prescale_factor
+            tensor = tensor * prescale_factor
+        compressed, ctx = compression.compress_for(tensor, group_size) \
+            if hasattr(compression, "compress_for") \
+            else compression.compress(tensor)
         out = adasum_allreduce(
             compressed, process_set=process_set, hierarchical=hierarchical
         )
@@ -164,9 +184,11 @@ def allreduce(
     if hierarchical and op in (Min, Max):
         raise ValueError("hierarchical allreduce supports Sum/Average/Adasum")
 
-    compressed, ctx = compression.compress(tensor)
     if prescale_factor != 1.0:
-        compressed = compressed * prescale_factor
+        tensor = tensor * prescale_factor     # before the wire cast, ditto
+    compressed, ctx = compression.compress_for(tensor, group_size) \
+        if hasattr(compression, "compress_for") \
+        else compression.compress(tensor)
 
     if hierarchical and op in (Average, Sum) and len(axes) == 1:
         if process_set is not None:

@@ -1,20 +1,92 @@
-"""Gradient compression algorithms.
+"""Gradient compression: the wire-efficiency tier's ops layer.
 
-Mirror of horovod/torch/compression.py and horovod/tensorflow/compression.py
-(reference, 75 LoC each): a ``Compressor`` with ``compress``/``decompress``
-and the ``Compression`` namespace with ``none`` and ``fp16``.  On TPU the
-natural wire dtype is bfloat16 (hardware-native on the MXU, same exponent
-range as fp32 so no loss scaling needed) — ``fp16`` is kept as an alias for
-API parity and maps to bf16.
+Grew out of the reference's stateless cast pair
+(horovod/torch/compression.py / horovod/tensorflow/compression.py:
+``Compressor`` with ``compress``/``decompress`` and the ``Compression``
+namespace) into a registry of wire formats plus an error-feedback
+wrapper (docs/compression.md):
+
+* :class:`NoneCompressor` / :class:`BF16Compressor` — kept, API
+  compatible (``fp16`` stays an alias: bf16 is the TPU-native half
+  type, same exponent range as fp32 so no loss scaling).
+* :class:`Int8Compressor` / :class:`FP8Compressor` (e4m3) /
+  :class:`FP8E5M2Compressor` — per-tensor-scaled quantizers.  The
+  scale is the *global* max-|x| (a scalar ``pmax`` when called inside
+  an SPMD region, the local max otherwise), so every rank dequantizes
+  with the same factor; the quantized range is divided by the reducing
+  group size so the integer/fp8 *sum* across ranks cannot wrap or
+  saturate (XLA reduces in the wire dtype — an un-headroomed int8 psum
+  over 8 ranks wraps, measured).  The precision lost to headroom is
+  exactly what :class:`ErrorFeedback` carries forward.
+* :class:`ErrorFeedback` — wraps any compressor with the
+  residual-carrying scheme of deep-gradient-compression / 1-bit Adam
+  (PAPERS.md lineage): each step reduces ``grad + residual`` and keeps
+  ``residual' = (grad + residual) - dequantize(quantize(...))`` — the
+  quantization error is fed back instead of dropped, so the *sum over
+  steps* of what reached the optimizer tracks the true gradient sum.
+  The residual is an explicit pytree threaded through
+  ``allreduce_pytree``/``fused_allreduce`` (ops/fusion.py),
+  ``DistributedOptimizer`` state (optim/distributed.py) and
+  ``TrainState.residual`` (training.py) — surviving jit, checkpointing
+  (utils/checkpoint.py saves the state pytree) and elastic rebuilds.
+* :class:`ErrorFeedbackGuard` — the convergence guard: trips when the
+  residual norm diverges (or goes non-finite), at which point the
+  train step falls back to uncompressed allreduce
+  (``hvd_compression_fallbacks_total``) instead of silently training
+  on a broken wire format.
+
+Every compressor passes integer/bool/complex leaves through untouched
+(``_compressible``): gradients routed via ``allreduce_pytree`` can
+carry non-float leaves (step counters, masks) and a cast would
+silently corrupt them.
+
+``numpy_quantize``/``numpy_dequantize`` are the ground-truth mirrors
+used by tests, in the style of ``ops/adasum.py``'s ``numpy_adasum``.
 """
 
 from __future__ import annotations
 
+from typing import Dict, List, Optional, Type
+
+import numpy as np
+
 import jax.numpy as jnp
+
+
+def _compressible(tensor) -> bool:
+    """Only real floating leaves are compressed; integer/bool/complex
+    leaves pass through untouched (casting them would corrupt data,
+    not round it)."""
+    return jnp.issubdtype(jnp.result_type(tensor), jnp.floating)
+
+
+def _global_max_abs(tensor):
+    """max |x| across every rank reducing this tensor — inside an SPMD
+    region a scalar pmax (every rank must dequantize with the SAME
+    factor for the reduced sum to mean anything), the local max
+    otherwise (single-rank/eager use)."""
+    from jax import lax
+
+    from .. import core
+
+    m = jnp.max(jnp.abs(tensor.astype(jnp.float32)))
+    axes = core._spmd_axes()
+    if axes is not None:
+        m = lax.pmax(m, axes if len(axes) > 1 else axes[0])
+    return m
 
 
 class Compressor:
     """Interface for compressing and decompressing a given tensor."""
+
+    #: registry name (Compression.lookup vocabulary)
+    name = "none"
+    #: wire bytes per element (None = unchanged) — the cost model's
+    #: comm_report.COMPRESSION_MODEL must agree with these
+    wire_itemsize: Optional[int] = None
+    #: True when compress needs a cross-rank scale exchange (the α the
+    #: cost model bills per compressed collective)
+    scale_exchange = False
 
     @staticmethod
     def compress(tensor):
@@ -26,9 +98,19 @@ class Compressor:
     def decompress(tensor, ctx):
         raise NotImplementedError
 
+    @classmethod
+    def compress_for(cls, tensor, group_size: int):
+        """Compress for a reduction over ``group_size`` ranks.  The
+        stateless cast compressors ignore the group size; quantizers
+        use it to reserve summation headroom."""
+        del group_size
+        return cls.compress(tensor)
+
 
 class NoneCompressor(Compressor):
     """No-op (reference compression.py NoneCompressor)."""
+
+    name = "none"
 
     @staticmethod
     def compress(tensor):
@@ -42,15 +124,20 @@ class NoneCompressor(Compressor):
 class BF16Compressor(Compressor):
     """Cast to bfloat16 for the collective, cast back after.
 
-    The reference's FP16Compressor halves wire bytes on NCCL rings; here it
-    halves ICI bytes, and since bf16 is MXU-native the reduce itself also
-    runs at full throughput.
+    The reference's FP16Compressor halves wire bytes on NCCL rings; here
+    it halves ICI bytes, and since bf16 is MXU-native the reduce itself
+    also runs at full throughput.
     """
+
+    name = "bf16"
+    wire_itemsize = 2
 
     @staticmethod
     def compress(tensor):
+        if not _compressible(tensor):
+            return tensor, None        # int/bool/complex: untouched
         ctx = tensor.dtype
-        if jnp.issubdtype(tensor.dtype, jnp.floating) and tensor.dtype != jnp.bfloat16:
+        if tensor.dtype != jnp.bfloat16:
             return tensor.astype(jnp.bfloat16), ctx
         return tensor, ctx
 
@@ -61,10 +148,351 @@ class BF16Compressor(Compressor):
         return tensor
 
 
+class _ScaledQuantizer(Compressor):
+    """Shared scale/headroom arithmetic for the int8/fp8 wire formats.
+
+    ``q = round_or_cast(x / scale * (max_mag / group_size))`` with
+    ``scale = global max |x|``: every |q| ≤ max_mag / group_size, so the
+    sum over the reducing group stays within the wire dtype's range —
+    no wrap (int8) and no saturation (fp8).  ``ctx`` carries
+    ``(orig_dtype, dequant_factor)``; dequantization is linear, so it
+    commutes with the Average division the collective layer applies.
+    """
+
+    #: wire dtype's maximum representable magnitude
+    max_mag = 1.0
+    wire_dtype = jnp.int8
+
+    @classmethod
+    def _quantize(cls, x32, headroom):
+        raise NotImplementedError
+
+    @classmethod
+    def compress_for(cls, tensor, group_size: int):
+        if not _compressible(tensor):
+            return tensor, None
+        headroom = cls.max_mag / max(int(group_size), 1)
+        if headroom < 2.0:
+            # fewer than two quantization levels survive the summation
+            # headroom (int8 over >63 ranks, e4m3 over >224): every
+            # value would truncate toward zero and the "compressed"
+            # gradient is noise.  Ship uncompressed instead — the flat
+            # quantized path is for small worlds; big worlds compress
+            # the cross stage of two_level_allreduce, whose group is
+            # the (small) host count.
+            from ..utils.logging import get_logger
+
+            get_logger(__name__).warning(
+                "%s over a %d-rank group leaves %.2f quantization "
+                "levels — shipping uncompressed (use two-level "
+                "reduction to compress across hosts instead)",
+                cls.name, group_size, headroom)
+            return tensor, None
+        orig_dtype = tensor.dtype
+        scale = jnp.maximum(_global_max_abs(tensor),
+                            jnp.asarray(1e-30, jnp.float32))
+        q = cls._quantize(tensor.astype(jnp.float32) / scale, headroom)
+        return q, (orig_dtype, scale / headroom)
+
+    @classmethod
+    def compress(cls, tensor):
+        # eager / single-rank entry: no summation headroom needed
+        return cls.compress_for(tensor, 1)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        orig_dtype, factor = ctx
+        return (tensor.astype(jnp.float32) * factor).astype(orig_dtype)
+
+
+class Int8Compressor(_ScaledQuantizer):
+    """Per-tensor-scaled symmetric int8 (round-to-nearest, clipped)."""
+
+    name = "int8"
+    wire_itemsize = 1
+    scale_exchange = True
+    max_mag = 127.0
+    wire_dtype = jnp.int8
+
+    @classmethod
+    def _quantize(cls, x_unit, headroom):
+        # clip to the HEADROOM, not max_mag: round(±headroom) can land
+        # one grid step above it (127/8 = 15.875 rounds to 16, and
+        # 8 x 16 = 128 wraps int8) — the truncating int cast then keeps
+        # every |q| <= floor(headroom), so the group sum can never wrap
+        q = jnp.clip(jnp.round(x_unit * headroom), -headroom, headroom)
+        return q.astype(jnp.int8)
+
+
+class FP8Compressor(_ScaledQuantizer):
+    """Per-tensor-scaled float8 e4m3 (448 max, ~3 mantissa bits)."""
+
+    name = "fp8_e4m3"
+    wire_itemsize = 1
+    scale_exchange = True
+    max_mag = 448.0
+    wire_dtype = jnp.float8_e4m3fn
+
+    @classmethod
+    def _quantize(cls, x_unit, headroom):
+        return (x_unit * headroom).astype(cls.wire_dtype)
+
+
+class FP8E5M2Compressor(FP8Compressor):
+    """float8 e5m2: wider range (57344 max), ~2 mantissa bits — for
+    gradients whose dynamic range overwhelms e4m3."""
+
+    name = "fp8_e5m2"
+    max_mag = 57344.0
+    wire_dtype = jnp.float8_e5m2
+
+
+# ---------------------------------------------------------------------------
+# error feedback
+# ---------------------------------------------------------------------------
+class ErrorFeedback:
+    """Carry the quantization residual across steps (DGC / 1-bit-Adam
+    scheme).  Stateless compressor calls delegate to the wrapped
+    compressor; the residual arithmetic itself lives where the state
+    does — ``fused_allreduce(..., residuals=...)`` applies
+
+        x  = grad + residual
+        q  = compress(x);  reduce(q)
+        residual' = x - decompress_local(q)
+
+    so this wrapper's job is (a) marking the compression as stateful
+    and (b) building the initial residual pytree.  Wrapping
+    :class:`NoneCompressor` is a valid degenerate case (residual stays
+    0) — and switching a compressed job back to ``none`` flushes the
+    outstanding residual into the next reduction instead of dropping
+    it."""
+
+    stateful = True
+
+    def __init__(self, compressor: Optional[Type[Compressor]] = None):
+        self.compressor = compressor if compressor is not None \
+            else Int8Compressor
+
+    @property
+    def name(self) -> str:
+        return f"ef_{self.compressor.name}"
+
+    @property
+    def wire_itemsize(self):
+        return self.compressor.wire_itemsize
+
+    @property
+    def scale_exchange(self):
+        return self.compressor.scale_exchange
+
+    def compress(self, tensor):
+        return self.compressor.compress(tensor)
+
+    def compress_for(self, tensor, group_size: int):
+        return self.compressor.compress_for(tensor, group_size)
+
+    def decompress(self, tensor, ctx):
+        return self.compressor.decompress(tensor, ctx)
+
+    @staticmethod
+    def init_state(tree):
+        """Zero residual pytree shaped like the gradients (float leaves
+        carry state; non-float leaves get zeros that stay zeros)."""
+        import jax
+
+        return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+class ErrorFeedbackGuard:
+    """Convergence guard for error-feedback compression: the residual
+    norm of a healthy EF loop is bounded by the per-step quantization
+    error; a norm that grows past ``factor`` × its early baseline (or
+    goes non-finite) means the feedback loop is diverging and the job
+    must fall back to uncompressed allreduce (training.py increments
+    ``hvd_compression_fallbacks_total`` and rebuilds without
+    compression).  Pure host-side float logic so it is deterministic
+    across processes observing the same replicated residual."""
+
+    def __init__(self, factor: Optional[float] = None, warmup: int = 3):
+        from ..utils import env as env_util
+
+        self.factor = factor if factor is not None else env_util.get_float(
+            env_util.HVD_COMPRESSION_GUARD_FACTOR,
+            env_util.DEFAULT_COMPRESSION_GUARD_FACTOR)
+        self.warmup = max(int(warmup), 1)
+        self._early: List[float] = []
+        self.baseline: Optional[float] = None
+
+    def observe(self, norm: float) -> bool:
+        """Feed one residual-norm sample; True = diverged (fall back)."""
+        norm = float(norm)
+        if not np.isfinite(norm):
+            return True
+        if self.baseline is None:
+            self._early.append(norm)
+            if len(self._early) < self.warmup:
+                return False
+            self.baseline = float(np.median(self._early))
+            return False
+        return norm > self.factor * max(self.baseline, 1e-30)
+
+
+def _sq_norm(leaves):
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        x = leaf.astype(jnp.float32)
+        total = total + jnp.vdot(x, x)
+    return total
+
+
+_sq_norm_jit = None
+
+
+def residual_norm(residual) -> float:
+    """Global L2 norm of a residual pytree (float leaves only) — the
+    ``hvd_compression_residual_norm`` gauge's value.  One jitted
+    reduction + one device sync per call (jit caches by leaf structure,
+    so the guard cadence pays a single dispatch, not one per leaf)."""
+    import jax
+
+    global _sq_norm_jit
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(residual)
+              if _compressible(leaf)]
+    if not leaves:
+        return 0.0
+    if _sq_norm_jit is None:
+        _sq_norm_jit = jax.jit(_sq_norm)
+    return float(np.sqrt(max(float(_sq_norm_jit(leaves)), 0.0)))
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+_REGISTRY: Dict[str, Type[Compressor]] = {
+    "none": NoneCompressor,
+    "fp16": BF16Compressor,   # parity alias: bf16 is the TPU half type
+    "bf16": BF16Compressor,
+    "int8": Int8Compressor,
+    "fp8": FP8Compressor,
+    "fp8_e4m3": FP8Compressor,
+    "fp8_e5m2": FP8E5M2Compressor,
+}
+
+
 class Compression:
-    """Optional gradient compression algorithm used during allreduce
-    (reference compression.py Compression namespace)."""
+    """Gradient compression registry used during allreduce (grew out of
+    the reference compression.py Compression namespace).  Attribute
+    access for the built-ins, :meth:`lookup` for knob/plan strings
+    (``HVD_COMPRESSION``, ``tpurun --compression``, per-bucket plan
+    payloads), :meth:`register` for custom wire formats."""
 
     none = NoneCompressor
     fp16 = BF16Compressor  # parity alias: bf16 is the TPU-native half type
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor
+    fp8_e4m3 = FP8Compressor
+    fp8_e5m2 = FP8E5M2Compressor
+
+    @staticmethod
+    def names() -> List[str]:
+        return sorted(_REGISTRY)
+
+    @staticmethod
+    def lookup(name: Optional[str], error_feedback: bool = False):
+        """Resolve a compressor by registry name (None/'' → none).
+        ``error_feedback=True`` wraps the result in
+        :class:`ErrorFeedback` (a no-op for ``none``)."""
+        key = str(name).strip().lower() if name else "none"
+        if key.startswith("ef_"):
+            key = key[3:]
+            error_feedback = True
+        try:
+            comp = _REGISTRY[key]
+        except KeyError:
+            raise ValueError(
+                f"unknown compression {name!r}; registered: "
+                f"{', '.join(Compression.names())}") from None
+        if error_feedback and comp is not NoneCompressor:
+            return ErrorFeedback(comp)
+        return comp
+
+    @staticmethod
+    def register(name: str, compressor: Type[Compressor]) -> None:
+        _REGISTRY[str(name).strip().lower()] = compressor
+
+
+def from_env():
+    """The job-level compression choice: ``HVD_COMPRESSION`` (none |
+    bf16 | int8 | fp8 | fp8_e5m2), error-feedback-wrapped unless
+    ``HVD_COMPRESSION_ERROR_FEEDBACK=0`` (quantized wire formats
+    without EF bias the gradient; EF is the accuracy story)."""
+    from ..utils import env as env_util
+
+    name = env_util.get_str(env_util.HVD_COMPRESSION, "none")
+    ef = env_util.get_bool(env_util.HVD_COMPRESSION_ERROR_FEEDBACK, True)
+    return Compression.lookup(name, error_feedback=ef)
+
+
+# ---------------------------------------------------------------------------
+# numpy ground truth (tests; ops/adasum.py numpy_adasum style)
+# ---------------------------------------------------------------------------
+def _numpy_wire(name: str):
+    import ml_dtypes
+
+    return {"int8": (np.int8, 127.0),
+            "fp8_e4m3": (ml_dtypes.float8_e4m3fn, 448.0),
+            "fp8": (ml_dtypes.float8_e4m3fn, 448.0),
+            "fp8_e5m2": (ml_dtypes.float8_e5m2, 57344.0)}[name]
+
+
+def numpy_quantize(x: np.ndarray, group_size: int = 1,
+                   wire: str = "int8"):
+    """Reference quantizer: returns ``(q, dequant_factor)`` with the
+    same scale/headroom rule the device compressors use."""
+    dtype, max_mag = _numpy_wire(wire)
+    scale = max(float(np.max(np.abs(x))), 1e-30)
+    headroom = max_mag / max(int(group_size), 1)
+    if wire == "int8":
+        # clip to headroom, truncating int cast — mirrors the device
+        # quantizer's no-wrap guarantee
+        q = np.clip(np.round(x.astype(np.float64) / scale * headroom),
+                    -headroom, headroom).astype(dtype)
+    else:
+        # f32 arithmetic throughout, like the device path (f64
+        # intermediate would double-round the f8 cast differently)
+        q = (x.astype(np.float32) / np.float32(scale)
+             * np.float32(headroom)).astype(dtype)
+    return q, scale / headroom
+
+
+def numpy_dequantize(q: np.ndarray, factor: float) -> np.ndarray:
+    return q.astype(np.float64) * factor
+
+
+def numpy_error_feedback_reduce(per_rank_grads, residuals,
+                                wire: str = "int8"):
+    """One error-feedback compressed allreduce step over a list of
+    per-rank gradients: returns ``(mean_gradient, new_residuals)`` —
+    the oracle the device parity tests pin against."""
+    n = len(per_rank_grads)
+    qs, factors, new_res = [], [], []
+    # shared scale: the global max over every rank's (grad + residual)
+    xs = [np.asarray(g, np.float64) + np.asarray(r, np.float64)
+          for g, r in zip(per_rank_grads, residuals)]
+    scale = max(max(float(np.max(np.abs(x))) for x in xs), 1e-30)
+    dtype, max_mag = _numpy_wire(wire)
+    headroom = max_mag / n
+    for x in xs:
+        if wire == "int8":
+            q = np.clip(np.round(x / scale * headroom),
+                        -headroom, headroom).astype(dtype)
+        else:
+            q = (x.astype(np.float32) / np.float32(scale)
+                 * np.float32(headroom)).astype(dtype)
+        qs.append(q)
+        new_res.append(x - numpy_dequantize(q, scale / headroom))
+    total = np.sum([q.astype(np.float64) for q in qs], axis=0)
+    return numpy_dequantize(total, scale / headroom) / n, new_res

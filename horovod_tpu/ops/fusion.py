@@ -56,14 +56,20 @@ class FusionPlan:
 
     def __init__(self, leaves: Sequence[Any],
                  threshold_bytes: Optional[int] = None,
-                 explicit_buckets: Optional[Sequence[Sequence[int]]] = None):
+                 explicit_buckets: Optional[Sequence[Sequence[int]]] = None,
+                 bucket_compression: Optional[Sequence[Optional[str]]] = None):
         if threshold_bytes is None:
             threshold_bytes = env_util.fusion_threshold_bytes()
         self.threshold_bytes = max(int(threshold_bytes), 1)
         self.explicit = explicit_buckets is not None
         self.buckets: List[List[int]] = []
+        #: per final bucket: compression registry name or None (global
+        #: compression applies) — the planner's per-bucket wire-format
+        #: knob (optim/profile_guided.py FusionPlanSpec.compression)
+        self.bucket_compression: List[Optional[str]] = []
         if explicit_buckets is not None:
-            self._build_explicit(leaves, explicit_buckets)
+            self._build_explicit(leaves, explicit_buckets,
+                                 bucket_compression)
         else:
             self._build_threshold(leaves)
 
@@ -80,12 +86,17 @@ class FusionPlan:
             else:
                 self.buckets.append([i])
                 current[dt] = (len(self.buckets) - 1, nbytes)
+        self.bucket_compression = [None] * len(self.buckets)
 
     def _build_explicit(self, leaves: Sequence[Any],
-                        explicit: Sequence[Sequence[int]]) -> None:
+                        explicit: Sequence[Sequence[int]],
+                        compression: Optional[Sequence[Optional[str]]] = None
+                        ) -> None:
         n = len(leaves)
         seen: set = set()
-        for bucket in explicit:
+        for bi, bucket in enumerate(explicit):
+            comp = compression[bi] if compression is not None \
+                and bi < len(compression) else None
             by_dtype: dict = {}  # dtype -> list of indices, order kept
             for i in bucket:
                 i = int(i)
@@ -99,14 +110,25 @@ class FusionPlan:
                 seen.add(i)
                 by_dtype.setdefault(jnp.result_type(leaves[i]),
                                     []).append(i)
-            self.buckets.extend(b for b in by_dtype.values() if b)
-        # unclaimed leaves: singletons, appended in leaf order
-        self.buckets.extend([i] for i in range(n) if i not in seen)
+            for b in by_dtype.values():
+                if b:
+                    # dtype-split halves inherit the source bucket's
+                    # compression choice
+                    self.buckets.append(b)
+                    self.bucket_compression.append(comp)
+        # unclaimed leaves: singletons, appended in leaf order, no
+        # plan-level compression (the global compressor still applies)
+        for i in range(n):
+            if i not in seen:
+                self.buckets.append([i])
+                self.bucket_compression.append(None)
 
     @classmethod
     def from_named_buckets(cls, leaves: Sequence[Any],
                            names: Sequence[str],
-                           named_buckets: Sequence[Sequence[str]]
+                           named_buckets: Sequence[Sequence[str]],
+                           bucket_compression:
+                           Optional[Sequence[Optional[str]]] = None
                            ) -> "FusionPlan":
         """Explicit plan from tensor NAMES (the vocabulary of the replay
         plan payload) matched against this call's leaf names: exact
@@ -127,7 +149,8 @@ class FusionPlan:
 
         used: set = set()
         explicit: List[List[int]] = []
-        for bucket in named_buckets:
+        comps: List[Optional[str]] = []
+        for bi, bucket in enumerate(named_buckets):
             idxs = []
             for name in bucket:
                 i = match(str(name))
@@ -136,7 +159,11 @@ class FusionPlan:
                     idxs.append(i)
             if idxs:
                 explicit.append(idxs)
-        return cls(leaves, explicit_buckets=explicit)
+                comps.append(bucket_compression[bi]
+                             if bucket_compression is not None
+                             and bi < len(bucket_compression) else None)
+        return cls(leaves, explicit_buckets=explicit,
+                   bucket_compression=comps)
 
     def num_buckets(self) -> int:
         return len(self.buckets)
@@ -166,6 +193,16 @@ def _reduce_flat(flat, *, op, axes, groups, group_size):
     return out
 
 
+def _compress_with(comp, tensor, group_size: int):
+    """One compressor call, via ``compress_for`` when the compressor has
+    it (quantizers need the reducing-group headroom) with a fallback to
+    the legacy two-method interface for user subclasses."""
+    fn = getattr(comp, "compress_for", None)
+    if fn is not None:
+        return fn(tensor, group_size)
+    return comp.compress(tensor)
+
+
 def fused_allreduce(
     tensors: List[Any],
     *,
@@ -174,6 +211,7 @@ def fused_allreduce(
     process_set=None,
     threshold_bytes: Optional[int] = None,
     plan: Optional[FusionPlan] = None,
+    residuals: Optional[List[Any]] = None,
 ):
     """Allreduce a list of tensors with static bucketing; returns the list in
     the original order (reference semantics: grouped allreduce results are
@@ -181,7 +219,17 @@ def fused_allreduce(
     overrides the threshold bucketing with an explicit
     :class:`FusionPlan` (profile-guided tuning); buckets dispatch in plan
     order, which is the overlap schedule under XLA's latency-hiding
-    scheduler."""
+    scheduler.  A plan may carry per-bucket ``bucket_compression``
+    (registry names) overriding the global ``compression`` for its
+    members — the planner's wire-format knob.
+
+    ``residuals`` (a list aligned with ``tensors``) switches on error
+    feedback: each float tensor reduces ``t + r`` and the call returns
+    ``(outputs, new_residuals)`` with ``r' = (t + r) - dequantized local
+    contribution`` (docs/compression.md) — the residual list is the
+    explicit state the caller must thread to the next step."""
+    from .compression import _compressible
+
     axes = core._spmd_axes()
     if axes is None:
         raise RuntimeError("fused_allreduce must run inside an SPMD region")
@@ -189,11 +237,40 @@ def fused_allreduce(
         groups, group_size = None, core.size()
     else:
         groups, group_size = process_set.groups(), process_set.size()
+    if residuals is not None and len(residuals) != len(tensors):
+        raise ValueError(
+            f"error-feedback residual list has {len(residuals)} entries "
+            f"for {len(tensors)} tensors")
+
+    # per-tensor compressor: the plan's per-bucket choice where given,
+    # the global compression elsewhere.  Resolution happens BEFORE the
+    # compress pass so each tensor is quantized exactly once, with its
+    # own scale, in its bucket's wire format.
+    comps = [compression] * len(tensors)
+    if plan is not None and plan.bucket_compression:
+        for bi, bucket in enumerate(plan.buckets):
+            name = plan.bucket_compression[bi] \
+                if bi < len(plan.bucket_compression) else None
+            if name:
+                comp = Compression.lookup(name)
+                for i in bucket:
+                    comps[i] = comp
 
     compressed = []
     ctxs = []
-    for t in tensors:
-        c, ctx = compression.compress(t)
+    new_res: Optional[List[Any]] = list(residuals) \
+        if residuals is not None else None
+    for i, t in enumerate(tensors):
+        x = t
+        ef = residuals is not None and _compressible(t)
+        if ef:
+            x = t + residuals[i].astype(t.dtype)
+        c, ctx = _compress_with(comps[i], x, group_size)
+        if ef:
+            # this rank's dequantized contribution to the sum; what the
+            # wire dropped is carried to the next step
+            new_res[i] = (x - comps[i].decompress(c, ctx)).astype(
+                residuals[i].dtype)
         compressed.append(c)
         ctxs.append(ctx)
 
@@ -212,7 +289,7 @@ def fused_allreduce(
             i = bucket[0]
             red = _reduce_flat(compressed[i], op=op, axes=axes, groups=groups,
                                group_size=group_size)
-            out[i] = compression.decompress(red, ctxs[i])
+            out[i] = comps[i].decompress(red, ctxs[i])
             continue
         flats = [compressed[i].reshape(-1) for i in bucket]
         fused = jnp.concatenate(flats)
@@ -224,8 +301,10 @@ def fused_allreduce(
             piece = lax.dynamic_slice_in_dim(red, offset, n).reshape(
                 compressed[i].shape
             )
-            out[i] = compression.decompress(piece, ctxs[i])
+            out[i] = comps[i].decompress(piece, ctxs[i])
             offset += n
+    if new_res is not None:
+        return out, new_res
     return out
 
 
@@ -238,6 +317,8 @@ def allreduce_pytree(
     threshold_bytes: Optional[int] = None,
     sparse_as_dense: bool = False,
     named_buckets: Optional[Sequence[Sequence[str]]] = None,
+    bucket_compression: Optional[Sequence[Optional[str]]] = None,
+    residual=None,
 ):
     """Fused allreduce over every array leaf of a pytree (gradients).
 
@@ -248,7 +329,17 @@ def allreduce_pytree(
     ``named_buckets`` applies an explicit profile-guided fusion plan
     (lists of tensor names in dispatch order, the replay plan payload's
     vocabulary) matched against the tree's slash-joined leaf paths —
-    see :meth:`FusionPlan.from_named_buckets` for the matching rules."""
+    see :meth:`FusionPlan.from_named_buckets` for the matching rules.
+    ``bucket_compression`` (registry names aligned with
+    ``named_buckets``) selects a wire format per bucket — the
+    profile-guided compression decision (docs/compression.md).
+
+    ``residual`` (a pytree shaped like ``tree``) switches on error
+    feedback: the call reduces ``tree + residual`` and returns
+    ``(reduced, new_residual)``; the caller owns the residual state
+    (``TrainState.residual``, ``DistributedOptimizer`` state).  Sparse
+    leaves keep their residual untouched (the allgather path is
+    exact)."""
     from .sparse import (
         allreduce_indexed_slices, is_indexed_slices, to_dense,
     )
@@ -256,18 +347,33 @@ def allreduce_pytree(
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=is_indexed_slices
     )
+    res_leaves = None
+    if residual is not None:
+        res_leaves = jax.tree_util.tree_flatten(
+            residual, is_leaf=is_indexed_slices)[0]
+        if len(res_leaves) != len(leaves):
+            raise ValueError(
+                "error-feedback residual pytree does not match the "
+                f"gradient pytree ({len(res_leaves)} vs {len(leaves)} "
+                "leaves) — initialize it with ErrorFeedback.init_state")
     names = tree_leaf_names(tree, is_leaf=is_indexed_slices) \
         if named_buckets else [""] * len(leaves)
     dense_idx = []
     dense_leaves = []
     dense_names = []
+    dense_res = [] if res_leaves is not None else None
     out: list = [None] * len(leaves)
+    res_out: list = list(res_leaves) if res_leaves is not None else []
     for i, leaf in enumerate(leaves):
         if is_indexed_slices(leaf):
             if sparse_as_dense:
                 dense_idx.append(i)
                 dense_leaves.append(to_dense(leaf))
                 dense_names.append(names[i])
+                if dense_res is not None:
+                    # sparse residuals are dense zero trees; EF on the
+                    # densified form is well defined
+                    dense_res.append(res_leaves[i])
             else:
                 out[i] = allreduce_indexed_slices(
                     leaf, op=op, process_set=process_set
@@ -276,13 +382,23 @@ def allreduce_pytree(
             dense_idx.append(i)
             dense_leaves.append(leaf)
             dense_names.append(names[i])
+            if dense_res is not None:
+                dense_res.append(res_leaves[i])
     plan = FusionPlan.from_named_buckets(
-        dense_leaves, dense_names, named_buckets) if named_buckets else None
+        dense_leaves, dense_names, named_buckets,
+        bucket_compression=bucket_compression) if named_buckets else None
     reduced = fused_allreduce(
         dense_leaves, op=op, compression=compression,
         process_set=process_set, threshold_bytes=threshold_bytes,
-        plan=plan,
+        plan=plan, residuals=dense_res,
     )
+    if dense_res is not None:
+        reduced, new_dense_res = reduced
+        for i, r in zip(dense_idx, new_dense_res):
+            res_out[i] = r
     for i, r in zip(dense_idx, reduced):
         out[i] = r
-    return jax.tree_util.tree_unflatten(treedef, out)
+    result = jax.tree_util.tree_unflatten(treedef, out)
+    if residual is not None:
+        return result, jax.tree_util.tree_unflatten(treedef, res_out)
+    return result

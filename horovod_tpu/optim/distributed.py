@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from .. import core
 from ..core import Average, Sum, Adasum
 from ..ops import collectives
-from ..ops.compression import Compression
+from ..ops.compression import Compression, ErrorFeedback
 from ..ops.fusion import allreduce_pytree
 
 
@@ -39,6 +39,17 @@ class _AccumulationState(NamedTuple):
     inner: Any
     counter: jnp.ndarray          # steps since last sync
     accum: Any                    # gradient accumulation pytree
+
+
+class _ErrorFeedbackState(NamedTuple):
+    """Optimizer-state carrier for the error-feedback residual
+    (docs/compression.md): living inside the optax state pytree, the
+    residual survives jit, rides ``utils/checkpoint.py`` saves/restores
+    with the rest of the train state, and is rebuilt consistently on
+    elastic epochs (the state is broadcast with everything else)."""
+
+    inner: Any
+    residual: Any                 # quantization-error carry pytree
 
 
 def DistributedOptimizer(
@@ -60,16 +71,31 @@ def DistributedOptimizer(
     counters (horovod/torch/__init__.py:141-157) expressed as optax state;
     off-sync steps return zero updates (parameters hold still), matching
     the semantics of skipping ``optimizer.step()`` while accumulating.
+
+    An :class:`~horovod_tpu.ops.compression.ErrorFeedback` ``compression``
+    makes the wrapper stateful: the quantization residual lives in the
+    optax state (:class:`_ErrorFeedbackState`), initialized to zeros by
+    ``init`` and updated by every synchronizing allreduce — so it is
+    checkpointed, broadcast, and elastic-rebuilt with the rest of the
+    optimizer state (docs/compression.md).
     """
     import optax
 
     n = int(backward_passes_per_step)
     if n < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    ef = isinstance(compression, ErrorFeedback)
+    if ef and op == Adasum:
+        raise ValueError(
+            "error-feedback compression composes with Sum/Average "
+            "allreduce, not Adasum (the scale-invariant merge is not "
+            "linear in the residual)")
 
     from ..ops.sparse import densify_tree
 
-    def reduce_grads(grads):
+    def reduce_grads(grads, residual=None):
+        """Returns ``(reduced, new_residual)`` — residual is None
+        throughout when error feedback is off."""
         if op == Adasum:
             # Adasum has no sparse form (reference: sparse tensors are not
             # routed to Adasum either) — densify first.
@@ -78,30 +104,47 @@ def DistributedOptimizer(
             reduced = [
                 collectives.allreduce(g, op=Adasum) for g in leaves
             ]
-            return jax.tree_util.tree_unflatten(treedef, reduced)
+            return jax.tree_util.tree_unflatten(treedef, reduced), None
         reduced = allreduce_pytree(
             grads, op=op, compression=compression,
             process_set=process_set, threshold_bytes=threshold_bytes,
-            sparse_as_dense=sparse_as_dense,
+            sparse_as_dense=sparse_as_dense, residual=residual,
         )
+        new_residual = None
+        if residual is not None:
+            reduced, new_residual = reduced
         # optax update rules consume dense arrays; the communication was
         # sparse, the application is a scatter-add (TF applies IndexedSlices
         # natively — optax has no sparse update, so densify post-reduce).
-        return densify_tree(reduced)
+        return densify_tree(reduced), new_residual
 
     if n == 1:
         def init_fn(params):
-            return optimizer.init(params)
+            inner = optimizer.init(params)
+            if ef:
+                return _ErrorFeedbackState(
+                    inner=inner, residual=ErrorFeedback.init_state(params))
+            return inner
 
         def update_fn(grads, state, params=None, **extra):
-            grads = reduce_grads(grads)
-            return optimizer.update(grads, state, params, **extra)
+            if ef:
+                grads = densify_tree(grads)  # residuals are dense trees
+                reduced, residual = reduce_grads(grads, state.residual)
+                updates, inner = optimizer.update(
+                    reduced, state.inner, params, **extra)
+                return updates, _ErrorFeedbackState(inner, residual)
+            reduced, _ = reduce_grads(grads)
+            return optimizer.update(reduced, state, params, **extra)
 
         return optax.GradientTransformation(init_fn, update_fn)
 
     def init_fn(params):
+        inner = optimizer.init(params)
+        if ef:
+            inner = _ErrorFeedbackState(
+                inner=inner, residual=ErrorFeedback.init_state(params))
         return _AccumulationState(
-            inner=optimizer.init(params),
+            inner=inner,
             counter=jnp.zeros((), jnp.int32),
             accum=jax.tree_util.tree_map(jnp.zeros_like, params),
         )
@@ -116,8 +159,15 @@ def DistributedOptimizer(
 
         def do_sync(_):
             mean = jax.tree_util.tree_map(lambda a: a / n, accum)
-            reduced = reduce_grads(mean)
-            updates, inner = optimizer.update(reduced, state.inner, params, **extra)
+            if ef:
+                reduced, residual = reduce_grads(mean, state.inner.residual)
+                updates, inner = optimizer.update(
+                    reduced, state.inner.inner, params, **extra)
+                inner = _ErrorFeedbackState(inner, residual)
+            else:
+                reduced, _ = reduce_grads(mean)
+                updates, inner = optimizer.update(
+                    reduced, state.inner, params, **extra)
             zeros = jax.tree_util.tree_map(jnp.zeros_like, accum)
             return updates, _AccumulationState(inner, jnp.zeros((), jnp.int32), zeros)
 

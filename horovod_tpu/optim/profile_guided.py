@@ -59,14 +59,22 @@ class FusionPlanSpec:
     ``buckets`` is the vector-of-buckets knob: tensor names grouped into
     explicit fusion buckets, listed in dispatch order — bucket 0 goes on
     the wire first, which is the overlap schedule (early gradients
-    transfer while later compute still runs).  ``cycle_flush_steps`` is
-    the flush cadence: how many optimizer steps a *verified* plan stays
-    pinned before the tuner re-measures and re-plans from a fresh trace
-    window (the compiled-world analog of the reference's cycle time;
-    0 pins the plan for the rest of the job)."""
+    transfer while later compute still runs).  ``compression`` is the
+    per-bucket wire-format knob (ops/compression.py registry names
+    aligned with ``buckets``; None entries ride uncompressed) — the
+    simulator's staged choice search fills it, and training.py applies
+    it through ``allreduce_pytree(bucket_compression=...)`` with error
+    feedback, so compression decisions verify and roll back through the
+    SAME guard-band machinery as fusion decisions.
+    ``cycle_flush_steps`` is the flush cadence: how many optimizer
+    steps a *verified* plan stays pinned before the tuner re-measures
+    and re-plans from a fresh trace window (the compiled-world analog
+    of the reference's cycle time; 0 pins the plan for the rest of the
+    job)."""
 
     buckets: List[List[str]]
     overlap: bool = True
+    compression: Optional[List[Optional[str]]] = None
     cycle_flush_steps: int = 0
     predicted_step_us: float = 0.0
     baseline_step_us: float = 0.0
@@ -106,9 +114,12 @@ def plan_from_what_if(wi: dict, *, step: Optional[int] = None,
     base = baseline_us if baseline_us is not None \
         else float(wi.get("baseline_replay_us", 0.0))
     plan = best["plan"]
+    comp = plan.get("compression")
     return FusionPlanSpec(
         buckets=[list(b) for b in plan["buckets"]],
         overlap=bool(plan.get("overlap", True)),
+        compression=[c if c else None for c in comp]
+        if comp is not None else None,
         predicted_step_us=float(best["predicted_step_us"]),
         baseline_step_us=base,
         predicted_speedup_pct=float(best.get("speedup_pct", 0.0)),
@@ -352,7 +363,8 @@ class ProfileGuidedTuner:
                      plan.predicted_speedup_pct)
             return
         if self.plan is not None and plan.buckets == self.plan.buckets \
-                and plan.overlap == self.plan.overlap:
+                and plan.overlap == self.plan.overlap \
+                and plan.compression == self.plan.compression:
             # cycle-flush re-plan landed on the plan already running:
             # keep it without a re-jit.  Crucially this must NOT enter
             # verify — the new baseline was measured WITH the plan

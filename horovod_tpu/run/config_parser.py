@@ -21,6 +21,9 @@ _CONFIG_SCHEMA = {
         "hierarchical_allreduce": "hierarchical_allreduce",
         "hierarchical_allgather": "hierarchical_allgather",
         "ring_min_bytes": "ring_min_bytes",
+        "compression": "compression",
+        "no_error_feedback": "no_error_feedback",
+        "two_level_allreduce": "two_level_allreduce",
     },
     "autotune": {
         "enabled": "autotune",
@@ -85,6 +88,12 @@ def env_from_args(args) -> Dict[str, str]:
          getattr(args, "hierarchical_allreduce", False))
     setb(env_util.HVD_HIERARCHICAL_ALLGATHER,
          getattr(args, "hierarchical_allgather", False))
+    if getattr(args, "compression", None):
+        env[env_util.HVD_COMPRESSION] = str(args.compression)
+    if getattr(args, "no_error_feedback", False):
+        env[env_util.HVD_COMPRESSION_ERROR_FEEDBACK] = "0"
+    setb(env_util.HVD_TWO_LEVEL_ALLREDUCE,
+         getattr(args, "two_level_allreduce", False))
 
     setb(env_util.HVD_AUTOTUNE, getattr(args, "autotune", False))
     if getattr(args, "autotune", False):

@@ -123,6 +123,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                               dest="hierarchical_allreduce")
     group_params.add_argument("--hierarchical-allgather", action="store_true",
                               dest="hierarchical_allgather")
+    group_params.add_argument("--compression", dest="compression",
+                              choices=["none", "bf16", "fp16", "int8",
+                                       "fp8", "fp8_e5m2"],
+                              help="gradient wire format (error-feedback "
+                                   "residual carried for the quantized "
+                                   "formats; docs/compression.md)")
+    group_params.add_argument("--no-error-feedback", action="store_true",
+                              dest="no_error_feedback",
+                              help="drop the error-feedback residual "
+                                   "carry (debug; quantized formats "
+                                   "then bias the gradient)")
+    group_params.add_argument("--two-level-allreduce", action="store_true",
+                              dest="two_level_allreduce",
+                              help="ICI reduce-scatter + compressed DCN "
+                                   "all-reduce + ICI all-gather gradient "
+                                   "path (docs/compression.md)")
     group_params.add_argument("--ring-min-bytes", type=int,
                               dest="ring_min_bytes",
                               help="host-plane payloads at or above this "

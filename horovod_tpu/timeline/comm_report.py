@@ -152,6 +152,68 @@ def _ring_hops(op: str, n: int) -> int:
     return 1                        # permute / all-to-all: one exchange
 
 
+#: cost curves of the wire formats in ops/compression.py — the itemsize
+#: MUST agree with the compressors' ``wire_itemsize``.  ``qd_us_per_mib``
+#: models the quantize+dequantize kernel pair per MiB of *uncompressed*
+#: payload (bf16 is a pure cast; int8 adds round+clip on the VPU; fp8
+#: adds the float-format conversion); ``scale_exchange`` adds one scalar
+#: all-reduce's α per call (the per-tensor max-|x| agreement quantizers
+#: need — pure latency, the payload is one float).
+COMPRESSION_MODEL = {
+    "bf16": {"itemsize": 2, "qd_us_per_mib": 0.5, "scale_exchange": False},
+    "fp16": {"itemsize": 2, "qd_us_per_mib": 0.5, "scale_exchange": False},
+    "int8": {"itemsize": 1, "qd_us_per_mib": 1.0, "scale_exchange": True},
+    "fp8": {"itemsize": 1, "qd_us_per_mib": 1.5, "scale_exchange": True},
+    "fp8_e4m3": {"itemsize": 1, "qd_us_per_mib": 1.5,
+                 "scale_exchange": True},
+    "fp8_e5m2": {"itemsize": 1, "qd_us_per_mib": 1.5,
+                 "scale_exchange": True},
+}
+
+#: modeled cross-host (DCN) link for the two-level shape — an order
+#: cheaper than ICI in bandwidth and an order worse in latency; override
+#: per job via HVD_REPLAY_DCN_GBPS / HVD_REPLAY_DCN_HOP_US
+DEFAULT_DCN_BYTES_PER_SEC = 25e9
+DEFAULT_DCN_HOP_LATENCY = 10e-6
+
+
+def _compression_spec(compression):
+    if not compression or str(compression).lower() in ("none", "ef_none"):
+        return None
+    key = str(compression).lower()
+    if key.startswith("ef_"):
+        key = key[3:]               # error feedback rides the same wire
+    spec = COMPRESSION_MODEL.get(key)
+    if spec is None:
+        raise ValueError(
+            f"no cost curve for compression {compression!r}; known: "
+            f"{', '.join(sorted(COMPRESSION_MODEL))}")
+    return spec
+
+
+def compression_wire_ratio(compression, orig_itemsize: int = 4) -> float:
+    """Compressed-to-original wire-byte ratio for a payload of
+    ``orig_itemsize``-byte elements (never above 1 — compressing bf16 to
+    bf16 is free, not a doubling)."""
+    spec = _compression_spec(compression)
+    if spec is None:
+        return 1.0
+    return min(1.0, spec["itemsize"] / max(int(orig_itemsize), 1))
+
+
+def compression_overhead_us(nbytes: int, compression) -> float:
+    """Quantize+dequantize µs for ``nbytes`` of uncompressed payload."""
+    spec = _compression_spec(compression)
+    if spec is None:
+        return 0.0
+    return nbytes / 2**20 * spec["qd_us_per_mib"]
+
+
+def compression_scale_exchange(compression) -> bool:
+    spec = _compression_spec(compression)
+    return bool(spec and spec["scale_exchange"])
+
+
 def predict_collective_us(
     op: str,
     nbytes: int,
@@ -160,14 +222,66 @@ def predict_collective_us(
     calls: int = 1,
     ici_bytes_per_sec: float = 186e9,
     ici_hop_latency: float = 1e-6,
+    compression: Optional[str] = None,
+    orig_itemsize: int = 4,
+    two_level: bool = False,
+    local_size: Optional[int] = None,
+    dcn_bytes_per_sec: Optional[float] = None,
+    dcn_hop_latency: Optional[float] = None,
 ) -> float:
     """α–β cost of ``calls`` ring executions of ``op`` moving ``nbytes``
     total, in µs — THE cost model: ``collective_report``'s scaling
     curves, the per-tensor table below, and the replay engine's what-if
     simulator (timeline/replay/simulator.py) all call this one function,
-    so a what-if and the report can never disagree on predicted cost."""
-    t = (_link_volume(op, nbytes, world) / ici_bytes_per_sec
-         + calls * _ring_hops(op, world) * ici_hop_latency)
+    so a what-if and the report can never disagree on predicted cost.
+
+    ``compression`` (a registry name from ops/compression.py) prices the
+    wire-efficiency tier: β shrinks by the wire-byte ratio, and the
+    quantize/dequantize overhead plus the quantizers' scalar scale
+    exchange (one α) are added — compression is NOT free, which is
+    exactly why the planner must rank it against fusion on one scale.
+
+    ``two_level=True`` (all-reduce only) prices the hierarchical shape
+    (parallel/hierarchical.py ``two_level_allreduce``): a local
+    reduce-scatter and all-gather on ICI at full precision, and the
+    cross-host all-reduce on the 1/local_size shard over the DCN link —
+    with ``compression`` applied to the cross stage only, where it is
+    applied in the real path.  Falls back to the flat shape when the
+    topology can't decompose (local_size unset/1, or not dividing
+    world) — mirroring the runtime's own degrade."""
+    spec = _compression_spec(compression)
+    ratio = compression_wire_ratio(compression, orig_itemsize)
+    scale_hops = _ring_hops("all-reduce", world) if spec \
+        and spec["scale_exchange"] else 0
+
+    if two_level and op == "all-reduce" and local_size \
+            and local_size > 1 and world % local_size == 0 \
+            and world // local_size > 1:
+        l, c = int(local_size), world // int(local_size)
+        dcn_bw = dcn_bytes_per_sec if dcn_bytes_per_sec is not None \
+            else DEFAULT_DCN_BYTES_PER_SEC
+        dcn_hop = dcn_hop_latency if dcn_hop_latency is not None \
+            else DEFAULT_DCN_HOP_LATENCY
+        shard = nbytes / l
+        t = (
+            # local reduce-scatter + all-gather, full precision on ICI
+            _link_volume("reduce-scatter", nbytes, l) / ici_bytes_per_sec
+            + _link_volume("all-gather", nbytes, l) / ici_bytes_per_sec
+            + calls * 2 * _ring_hops("reduce-scatter", l) * ici_hop_latency
+            # cross all-reduce on the (compressed) shard over DCN
+            + _link_volume("all-reduce", shard * ratio, c) / dcn_bw
+            + calls * _ring_hops("all-reduce", c) * dcn_hop
+            # quantize/dequantize the shard; scale exchange rides DCN
+            + compression_overhead_us(int(shard), compression) * 1e-6
+            + (calls * _ring_hops("all-reduce", c) * dcn_hop
+               if spec and spec["scale_exchange"] else 0.0)
+        )
+        return t * 1e6
+
+    t = (_link_volume(op, nbytes * ratio, world) / ici_bytes_per_sec
+         + calls * _ring_hops(op, world) * ici_hop_latency
+         + compression_overhead_us(nbytes, compression) * 1e-6
+         + calls * scale_hops * ici_hop_latency)
     return t * 1e6
 
 
@@ -218,15 +332,40 @@ def model_scaling(
     sizes=(8, 16, 32, 64),
     ici_bytes_per_sec: float = 186e9,
     ici_hop_latency: float = 1e-6,
+    compression: Optional[str] = None,
+    orig_itemsize: int = 4,
+    two_level: bool = False,
+    local_size: Optional[int] = None,
+    dcn_bytes_per_sec: Optional[float] = None,
+    dcn_hop_latency: Optional[float] = None,
 ):
     """The pure α-β curve: ({n: t_comm_seconds}, {n: efficiency}) from a
     collective profile (``hlo_collectives`` output) and a per-step
-    single-chip compute time."""
+    single-chip compute time.  ``compression``/``two_level`` model the
+    wire-efficiency tier (docs/compression.md) on the same curve — the
+    SCALING.md story of whether 96–99% at 64 chips survives 10× bigger
+    gradient payloads.  ``orig_itemsize`` is the payload's element size
+    (default f32 = 4): pass 2 for bf16-native gradients, or the wire
+    ratio of bf16/int8 compression is overstated (``cols`` aggregates
+    bytes only, so the dtype must come from the caller).  Routed
+    through :func:`predict_collective_us` so this curve and the replay
+    what-ifs share one arithmetic."""
     comm_seconds, scaling = {}, {}
     for n in sizes:
         t_comm = sum(
-            _link_volume(op, d["bytes"], n) / ici_bytes_per_sec
-            + d["count"] * _ring_hops(op, n) * ici_hop_latency
+            predict_collective_us(
+                op, d["bytes"], n, calls=d["count"],
+                ici_bytes_per_sec=ici_bytes_per_sec,
+                ici_hop_latency=ici_hop_latency,
+                # only the gradient all-reduce path compresses; other
+                # collectives (batch-stat gathers, permutes) ride as-is
+                compression=compression if op == "all-reduce" else None,
+                orig_itemsize=orig_itemsize,
+                two_level=two_level,
+                local_size=local_size,
+                dcn_bytes_per_sec=dcn_bytes_per_sec,
+                dcn_hop_latency=dcn_hop_latency,
+            ) * 1e-6
             for op, d in cols.items()
         )
         comm_seconds[n] = round(t_comm, 6)
@@ -245,6 +384,12 @@ def collective_report(
     ici_hop_latency: float = 1e-6,      # ~1 µs per ICI neighbor hop
     sizes=(8, 16, 32, 64),
     measured_step_seconds: Optional[float] = None,
+    compression: Optional[str] = None,
+    orig_itemsize: int = 4,
+    two_level: bool = False,
+    local_size: Optional[int] = None,
+    dcn_bytes_per_sec: Optional[float] = None,
+    dcn_hop_latency: Optional[float] = None,
     **kwargs,
 ) -> Dict[str, Any]:
     """Compile ``step_fn`` (a jitted/spmd-wrapped callable) on the current
@@ -281,6 +426,10 @@ def collective_report(
         cols, t_compute, sizes=sizes,
         ici_bytes_per_sec=ici_bytes_per_sec,
         ici_hop_latency=ici_hop_latency,
+        compression=compression, orig_itemsize=orig_itemsize,
+        two_level=two_level,
+        local_size=local_size, dcn_bytes_per_sec=dcn_bytes_per_sec,
+        dcn_hop_latency=dcn_hop_latency,
     )
     return {
         "collectives": cols,
@@ -293,6 +442,9 @@ def collective_report(
             "t_compute_seconds": t_compute,
             "t_compute_source": "measured" if measured_step_seconds
             else "flops/peak",
+            "compression": compression or "none",
+            "two_level": bool(two_level),
+            "local_size": local_size,
             "model": "efficiency = t_compute / (t_compute + t_comm); "
                      "t_comm = bytes-on-busiest-link/bw + "
                      "count*ring_hops*hop_latency; 1-D ring, no overlap",

@@ -63,6 +63,17 @@ def _cost_model_from_env(world: int) -> CostModel:
         ici_bytes_per_sec=env_util.get_float(
             env_util.HVD_REPLAY_ICI_GBPS, 186.0) * 1e9,
         hop_latency_us=env_util.get_float(env_util.HVD_REPLAY_HOP_US, 1.0),
+        # two-level what-if shape: the job's real ICI group size unless
+        # overridden (HVD_LOCAL_SIZE is launcher-set; 1 = no hierarchy,
+        # scenario skipped)
+        local_size=env_util.get_int(
+            env_util.HVD_REPLAY_LOCAL_SIZE,
+            env_util.get_int(env_util.HVD_LOCAL_SIZE, 1)),
+        dcn_bytes_per_sec=env_util.get_float(
+            env_util.HVD_REPLAY_DCN_GBPS,
+            env_util.DEFAULT_DCN_GBPS) * 1e9,
+        dcn_hop_latency_us=env_util.get_float(
+            env_util.HVD_REPLAY_DCN_HOP_US, env_util.DEFAULT_DCN_HOP_US),
     )
 
 

@@ -108,10 +108,26 @@ def _events_rank1() -> List[dict]:
 #:
 #: * 3 buckets (no fusion):   g0 100→220, g1 220→270, g2 270→320 → 320
 #: * 2 buckets {g0},{g1,g2}:  g0 100→220, {g1,g2} = α20+β60 = 80,
-#:   220→300 → **300 µs** (the optimum the loop must recover)
+#:   220→300 → **300 µs** (the uncompressed optimum)
 #: * 1 bucket  {g0,g1,g2}:    fills at 200, α20+β160 = 180 → 380
 #: * fuse_all_comm (serial):  200 compute + 180 bucket + 20 tail = 400
 #: * overlap_comm (free channels, unimplementable upper bound): 250
+#:
+#: Wire-efficiency tier (comm_report.COMPRESSION_MODEL constants:
+#: int8 ¼β + 1 µs/MiB qd + one scale-exchange α; fp8 ¼β + 1.5 µs/MiB
+#: + scale α; bf16 ½β + 0.5 µs/MiB, no scale) on the 2-bucket
+#: partition — g0 is 4 MiB f32 (β_cal 100), {g1,g2} 0.5 MiB (β 60):
+#:
+#: * bucket {g0}:      none 120 | int8 20+25+4+20 = **69** |
+#:   fp8 20+25+6+20 = 71 | bf16 20+50+2 = 72
+#: * bucket {g1,g2}:   none 80 | int8 20+15+0.5+20 = 55.5 |
+#:   fp8 55.75 | bf16 20+30+0.25 = **50.25**
+#: * chosen plan [int8, bf16]: g0 100→169, {g1,g2} fills 200,
+#:   200→250.25 → **250.25 µs** (the staged optimum — int8 on the
+#:   largest gradient, cast-only bf16 on the small bucket where the
+#:   scale-exchange α would not pay)
+#: * whole-wire compress_int8 (serial replay): 220 compute +
+#:   69+47.75+47.75 = **384.5**
 AUTOTUNE_TENSORS = ("g0", "g1", "g2")
 AUTOTUNE_SHAPES = {"g0": [1024, 1024], "g1": [256, 256], "g2": [256, 256]}
 AUTOTUNE_STEP_NO = 1
@@ -121,9 +137,17 @@ AUTOTUNE_EXPECTED: Dict[str, object] = {
     "baseline_us": 440.0,
     "optimal_num_buckets": 2,
     "optimal_buckets": [["g0"], ["g1", "g2"]],
-    "predicted_step_us": 300.0,
-    "predicted_speedup_pct": 31.82,
+    # uncompressed bucket economics (the bucket_search table rows)
+    "uncompressed_step_us": 300.0,
+    "uncompressed_speedup_pct": 31.82,
     "bucket_search_us": {1: 380.0, 2: 300.0, 3: 320.0},
+    # the staged wire-format choice on the winning partition — the plan
+    # the closed loop must recover END TO END: int8 on the largest
+    # gradient, bf16 on the small bucket (hand math in the block above)
+    "optimal_compression": ["int8", "bf16"],
+    "predicted_step_us": 250.25,
+    "predicted_speedup_pct": 43.12,
+    "compress_int8_us": 384.5,
     "fuse_all_us": 400.0,
     "overlap_us": 250.0,
     "hop_latency_us": AUTOTUNE_HOP_US,

@@ -44,13 +44,22 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional
 
-from ..comm_report import _link_volume, _ring_hops, predict_collective_us
+from ..comm_report import (
+    DEFAULT_DCN_BYTES_PER_SEC, DEFAULT_DCN_HOP_LATENCY, _link_volume,
+    _ring_hops, compression_overhead_us, compression_scale_exchange,
+    compression_wire_ratio, predict_collective_us,
+)
 from .critical_path import Schedule, attribute, schedule
-from .stitcher import Node, StepDAG
+from .stitcher import Node, StepDAG, _dtype_bytes
 
 #: defaults shared with comm_report.collective_report (v5e-class ICI)
 DEFAULT_ICI_BYTES_PER_SEC = 186e9
 DEFAULT_HOP_LATENCY_US = 1.0
+
+#: wire formats the compression what-ifs and the per-bucket choice
+#: search rank (ops/compression.py registry names priced by
+#: comm_report.COMPRESSION_MODEL)
+COMPRESSION_CANDIDATES = ("int8", "fp8", "bf16")
 
 
 @dataclasses.dataclass
@@ -60,6 +69,11 @@ class CostModel:
     world: int
     ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC
     hop_latency_us: float = DEFAULT_HOP_LATENCY_US
+    #: two-level (ICI/DCN) shape parameters — local_size <= 1 disables
+    #: the two_level_comm what-if (no hierarchy to exploit)
+    local_size: int = 1
+    dcn_bytes_per_sec: float = DEFAULT_DCN_BYTES_PER_SEC
+    dcn_hop_latency_us: float = DEFAULT_DCN_HOP_LATENCY * 1e6
 
     def alpha_us(self, node: Node) -> float:
         return _ring_hops(node.op or "all-reduce",
@@ -85,6 +99,56 @@ class CostModel:
         honest on hardware whose effective bandwidth differs from the
         datasheet — the model shape is analytic, the level is measured."""
         return max(node.dur_us - self.alpha_us(node), 0.0)
+
+    # -- wire-efficiency tier ------------------------------------------------
+    def compressible(self, node: Node) -> bool:
+        """Float payloads compress; integer/bool payloads ride as-is
+        (the compressors gate the same way, ops/compression.py)."""
+        if node.kind != "comm" or not node.nbytes:
+            return False
+        d = str(node.dtype) if node.dtype else "float32"
+        return d.startswith(("float", "bfloat"))
+
+    def compression_ratio(self, node: Node, compression: str) -> float:
+        orig = _dtype_bytes(node.dtype)
+        return compression_wire_ratio(compression, orig)
+
+    def compressed_dur_us(self, node: Node, compression: str) -> float:
+        """Calibrated compressed cost: the measured β share shrinks by
+        the wire ratio; quantize/dequantize and the quantizers' scalar
+        scale exchange (one all-reduce α) are added — the same curve
+        predict_collective_us prices, anchored on the measured level."""
+        if not self.compressible(node):
+            return node.dur_us
+        beta = self.calibrated_beta_us(node) * \
+            self.compression_ratio(node, compression)
+        qd = compression_overhead_us(node.nbytes or 0, compression)
+        scale = (_ring_hops("all-reduce", self.world) * self.hop_latency_us
+                 if compression_scale_exchange(compression) else 0.0)
+        return self.alpha_us(node) + beta + qd + scale
+
+    def two_level_dur_us(self, node: Node,
+                         compression: Optional[str] = None) -> float:
+        """Model-priced two-level cost (parallel/hierarchical.py shape):
+        the measured flat duration carries no information about the
+        ICI/DCN split, so this scenario is pure predict_collective_us —
+        the fixture-checkable arithmetic, not a calibrated replay."""
+        if node.kind != "comm" or not node.nbytes \
+                or (node.op or "all-reduce") != "all-reduce":
+            return node.dur_us
+        return predict_collective_us(
+            "all-reduce", node.nbytes, self.world,
+            ici_bytes_per_sec=self.ici_bytes_per_sec,
+            ici_hop_latency=self.hop_latency_us * 1e-6,
+            compression=compression if self.compressible(node) else None,
+            orig_itemsize=_dtype_bytes(node.dtype),
+            two_level=True, local_size=self.local_size,
+            dcn_bytes_per_sec=self.dcn_bytes_per_sec,
+            dcn_hop_latency=self.dcn_hop_latency_us * 1e-6)
+
+    def two_level_possible(self) -> bool:
+        return (self.local_size > 1 and self.world % self.local_size == 0
+                and self.world // self.local_size > 1)
 
 
 def identify_straggler(dag: StepDAG, sched: Schedule) -> Optional[int]:
@@ -215,14 +279,42 @@ def comm_channel_order(dag: StepDAG) -> List[int]:
     return order
 
 
+def _bucket_dur_us(cm: CostModel, members: List[Node],
+                   compression: Optional[str]) -> float:
+    """One bucket's cost: max member α + summed calibrated β (scaled by
+    the wire ratio when compressed) + the members' quantize/dequantize
+    overhead + ONE scale-exchange α for the whole bucket (the per-tensor
+    scale scalars ride one fused collective)."""
+    alpha = max(cm.alpha_us(m) for m in members)
+    if not compression:
+        return alpha + sum(cm.calibrated_beta_us(m) for m in members)
+    beta = qd = 0.0
+    any_scale = False
+    for m in members:
+        if cm.compressible(m):
+            beta += cm.calibrated_beta_us(m) * \
+                cm.compression_ratio(m, compression)
+            qd += compression_overhead_us(m.nbytes or 0, compression)
+            any_scale = any_scale or compression_scale_exchange(compression)
+        else:
+            beta += cm.calibrated_beta_us(m)
+    scale = (_ring_hops("all-reduce", cm.world) * cm.hop_latency_us
+             if any_scale else 0.0)
+    return alpha + beta + qd + scale
+
+
 def bucketed_dag(dag: StepDAG, cm: CostModel,
-                 buckets: List[List[int]]):
+                 buckets: List[List[int]],
+                 bucket_compression: Optional[List[Optional[str]]] = None):
     """The step DAG with the given comm nodes re-batched into explicit
     buckets (each a list of original comm node ids): per rank a bucket
     node sits where its LAST member sat, earlier members vanish, and the
     bucket costs one α (the members' max) plus the summed calibrated β.
     Readiness per rank is the last compute segment preceding the bucket's
     last member — a bucket can't launch before it fills.
+    ``bucket_compression`` (registry names aligned with ``buckets``)
+    prices a per-bucket wire format via :func:`_bucket_dur_us` — the
+    planner's compression choice replayed on the same DAG.
 
     Returns ``(new_dag, bucket_ids, chain_edges)`` where ``chain_edges``
     serializes the bucket nodes on one comm channel in dispatch order —
@@ -248,13 +340,15 @@ def bucketed_dag(dag: StepDAG, cm: CostModel,
 
     def bucket_node(bi: int) -> Node:
         members = [dag.nodes[nid] for nid in buckets[bi]]
-        alpha = max(cm.alpha_us(m) for m in members)
-        beta = sum(cm.calibrated_beta_us(m) for m in members)
+        comp = bucket_compression[bi] if bucket_compression is not None \
+            and bi < len(bucket_compression) else None
         nbytes = sum(m.nbytes or 0 for m in members) or None
         names = ",".join(m.tensor or m.label for m in members)
-        return Node(0, "comm", alpha + beta, tensor=f"<bucket{bi}>",
+        tag = f"|{comp}" if comp else ""
+        return Node(0, "comm", _bucket_dur_us(cm, members, comp),
+                    tensor=f"<bucket{bi}>",
                     op=members[0].op or "all-reduce", nbytes=nbytes,
-                    label=f"comm:<bucket{bi}:{names}>",
+                    label=f"comm:<bucket{bi}:{names}{tag}>",
                     ranks=tuple(sorted({r for m in members
                                         for r in m.ranks})))
 
@@ -304,13 +398,18 @@ def bucketed_dag(dag: StepDAG, cm: CostModel,
 
 
 def _bucket_plan(dag: StepDAG, partition: List[List[int]],
-                 predicted_us: float) -> dict:
+                 predicted_us: float,
+                 compression: Optional[List[Optional[str]]] = None) -> dict:
     """Machine-readable plan payload for one bucketing — the contract
-    optim/profile_guided.py consumes (docs/autotune.md)."""
+    optim/profile_guided.py consumes (docs/autotune.md).  ``compression``
+    (aligned with ``partition``) records the per-bucket wire-format
+    decision; it is re-ordered with the buckets into wire order."""
     order = comm_channel_order(dag)
     pos = {nid: i for i, nid in enumerate(order)}
-    wire = sorted(partition, key=lambda b: max(pos[n] for n in b))
-    return {
+    idx = sorted(range(len(partition)),
+                 key=lambda i: max(pos[n] for n in partition[i]))
+    wire = [partition[i] for i in idx]
+    plan = {
         "num_buckets": len(wire),
         "buckets": [[dag.nodes[n].tensor or dag.nodes[n].label
                      for n in sorted(b, key=pos.get)] for b in wire],
@@ -319,6 +418,54 @@ def _bucket_plan(dag: StepDAG, partition: List[List[int]],
         "overlap": True,
         "predicted_step_us": round(predicted_us, 3),
     }
+    if compression is not None:
+        plan["compression"] = [compression[i] for i in idx]
+    return plan
+
+
+def compression_choice_search(dag: StepDAG, cm: CostModel,
+                              partition: List[List[int]],
+                              candidates=COMPRESSION_CANDIDATES):
+    """Per-bucket wire-format choice for a FIXED bucket partition:
+    greedy over buckets in descending payload order, picking per bucket
+    the candidate that most improves the two-thread replayed makespan
+    (ties broken toward the cheaper bucket duration, so a bucket hidden
+    behind the critical path still takes the best format).  Staged
+    after the partition search (docs/autotune.md): the joint
+    partition × format space is exponential, and the partition choice
+    is driven by α amortization while the format choice is driven by β
+    — factoring them keeps both searches hand-checkable.
+
+    Returns ``(compression, makespan_us)`` with ``compression`` aligned
+    to ``partition`` (None = uncompressed)."""
+    comp: List[Optional[str]] = [None] * len(partition)
+
+    def evaluate(c):
+        bdag, _ids, chain = bucketed_dag(dag, cm, partition,
+                                         bucket_compression=c)
+        return schedule(bdag, overlap=True, extra_preds=chain).makespan
+
+    def bucket_dur(bi, name):
+        return _bucket_dur_us(cm, [dag.nodes[n] for n in partition[bi]],
+                              name)
+
+    best_m = evaluate(comp)
+    order = sorted(range(len(partition)), key=lambda bi: -sum(
+        dag.nodes[n].nbytes or 0 for n in partition[bi]))
+    for bi in order:
+        if not any(cm.compressible(dag.nodes[n]) for n in partition[bi]):
+            continue
+        best = (best_m, bucket_dur(bi, comp[bi]), comp[bi])
+        for cand in candidates:
+            trial = list(comp)
+            trial[bi] = cand
+            key = (evaluate(trial), bucket_dur(bi, cand), cand)
+            if key[:2] < best[:2]:
+                best = key
+        if best[2] != comp[bi]:
+            comp[bi] = best[2]
+            best_m = best[0]
+    return comp, best_m
 
 
 def bucket_plan_search(dag: StepDAG, cm: CostModel,
@@ -358,7 +505,12 @@ def bucket_plan_search(dag: StepDAG, cm: CostModel,
     results: List[dict] = []
 
     def record(partition: List[List[int]], makespan: float) -> None:
-        results.append(_bucket_plan(dag, partition, makespan))
+        row = _bucket_plan(dag, partition, makespan)
+        # node-id partition, for the staged compression_choice_search
+        # (tensor names in `buckets` are the plan contract; node ids are
+        # this DAG's internals)
+        row["node_partition"] = [list(b) for b in partition]
+        results.append(row)
 
     best_seen = evaluate(parts)
     record(parts, best_seen)
@@ -435,6 +587,31 @@ def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
         add("fuse_all_comm", schedule(fdag),
             "all collectives re-batched into one bucket: one α, "
             "summed β, launch gated by the last gradient")
+    # wire-efficiency tier (docs/compression.md): every float payload
+    # re-costed in one wire format — β scaled by the compression ratio,
+    # quantize/dequantize and scale-exchange overheads added, all from
+    # comm_report's COMPRESSION_MODEL (the same curve
+    # predict_collective_us prices)
+    for comp in COMPRESSION_CANDIDATES:
+        overrides = {n.nid: cm.compressed_dur_us(n, comp)
+                     for n in dag.nodes if cm.compressible(n)}
+        if overrides:
+            add(f"compress_{comp}", schedule(dag, dur_overrides=overrides),
+                f"every float gradient quantized to {comp} on the wire "
+                "(error-feedback residual carried, "
+                "HVD_COMPRESSION=" + comp + ")")
+    if cm.two_level_possible():
+        overrides = {
+            n.nid: cm.two_level_dur_us(n) for n in dag.nodes
+            if n.kind == "comm" and n.nbytes
+            and (n.op or "all-reduce") == "all-reduce"
+        }
+        if overrides:
+            add("two_level_comm", schedule(dag, dur_overrides=overrides),
+                f"two-level allreduce: ICI reduce-scatter over "
+                f"{cm.local_size} local ranks + DCN all-reduce on the "
+                "shard + ICI all-gather (model-priced, "
+                "HVD_TWO_LEVEL_ALLREDUCE=1)")
     search = bucket_plan_search(dag, cm) if plan_search else []
     if search:
         best = search[0]
@@ -444,6 +621,20 @@ def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
             "on a serialized comm channel overlapping compute — the "
             "implementable plan the profile-guided tuner applies",
             plan=best)
+        # staged wire-format choice on the winning partition: the
+        # per-bucket compression decision the planner applies/verifies/
+        # rolls back exactly like the fusion decision
+        comp, m = compression_choice_search(dag, cm,
+                                            best["node_partition"])
+        if any(comp) and m < best["predicted_step_us"]:
+            plan = _bucket_plan(dag, best["node_partition"], m,
+                                compression=comp)
+            chosen = ",".join(f"{c or 'none'}" for c in plan["compression"])
+            add(f"fuse_buckets_{plan['num_buckets']}_compressed", m,
+                f"the {plan['num_buckets']}-bucket plan with per-bucket "
+                f"wire formats [{chosen}] — compression ranked against "
+                "fusion on one scale",
+                plan=plan)
     scenarios.sort(key=lambda s: s["predicted_step_us"])
     return {
         "baseline_replay_us": round(baseline_us, 3),

@@ -76,6 +76,7 @@ class Node:
     ranks: Tuple[int, ...] = ()     # participants (comm nodes)
     label: str = ""                 # compute-segment identity, cross-rank
     dag_label: Optional[str] = None  # joined dag.gml node label
+    dtype: Optional[str] = None     # payload dtype (compression pricing)
 
 
 @dataclasses.dataclass
@@ -228,8 +229,9 @@ def _dtype_bytes(dtype: Optional[str]) -> int:
 
 
 def join_tensor(tensor: str, art: Artifacts) -> Tuple[Optional[int],
+                                                      Optional[str],
                                                       Optional[str]]:
-    """``(nbytes, dag_label)`` for a comm span's tensor name, joined
+    """``(nbytes, dag_label, dtype)`` for a comm span's tensor name, joined
     against the Recorder artifacts: exact ``tensor_shapes.json`` key
     first, then a manifest suffix match (eager dispatch names are often
     the trailing path component of ``gradients/...`` manifest names),
@@ -263,11 +265,11 @@ def join_tensor(tensor: str, art: Artifacts) -> Tuple[Optional[int],
                 label = cand
                 break
     if shape is None:
-        return None, label
+        return None, label, dtype
     n = 1
     for d in shape:
         n *= int(d)
-    return n * _dtype_bytes(dtype), label
+    return n * _dtype_bytes(dtype), label, dtype
 
 
 # ---------------------------------------------------------------------------
@@ -376,10 +378,10 @@ def build_step_dag(art: Artifacts, step_no: int,
                 chain.append(nid)
             key = (s.tensor, k)
             if key not in comm_ids:
-                nbytes, dag_label = join_tensor(s.tensor, art)
+                nbytes, dag_label, dtype = join_tensor(s.tensor, art)
                 comm_ids[key] = add(Node(
                     0, "comm", s.dur_us, tensor=s.tensor, op=s.op,
-                    nbytes=nbytes, dag_label=dag_label,
+                    nbytes=nbytes, dag_label=dag_label, dtype=dtype,
                     label=f"comm:{s.tensor}:{k}"))
                 ready_pred[comm_ids[key]] = {}
             cid = comm_ids[key]

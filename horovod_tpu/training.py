@@ -20,7 +20,7 @@ from . import core
 from .core import Average
 from .elastic import faults as _faults
 from .elastic import heartbeat as _heartbeat
-from .ops.compression import Compression
+from .ops.compression import Compression, ErrorFeedback
 from .ops.fusion import allreduce_pytree
 from .spmd import spmd
 
@@ -30,6 +30,10 @@ class TrainState(NamedTuple):
     opt_state: Any
     model_state: Any  # mutable collections (e.g. batch_stats); may be {}
     step: jnp.ndarray
+    #: error-feedback residual pytree (docs/compression.md) — ``()`` (no
+    #: leaves) when compression is stateless.  Living in the state, it
+    #: is checkpointed and elastic-rebuilt with params/opt_state.
+    residual: Any = ()
 
 
 def scan_steps(step_fn: Callable, k: int) -> Callable:
@@ -57,11 +61,12 @@ def make_train_step(
     loss_fn: Callable,
     optimizer,
     op: str = Average,
-    compression=Compression.none,
+    compression=None,
     has_batch_stats: bool = False,
     threshold_bytes: Optional[int] = None,
     donate: bool = True,
     hierarchical: bool = False,
+    two_level: Optional[bool] = None,
     autotune: Optional[bool] = None,
     autotune_log_file: Optional[str] = None,
     profile_guided: Optional[bool] = None,
@@ -75,6 +80,22 @@ def make_train_step(
     * gradients are bucket-fused and allreduced with ``op``/``compression``;
       the loss is also averaged across ranks for reporting (matching
       MetricAverageCallback semantics, reference _keras/callbacks.py:46-60).
+    * ``compression`` (default: the ``HVD_COMPRESSION`` /
+      ``HVD_COMPRESSION_ERROR_FEEDBACK`` env knobs, docs/compression.md)
+      selects the wire format; an
+      :class:`~horovod_tpu.ops.compression.ErrorFeedback` instance
+      threads the quantization residual through ``TrainState.residual``
+      (initialize it via ``init_train_state(..., compression=...)``;
+      with ``in_graph_steps == 1`` an uninitialized residual is created
+      lazily at first trace).  A residual-norm convergence guard
+      (``HVD_COMPRESSION_GUARD_STEPS``/``_FACTOR``) samples the
+      ``hvd_compression_residual_norm`` gauge and, if the residual
+      diverges, falls back to uncompressed allreduce
+      (``hvd_compression_fallbacks_total``) — training continues.
+    * ``two_level`` (default: ``HVD_TWO_LEVEL_ALLREDUCE``) reduces each
+      gradient with the compressed two-level path — ICI reduce-scatter,
+      ``compression`` on the cross/DCN stage only
+      (parallel/hierarchical.py ``two_level_allreduce``).
     * ``autotune`` (default: the HVD_AUTOTUNE env, reference run.py:490-521
       --autotune) drives a live ParameterManager: it scores each step as
       bytes/sec, moves the fusion-threshold / hierarchical knobs, and
@@ -99,9 +120,37 @@ def make_train_step(
       on the v5e, docs/PERF.md).  Real data pipelines keep the default 1.
     """
     from .ops import collectives
-    from .parallel.hierarchical import hierarchical_allreduce
+    from .parallel.hierarchical import (
+        hierarchical_allreduce, two_level_allreduce, use_two_level_default,
+    )
+    from .utils import env as env_util
+    from .utils.logging import get_logger
 
-    def _build(threshold_b, hier, named_buckets=None):
+    log = get_logger(__name__)
+
+    if compression is None:
+        from .ops.compression import from_env as _compression_from_env
+
+        compression = _compression_from_env()
+    if two_level is None:
+        two_level = use_two_level_default()
+
+    def _build(threshold_b, hier, named_buckets=None, comp=None,
+               bucket_compression=None, tlvl=None):
+        comp = comp if comp is not None else compression
+        tlvl = two_level if tlvl is None else tlvl
+        # error feedback threads TrainState.residual — only on the fused
+        # pytree path (the per-leaf hier/two-level paths carry their own
+        # compression semantics; two_level_allreduce documents why EF
+        # degrades there)
+        plan_comp = bucket_compression is not None \
+            and any(bucket_compression) \
+            and env_util.get_bool(
+                env_util.HVD_COMPRESSION_ERROR_FEEDBACK, True) \
+            and in_graph_steps <= 1
+        ef = (isinstance(comp, ErrorFeedback) or plan_comp) \
+            and not hier and not tlvl
+
         def per_rank_step(state: TrainState, x, y):
             def compute_loss(params):
                 variables = {"params": params, **state.model_state}
@@ -117,15 +166,44 @@ def make_train_step(
                 compute_loss, has_aux=True
             )(state.params)
 
-            if hier:
+            residual = state.residual
+            if tlvl:
+                grads = jax.tree_util.tree_map(
+                    lambda g: two_level_allreduce(g, op=op,
+                                                  compression=comp),
+                    grads,
+                )
+            elif hier:
                 grads = jax.tree_util.tree_map(
                     lambda g: hierarchical_allreduce(g, op=op), grads
                 )
-            else:
-                grads = allreduce_pytree(
-                    grads, op=op, compression=compression,
+            elif ef:
+                if not jax.tree_util.tree_leaves(residual):
+                    if in_graph_steps > 1:
+                        raise ValueError(
+                            "error-feedback compression with "
+                            "in_graph_steps > 1 needs an initialized "
+                            "residual (lax.scan carries must keep one "
+                            "structure) — build the state with "
+                            "init_train_state(..., compression=...)")
+                    # lazy init at trace time: the first compiled step
+                    # returns the full residual structure, later calls
+                    # carry it (one extra re-trace, no extra step work)
+                    residual = jax.tree_util.tree_map(
+                        jnp.zeros_like, grads)
+                grads, residual = allreduce_pytree(
+                    grads, op=op, compression=comp,
                     threshold_bytes=threshold_b,
                     named_buckets=named_buckets,
+                    bucket_compression=bucket_compression,
+                    residual=residual,
+                )
+            else:
+                grads = allreduce_pytree(
+                    grads, op=op, compression=comp,
+                    threshold_bytes=threshold_b,
+                    named_buckets=named_buckets,
+                    bucket_compression=bucket_compression,
                 )
             loss = collectives.allreduce(loss, op=Average)
 
@@ -136,7 +214,8 @@ def make_train_step(
 
             params = optax.apply_updates(state.params, updates)
             return (
-                TrainState(params, opt_state, new_model_state, state.step + 1),
+                TrainState(params, opt_state, new_model_state,
+                           state.step + 1, residual),
                 loss,
             )
 
@@ -144,16 +223,16 @@ def make_train_step(
 
         # params/opt_state replicated; batch sharded across ranks on dim 0.
         state_spec = TrainState(
-            params=P(), opt_state=P(), model_state=P(), step=P()
+            params=P(), opt_state=P(), model_state=P(), step=P(),
+            residual=P(),
         )
-        return spmd(
+        fn = spmd(
             per_rank_entry,
             in_specs=(state_spec, P(core.AXIS), P(core.AXIS)),
             out_specs=(state_spec, P()),
             donate_argnums=(0,) if donate else (),
         )
-
-    from .utils import env as env_util
+        return fn, ef
 
     if autotune is None:
         autotune = env_util.get_bool(env_util.HVD_AUTOTUNE)
@@ -167,15 +246,36 @@ def make_train_step(
         change (core.reinit bumps the epoch and swaps the mesh) can
         rebuild with the same knobs.  ``plan`` is a profile-guided
         FusionPlanSpec: its explicit bucket vector overrides the scalar
-        threshold (optim/profile_guided.py)."""
+        threshold, and its per-bucket ``compression`` names override the
+        wire format (optim/profile_guided.py)."""
         named = plan.buckets if plan is not None else None
+        bucket_comp = getattr(plan, "compression", None) \
+            if plan is not None else None
+        if bucket_comp is not None and box.get("guard_tripped"):
+            # the convergence guard already condemned compression in
+            # this job; later plans keep their fusion layout but ship
+            # uncompressed
+            bucket_comp = None
+        if bucket_comp is not None and any(bucket_comp) \
+                and in_graph_steps > 1:
+            # plan compression rides error feedback, and a lax.scan
+            # carry can't grow a residual mid-job — keep the fusion
+            # layout, ship it uncompressed rather than silently
+            # quantizing without the residual carry
+            log.info("profile-guided plan carries per-bucket compression "
+                     "but in_graph_steps > 1 has no residual carry — "
+                     "applying the fusion layout uncompressed")
+            bucket_comp = None
+        comp = box.get("compression", compression)
         # An explicit bucket plan owns the comm layout: the hierarchical
         # path reduces per leaf and would silently drop named_buckets
         # while the tuner reports the plan applied.  box keeps the
         # original hier so rollback (plan=None) restores it.
+        fn, ef = _build(threshold_b, hier and plan is None, named,
+                        comp, bucket_comp, two_level and plan is None)
         box.update(
-            fn=_build(threshold_b, hier and plan is None, named),
-            threshold=threshold_b, hier=hier, plan=plan,
+            fn=fn, threshold=threshold_b, hier=hier, plan=plan,
+            ef_active=ef, compression=comp,
             core_epoch=core._require_init().epoch,
         )
 
@@ -224,6 +324,56 @@ def make_train_step(
         except (AttributeError, IndexError, TypeError):
             pass  # batch without a leading dim: samples stay uncounted
 
+    # Error-feedback convergence guard (docs/compression.md): every
+    # HVD_COMPRESSION_GUARD_STEPS steps read the residual norm off the
+    # returned state (one device sync per guard window — not per step),
+    # export the gauge, and fall back to uncompressed allreduce when the
+    # norm diverges.  The residual is replicated and the guard logic is
+    # deterministic host float math, so every process trips identically.
+    guard_steps = env_util.get_int(env_util.HVD_COMPRESSION_GUARD_STEPS,
+                                   env_util.DEFAULT_COMPRESSION_GUARD_STEPS)
+    guard_box = {"n": 0, "guard": None}
+
+    def _maybe_guard(new_state):
+        if not box.get("ef_active") or guard_steps <= 0:
+            return
+        guard_box["n"] += 1
+        if guard_box["n"] % guard_steps:
+            return
+        from .ops.compression import ErrorFeedbackGuard, residual_norm
+
+        norm = residual_norm(new_state.residual)
+        if metrics.on():
+            metrics.COMPRESSION_RESIDUAL_NORM.set(norm)
+        if guard_box["guard"] is None:
+            guard_box["guard"] = ErrorFeedbackGuard()
+        if not guard_box["guard"].observe(norm):
+            return
+        log.warning(
+            "error-feedback residual norm %.3g diverged past %gx its "
+            "baseline — falling back to uncompressed allreduce; the "
+            "diverged residual is DISCARDED (it is garbage by "
+            "construction) and stays frozen in TrainState.residual",
+            norm, guard_box["guard"].factor)
+        if metrics.on():
+            metrics.COMPRESSION_FALLBACKS.inc()
+        box["guard_tripped"] = True
+        box["compression"] = Compression.none
+        plan = box.get("plan")
+        if plan is not None and getattr(plan, "compression", None):
+            plan = dataclasses_replace_plan(plan)
+        _rebuild(box["threshold"], box["hier"], plan)
+
+    def dataclasses_replace_plan(plan):
+        """The applied plan minus its compression decision — fusion
+        layout survives the fall-back, wire format does not."""
+        import dataclasses as _dc
+
+        try:
+            return _dc.replace(plan, compression=None)
+        except TypeError:
+            return plan
+
     def _invoke(state, x, y, _under_trace=None):
         # Host-side step record: advances the trace window (reference
         # BYTEPS_TRACE_START/END_STEP semantics) and emits a STEP dispatch
@@ -255,8 +405,12 @@ def make_train_step(
             timeline.record_step(owner="train_step")
             timeline.mark_cycle_start()
             with timeline.span("train_step", "STEP"):
-                return box["fn"](state, x, y)
-        return box["fn"](state, x, y)
+                result = box["fn"](state, x, y)
+        else:
+            result = box["fn"](state, x, y)
+        if not under_trace:
+            _maybe_guard(result[0])
+        return result
 
     # Profile-guided loop (optim/profile_guided.py): analyze the job's
     # own trace window, apply the winning bucket plan through the same
@@ -380,10 +534,16 @@ def make_train_step(
 
 
 def init_train_state(model, optimizer, sample_input, *, rngs=None,
-                    has_batch_stats: bool = False) -> TrainState:
+                    has_batch_stats: bool = False,
+                    compression=None) -> TrainState:
     """Initialize replicated TrainState on the mesh (rank-0-initializes +
     broadcast in Horovod terms; under a single controller, replication by
-    construction plus hvd.broadcast_parameters for multi-host)."""
+    construction plus hvd.broadcast_parameters for multi-host).
+
+    Pass the same ``compression`` the train step uses: an
+    :class:`~horovod_tpu.ops.compression.ErrorFeedback` wrapper gets its
+    zero residual pytree here (required for ``in_graph_steps > 1``,
+    where ``lax.scan`` needs the carry structure fixed up front)."""
     import numpy as np
 
     rngs = rngs if rngs is not None else jax.random.PRNGKey(0)
@@ -393,9 +553,11 @@ def init_train_state(model, optimizer, sample_input, *, rngs=None,
         k: v for k, v in variables.items() if k != "params"
     } if has_batch_stats else {}
     opt_state = optimizer.init(params)
+    residual = ErrorFeedback.init_state(params) \
+        if isinstance(compression, ErrorFeedback) else ()
     state = TrainState(
         params=params, opt_state=opt_state, model_state=model_state,
-        step=jnp.zeros((), jnp.int32),
+        step=jnp.zeros((), jnp.int32), residual=residual,
     )
     # Replicate across the mesh explicitly so the donated buffers live on
     # every device before step 1 (no lazy broadcast inside the hot loop).

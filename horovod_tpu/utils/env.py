@@ -45,6 +45,14 @@ HVD_LOG_LEVEL = "HVD_LOG_LEVEL"
 HVD_LOG_HIDE_TIME = "HVD_LOG_HIDE_TIME"
 HVD_HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
 HVD_HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
+# wire-efficiency tier (ops/compression.py, parallel/hierarchical.py;
+# docs/compression.md): gradient compression + two-level reduction
+HVD_COMPRESSION = "HVD_COMPRESSION"                    # none|bf16|int8|fp8|fp8_e5m2 wire format
+HVD_COMPRESSION_ERROR_FEEDBACK = "HVD_COMPRESSION_ERROR_FEEDBACK"  # 0 drops the residual carry (default 1)
+HVD_COMPRESSION_GUARD_STEPS = "HVD_COMPRESSION_GUARD_STEPS"  # residual-norm check cadence (default 25; 0 off)
+HVD_COMPRESSION_GUARD_FACTOR = "HVD_COMPRESSION_GUARD_FACTOR"  # divergence = norm > factor x baseline (default 10)
+HVD_TWO_LEVEL_ALLREDUCE = "HVD_TWO_LEVEL_ALLREDUCE"    # 1 = compressed two-level (ICI RS + DCN AR) gradient path
+HVD_BENCH_COMPRESSION = "HVD_BENCH_COMPRESSION"        # 0 skips bench.py's compressed comparison leg
 HVD_CACHE_CAPACITY = "HVD_CACHE_CAPACITY"
 # host-plane ring/star crossover: payloads >= this ride the peer ring
 # (calibrate per fabric: scripts/host_plane_bench.py --crossover)
@@ -102,6 +110,9 @@ HVD_REPLAY_CLOCK_SYNC = "HVD_REPLAY_CLOCK_SYNC"        # 0 skips the init-time c
 HVD_REPLAY_CLOCK_SAMPLES = "HVD_REPLAY_CLOCK_SAMPLES"  # handshake round trips (default 8)
 HVD_REPLAY_ICI_GBPS = "HVD_REPLAY_ICI_GBPS"            # what-if link bandwidth, GB/s (default 186)
 HVD_REPLAY_HOP_US = "HVD_REPLAY_HOP_US"                # what-if per-hop latency, µs (default 1)
+HVD_REPLAY_DCN_GBPS = "HVD_REPLAY_DCN_GBPS"            # two-level what-if cross bandwidth, GB/s (default 25)
+HVD_REPLAY_DCN_HOP_US = "HVD_REPLAY_DCN_HOP_US"        # two-level what-if cross hop latency, µs (default 10)
+HVD_REPLAY_LOCAL_SIZE = "HVD_REPLAY_LOCAL_SIZE"        # two-level what-if ICI group size (default HVD_LOCAL_SIZE)
 # failure-domain runtime (horovod_tpu/elastic/, docs/fault_tolerance.md)
 HVD_HEARTBEAT_INTERVAL_SECONDS = "HVD_HEARTBEAT_INTERVAL_SECONDS"  # lease renewal (default 2)
 HVD_HEARTBEAT_DISABLE = "HVD_HEARTBEAT_DISABLE"        # 1 turns the lease/abort plane off
@@ -133,6 +144,10 @@ DEFAULT_ELASTIC_MAX_FLAPS = 3                      # elastic/driver.py blocklist
 DEFAULT_AUTOTUNE_WINDOW_STEPS = 20                 # profile-guided measure/verify window
 DEFAULT_AUTOTUNE_GUARD_BAND_PCT = 10.0             # rollback when realized lags predicted by more
 DEFAULT_AUTOTUNE_CYCLE_FLUSH_STEPS = 0             # verified plans pinned forever unless set
+DEFAULT_COMPRESSION_GUARD_STEPS = 25               # error-feedback residual-norm check cadence
+DEFAULT_COMPRESSION_GUARD_FACTOR = 10.0            # residual divergence threshold (x baseline)
+DEFAULT_DCN_GBPS = 25.0                            # modeled cross-host (DCN) bandwidth per host
+DEFAULT_DCN_HOP_US = 10.0                          # modeled cross-host per-hop latency
 
 
 def get_int(name: str, default: int) -> int:

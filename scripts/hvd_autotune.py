@@ -42,7 +42,13 @@ from horovod_tpu.timeline.replay.simulator import CostModel  # noqa: E402
 
 
 def run_check() -> int:
-    """Closed-loop self-test on the hand-computed autotune fixture."""
+    """Closed-loop self-test on the hand-computed autotune fixture,
+    wire-efficiency tier included: the recovered plan must carry the
+    known-optimal per-bucket compression (int8 on the largest gradient),
+    apply → verify in-band, and the decision must be visible on a real
+    rendezvous server's ``GET /autotune``."""
+    from horovod_tpu.run.http_client import get_autotune
+    from horovod_tpu.run.http_server import RendezvousServer
     from horovod_tpu.timeline.replay.fixture import (
         AUTOTUNE_EXPECTED, write_autotune_fixture_trace,
     )
@@ -54,7 +60,8 @@ def run_check() -> int:
         summary = analyze(d, cost_model=cm).summary
         plan = plan_from_summary(summary)
 
-        # 1. plan recovery: exact buckets, exact predicted step time
+        # 1. plan recovery: exact buckets, exact per-bucket compression,
+        # exact predicted step time
         if plan is None:
             print("hvd_autotune --check FAILED: no plan recovered",
                   file=sys.stderr)
@@ -62,44 +69,73 @@ def run_check() -> int:
         if plan.buckets != exp["optimal_buckets"]:
             errors.append(f"buckets {plan.buckets} != "
                           f"{exp['optimal_buckets']}")
+        if plan.compression != exp["optimal_compression"]:
+            errors.append(f"compression {plan.compression} != "
+                          f"{exp['optimal_compression']}")
         if abs(plan.predicted_step_us - exp["predicted_step_us"]) > 1e-3:
             errors.append(f"predicted {plan.predicted_step_us} != "
                           f"{exp['predicted_step_us']}")
         if abs(plan.baseline_step_us - exp["baseline_us"]) > 1e-3:
             errors.append(f"baseline {plan.baseline_step_us} != "
                           f"{exp['baseline_us']}")
-        search = summary["steps"][0]["what_if"].get("bucket_search", [])
+        wi = summary["steps"][0]["what_if"]
+        search = wi.get("bucket_search", [])
         got_k = {r["num_buckets"]: r["predicted_step_us"] for r in search}
         for k, us in exp["bucket_search_us"].items():
             if abs(got_k.get(int(k), -1.0) - us) > 1e-3:
                 errors.append(f"bucket_search[{k}] {got_k.get(int(k))} "
                               f"!= {us}")
+        by_name = {s["scenario"]: s["predicted_step_us"]
+                   for s in wi["scenarios"]}
+        if abs(by_name.get("compress_int8", -1.0)
+               - exp["compress_int8_us"]) > 1e-3:
+            errors.append(f"compress_int8 {by_name.get('compress_int8')} "
+                          f"!= {exp['compress_int8_us']}")
 
-        # 2. closed loop, verified: the simulated job realizes the
-        # predicted step time — realized speedup must land inside the
-        # guard band and the plan must stay applied
-        applied: list = []
-        tuner = ProfileGuidedTuner(
-            analyze_fn=lambda: summary,
-            apply_fn=applied.append,
-            window_steps=4, guard_band_pct=10.0, rollback=True)
-        for _ in range(4):                      # baseline window: 440 µs
-            tuner.on_step(exp["baseline_us"] * 1e-6)
-        if not applied or not isinstance(applied[-1], FusionPlanSpec):
-            errors.append("loop did not apply a plan after the baseline "
-                          "window")
-        else:
-            for _ in range(4):                  # verify window: 300 µs
-                tuner.on_step(exp["predicted_step_us"] * 1e-6)
-            last = tuner.history[-1]
-            if last.get("outcome") != "verified":
-                errors.append(f"verify outcome {last.get('outcome')!r}, "
-                              "want 'verified'")
-            realized = last.get("realized_speedup_pct", 0.0)
-            predicted = exp["predicted_speedup_pct"]
-            if abs(realized - predicted) > 10.0:
-                errors.append(f"realized {realized}% not within guard "
-                              f"band of predicted {predicted}%")
+        # 2. closed loop, verified, decision served: the simulated job
+        # realizes the predicted step time — realized speedup must land
+        # inside the guard band, the plan must stay applied, and the
+        # rendezvous /autotune table must show the compression decision
+        server = RendezvousServer()
+        server.start()
+        try:
+            applied: list = []
+            tuner = ProfileGuidedTuner(
+                analyze_fn=lambda: summary,
+                apply_fn=applied.append,
+                window_steps=4, guard_band_pct=10.0, rollback=True,
+                push_target=("127.0.0.1", server.port, None))
+            for _ in range(4):                  # baseline window: 440 µs
+                tuner.on_step(exp["baseline_us"] * 1e-6)
+            if not applied or not isinstance(applied[-1], FusionPlanSpec):
+                errors.append("loop did not apply a plan after the "
+                              "baseline window")
+            else:
+                for _ in range(4):              # verify window: 250.25 µs
+                    tuner.on_step(exp["predicted_step_us"] * 1e-6)
+                last = tuner.history[-1]
+                if last.get("outcome") != "verified":
+                    errors.append(f"verify outcome "
+                                  f"{last.get('outcome')!r}, "
+                                  "want 'verified'")
+                realized = last.get("realized_speedup_pct", 0.0)
+                predicted = exp["predicted_speedup_pct"]
+                if abs(realized - predicted) > 10.0:
+                    errors.append(f"realized {realized}% not within "
+                                  f"guard band of predicted {predicted}%")
+                report = get_autotune("127.0.0.1", server.port)
+                current = report.get("current") or {}
+                if current.get("compression") != \
+                        exp["optimal_compression"]:
+                    errors.append(
+                        "GET /autotune does not show the compression "
+                        f"decision: {current.get('compression')} != "
+                        f"{exp['optimal_compression']}")
+                if current.get("outcome") != "verified":
+                    errors.append("GET /autotune outcome "
+                                  f"{current.get('outcome')!r}")
+        finally:
+            server.stop()
 
         # 3. closed loop, regression: a job that does NOT realize the
         # prediction must roll the plan back
@@ -124,8 +160,11 @@ def run_check() -> int:
         return 1
     print(f"hvd_autotune --check OK: recovered "
           f"{exp['optimal_num_buckets']}-bucket plan "
-          f"{exp['optimal_buckets']} at {exp['predicted_step_us']:.0f} us "
-          f"(hand-computed), verified in-band, rollback exercised")
+          f"{exp['optimal_buckets']} with wire formats "
+          f"{exp['optimal_compression']} at "
+          f"{exp['predicted_step_us']:.2f} us (hand-computed), verified "
+          "in-band, compression decision served on GET /autotune, "
+          "rollback exercised")
     return 0
 
 
@@ -137,7 +176,10 @@ def _print_text(plan: FusionPlanSpec, summary: dict) -> None:
           f"{plan.predicted_step_us:.1f} us "
           f"({plan.predicted_speedup_pct:+.1f}%)")
     for i, bucket in enumerate(plan.buckets):
-        print(f"  bucket {i}: {', '.join(bucket)}")
+        comp = plan.compression[i] if plan.compression \
+            and i < len(plan.compression) and plan.compression[i] \
+            else "uncompressed"
+        print(f"  bucket {i} [{comp}]: {', '.join(bucket)}")
     print(f"overlap: {plan.overlap}  "
           f"cycle_flush_steps: {plan.cycle_flush_steps}")
     print("\napply live: make_train_step(..., profile_guided=True) "

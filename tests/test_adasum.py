@@ -165,3 +165,62 @@ def test_adasum_zero_rank_contributes_as_sum(hvd_init, rng):
 
     out = hvd.get_per_rank(step(np.stack(xs)))
     np.testing.assert_allclose(out[0], xs[3], rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# convergence parity (wire-efficiency tier satellite)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_adasum_vs_sgd_convergence_parity(hvd_init, rng):
+    """Adasum's scale-invariance contract, pinned end-to-end: training a
+    small MLP with ``op=Adasum`` at learning rate η must converge like
+    plain SGD at the linearly-scaled rate n·η (the per-rank gradients of
+    a sharded batch are near-orthogonal, where the Adasum merge is a
+    sum), while SGD at the UNscaled η lags far behind — i.e. Adasum buys
+    the large-effective-batch speedup without retuning the LR (reference
+    adasum.h:167-195 rationale)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    model = MLP()
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    data_rng = np.random.default_rng(3)
+    X = data_rng.normal(size=(32, 8)).astype(np.float32)
+    Y = data_rng.integers(0, 4, size=(32,)).astype(np.int32)
+
+    def train(op, lr, steps=150):
+        opt = optax.sgd(lr)
+        step = make_train_step(
+            apply_fn=lambda v, x: model.apply(v, x), loss_fn=loss_fn,
+            optimizer=opt, op=op)
+        state = init_train_state(model, opt, jnp.zeros((2, 8)))
+        x, y = shard_batch(X), shard_batch(Y)
+        loss = None
+        for _ in range(steps):
+            state, loss = step(state, x, y)
+        return float(loss)
+
+    lr, n = 0.05, hvd.size()
+    adasum = train(hvd.Adasum, lr)
+    sgd_scaled = train(hvd.Average, lr * n)
+    sgd_unscaled = train(hvd.Average, lr)
+    # pinned tolerance: parity with the n·η-scaled SGD run
+    assert adasum == pytest.approx(sgd_scaled, abs=1e-3)
+    # and the parity is not vacuous — unscaled SGD is far behind both
+    assert sgd_unscaled > adasum + 0.1

@@ -72,6 +72,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_probe", lambda: "ok")
     monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
+    monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
@@ -103,6 +104,7 @@ def test_autotune_delta_merged_into_tail(monkeypatch, capsys):
         return FakeProc(json.dumps(payload))
 
     monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     monkeypatch.delenv("HVD_BENCH_AUTOTUNE", raising=False)
     bench.main()
@@ -133,6 +135,7 @@ def test_autotune_leg_failure_cannot_cost_the_main_number(monkeypatch,
         return FakeProc()
 
     monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     monkeypatch.delenv("HVD_BENCH_AUTOTUNE", raising=False)
     bench.main()
@@ -140,6 +143,100 @@ def test_autotune_leg_failure_cannot_cost_the_main_number(monkeypatch,
     assert out["value"] == 2700.0
     assert out["autotune_delta_pct"] is None
     assert "timeout" in out["autotune_error"]
+
+
+def test_compression_delta_merged_into_tail(monkeypatch, capsys):
+    """The compressed comparison leg (error-feedback int8,
+    docs/compression.md) lands in the JSON tail as
+    compressed_img_sec_per_chip + compression_delta_pct."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        def __init__(self, line):
+            self.returncode = 0
+            self.stdout = "RESULT " + line + "\n"
+            self.stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        if "--child-compression" in cmd:
+            return FakeProc(json.dumps({"img_sec_per_chip": 2646.0}))
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_COMPRESSION", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["compressed_img_sec_per_chip"] == 2646.0
+    assert out["compression_delta_pct"] == -2.0
+    assert any("--child-compression" in c for c in calls)
+
+
+def test_compression_leg_failure_cannot_cost_the_main_number(monkeypatch,
+                                                             capsys):
+    """A hung compression leg degrades to compression_delta_pct: None —
+    the default number still publishes (the acceptance contract)."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        returncode = 0
+        stdout = "RESULT " + json.dumps(payload) + "\n"
+        stderr = ""
+
+    def fake_run(cmd, *a, **k):
+        if "--child-compression" in cmd:
+            raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+        return FakeProc()
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_COMPRESSION", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["compression_delta_pct"] is None
+    assert "timeout" in out["compression_error"]
+
+
+def test_compression_leg_skippable(monkeypatch, capsys):
+    """HVD_BENCH_COMPRESSION=0 skips the leg entirely — no child run,
+    no tail fields."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        returncode = 0
+        stdout = "RESULT " + json.dumps(payload) + "\n"
+        stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        return FakeProc()
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("HVD_BENCH_COMPRESSION", "0")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "compression_delta_pct" not in out
+    assert not any("--child-compression" in c for c in calls)
 
 
 def test_run_timeout_retries_then_skips(monkeypatch, capsys):

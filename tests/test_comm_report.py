@@ -200,3 +200,103 @@ def test_latency_term_separates_fused_from_per_tensor():
     # β term alone is ~size-independent for a ring: t_comm grows with
     # (n-1)/n; the split curve must degrade faster with n than fused
     assert (eff_fused[8] - eff_fused[64]) < (eff_split[8] - eff_split[64])
+
+
+# ---------------------------------------------------------------------------
+# wire-efficiency tier: dtype byte table + compression/two-level pricing
+# ---------------------------------------------------------------------------
+def test_dtype_bytes_table_pinned():
+    """SATELLITE pin: the compressed-wire dtypes must be billed at their
+    real sizes — a missing entry counts the collective as 0 bytes and
+    the traffic report under-models exactly the payloads compression
+    shrinks (int8/uint8 = 1, fp8 families = 1, bf16 = 2, f32 = 4)."""
+    from horovod_tpu.timeline.comm_report import _DTYPE_BYTES, _array_bytes
+
+    expected = {"s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+                "f8e4m3": 1, "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+                "pred": 1, "c64": 8, "c128": 16}
+    for dtype, size in expected.items():
+        assert _DTYPE_BYTES[dtype] == size, dtype
+        # 128-element payload of each dtype bills exactly 128*size
+        assert _array_bytes(f"{dtype}[128]") == 128 * size, dtype
+    # a quantized-allreduce HLO result shape bills at 1 byte/element
+    assert _array_bytes("s8[1024,1024]") == 1 << 20
+    assert _array_bytes("f8e4m3fn[1024,1024]") == 1 << 20
+
+
+def test_predict_collective_us_compression_pinned():
+    """Compression cost curves, hand-computed at world 8 / ICI defaults
+    (186 GB/s, 1 µs hop; COMPRESSION_MODEL: int8 = ¼ wire bytes +
+    1 µs/MiB qd + one scalar scale all-reduce's α = 14 hops):
+
+    64 MiB f32 flat:  1.75·64 MiB/186e9 + 14        = 645.40 µs
+    64 MiB int8:      ¼·β(157.85) + 14 + 64 + 14    = 249.85 µs  (2.6x)
+    1 MiB int8:       ¼·β(2.466) + 14 + 1 + 14      =  31.47 µs
+    1 MiB f32 flat:   β(9.866) + 14                 =  23.87 µs
+    — compression LOSES on small payloads (the scale-exchange α
+    dominates), which is why the planner chooses per bucket."""
+    from horovod_tpu.timeline.comm_report import predict_collective_us
+
+    MiB = 1 << 20
+    assert predict_collective_us("all-reduce", 64 * MiB, 8) == \
+        pytest.approx(645.40, abs=0.01)
+    assert predict_collective_us(
+        "all-reduce", 64 * MiB, 8, compression="int8") == \
+        pytest.approx(249.85, abs=0.01)
+    # bf16: ½·β(631.40) + 14 + 32 qd, no scale exchange = 361.70 µs
+    big_bf16 = predict_collective_us("all-reduce", 64 * MiB, 8,
+                                     compression="bf16")
+    assert big_bf16 == pytest.approx(361.70, abs=0.01)
+    # small payload: int8 costs MORE than shipping f32
+    small_raw = predict_collective_us("all-reduce", MiB, 8)
+    small_int8 = predict_collective_us("all-reduce", MiB, 8,
+                                       compression="int8")
+    assert small_int8 == pytest.approx(31.47, abs=0.01)
+    assert small_raw == pytest.approx(23.87, abs=0.01)
+    assert small_int8 > small_raw
+    # already-narrow payloads never bill below 1x (ratio clamps at 1)
+    assert predict_collective_us(
+        "all-reduce", MiB, 8, compression="bf16", orig_itemsize=2) >= \
+        small_raw
+
+
+def test_predict_collective_us_two_level_pinned():
+    """Two-level shape (64 MiB, 8 ranks = 4 local x 2 cross, DCN
+    defaults 25 GB/s / 10 µs hop): local RS+AG move 2·(3/4)·64 MiB on
+    ICI (+ 6 ICI hops), the cross all-reduce moves (1/2)·2·16 MiB shard
+    on DCN (+ 2 DCN hops); int8 shrinks ONLY the cross/DCN stage."""
+    from horovod_tpu.timeline.comm_report import predict_collective_us
+
+    MiB = 1 << 20
+    tl = predict_collective_us("all-reduce", 64 * MiB, 8,
+                               two_level=True, local_size=4)
+    assert tl == pytest.approx(1238.29, abs=0.01)
+    tl_int8 = predict_collective_us("all-reduce", 64 * MiB, 8,
+                                    two_level=True, local_size=4,
+                                    compression="int8")
+    assert tl_int8 == pytest.approx(770.97, abs=0.01)
+    # vs the honest multi-host flat baseline (the whole ring at DCN
+    # bandwidth): two-level + int8 wins big
+    flat_dcn = predict_collective_us("all-reduce", 64 * MiB, 8,
+                                     ici_bytes_per_sec=25e9)
+    assert flat_dcn > 2 * tl_int8
+    # un-decomposable topologies fall back to the flat shape — the
+    # model mirrors two_level_allreduce's runtime degrade
+    flat = predict_collective_us("all-reduce", 64 * MiB, 8)
+    for bad_local in (None, 1, 3, 8):
+        assert predict_collective_us(
+            "all-reduce", 64 * MiB, 8, two_level=True,
+            local_size=bad_local) == pytest.approx(flat)
+
+
+def test_model_scaling_with_compression_improves_efficiency():
+    """The SCALING.md story: the same collective profile, modeled with
+    int8 gradients, keeps more efficiency at every world size."""
+    from horovod_tpu.timeline.comm_report import model_scaling
+
+    cols = {"all-reduce": {"count": 4, "bytes": 100 * (1 << 20)}}
+    _, eff_raw = model_scaling(cols, 0.05)
+    _, eff_c = model_scaling(cols, 0.05, compression="int8")
+    for n in (8, 16, 32, 64):
+        assert eff_c[n] > eff_raw[n]
+        assert 0.0 < eff_raw[n] < 1.0

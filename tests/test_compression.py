@@ -1,0 +1,546 @@
+"""Wire-efficiency tier: error-feedback fp8/int8 compression, two-level
+reduction, and their cost curves (docs/compression.md).
+
+Ground truth comes from the numpy mirrors in ops/compression.py (the
+``numpy_adasum`` pattern): quantization round-trip error bounds are
+pinned analytically, the device compressors must match the oracle, the
+error-feedback residual must stay bounded over N steps (the DGC/1-bit-
+Adam property), and an injected residual blow-up must trip the
+convergence guard into the uncompressed fall-back with training intact.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics
+from horovod_tpu.ops.compression import (
+    BF16Compressor,
+    Compression,
+    ErrorFeedback,
+    ErrorFeedbackGuard,
+    FP8Compressor,
+    Int8Compressor,
+    numpy_dequantize,
+    numpy_error_feedback_reduce,
+    numpy_quantize,
+)
+from horovod_tpu.ops.fusion import allreduce_pytree
+from horovod_tpu.parallel.hierarchical import two_level_allreduce
+from horovod_tpu.training import (
+    TrainState, init_train_state, make_train_step, shard_batch,
+)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def test_registry_lookup_names():
+    assert Compression.lookup("int8") is Int8Compressor
+    assert Compression.lookup("fp8") is FP8Compressor
+    assert Compression.lookup("bf16") is BF16Compressor
+    assert Compression.lookup("fp16") is BF16Compressor   # parity alias
+    assert Compression.lookup(None) is Compression.none
+    assert Compression.lookup("") is Compression.none
+    ef = Compression.lookup("int8", error_feedback=True)
+    assert isinstance(ef, ErrorFeedback)
+    assert ef.compressor is Int8Compressor
+    # ef_ prefix round-trips (the name FusionPlanSpec records)
+    ef2 = Compression.lookup("ef_int8")
+    assert isinstance(ef2, ErrorFeedback)
+    # error feedback around none is the identity choice, not a wrapper
+    assert Compression.lookup("none", error_feedback=True) \
+        is Compression.none
+    with pytest.raises(ValueError, match="unknown compression"):
+        Compression.lookup("zstd")
+
+
+def test_wire_itemsize_agrees_with_cost_model():
+    """The compressors' wire bytes and comm_report's cost curves must
+    never drift apart — the planner prices what the ops layer ships."""
+    from horovod_tpu.timeline.comm_report import COMPRESSION_MODEL
+
+    for name in ("bf16", "int8", "fp8", "fp8_e4m3", "fp8_e5m2"):
+        assert Compression.lookup(name).wire_itemsize == \
+            COMPRESSION_MODEL[name]["itemsize"], name
+        assert Compression.lookup(name).scale_exchange == \
+            COMPRESSION_MODEL[name]["scale_exchange"], name
+
+
+# ---------------------------------------------------------------------------
+# numpy ground truth: round-trip error bounds
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("group_size", [1, 8])
+def test_numpy_int8_roundtrip_bound(group_size):
+    """|x - dq(q(x))| <= 0.5 * scale * group / 127 — half the int8 grid
+    spacing after the summation-headroom division."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(257,)).astype(np.float32)
+    q, factor = numpy_quantize(x, group_size=group_size, wire="int8")
+    scale = float(np.max(np.abs(x)))
+    assert factor == pytest.approx(scale * group_size / 127.0)
+    err = np.abs(numpy_dequantize(q, factor) - x)
+    # interior elements sit within half a grid step; the max-|x| element
+    # may lose up to one step to the no-wrap headroom clip
+    assert err.max() <= factor + 1e-12
+    interior = np.abs(x) < scale * (1 - 1.0 / 127)
+    assert err[interior].max() <= 0.5 * factor + 1e-12
+    # headroom: the sum of group_size maximal payloads cannot wrap int8
+    assert np.abs(q.astype(np.int64)).max() * group_size <= 127
+
+
+@pytest.mark.parametrize("wire,rel", [("fp8_e4m3", 2 ** -3),
+                                      ("fp8_e5m2", 2 ** -2)])
+def test_numpy_fp8_roundtrip_bound(wire, rel):
+    """fp8 round-trip error is RELATIVE (float grid): e4m3 carries 3
+    mantissa bits (eps 2^-3), e5m2 two (2^-2)."""
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(257,)).astype(np.float32)
+    q, factor = numpy_quantize(x, group_size=1, wire=wire)
+    err = np.abs(numpy_dequantize(q, factor) - x)
+    # relative to each element's magnitude, floored by the subnormal grid
+    bound = np.maximum(np.abs(x) * rel, float(np.max(np.abs(x))) * 2e-3)
+    assert (err <= bound + 1e-12).all()
+
+
+def test_device_compressor_matches_numpy_oracle():
+    """int8 must match the oracle exactly (integer rounding is robust);
+    the fp8 casts may differ by ONE grid step where the f32 intermediate
+    lands on a rounding midpoint (XLA fuses the divide+multiply, numpy
+    doesn't — a one-ULP intermediate difference flips the tie)."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(64,)).astype(np.float32)
+    for name, rel in (("int8", 0.0), ("fp8_e4m3", 2 ** -3),
+                      ("fp8_e5m2", 2 ** -2)):
+        comp = Compression.lookup(name)
+        c, ctx = comp.compress_for(jnp.asarray(x), 4)
+        dev = np.asarray(comp.decompress(c, ctx))
+        q, factor = numpy_quantize(x, group_size=4, wire=name)
+        oracle = numpy_dequantize(q, factor)
+        if rel == 0.0:
+            np.testing.assert_allclose(dev, oracle, rtol=1e-6, atol=1e-6,
+                                       err_msg=name)
+        else:
+            err = np.abs(dev - oracle)
+            assert (err <= np.abs(oracle) * rel + 1e-6).all(), name
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: non-float leaves pass through untouched
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("comp", [BF16Compressor, Int8Compressor,
+                                  FP8Compressor])
+@pytest.mark.parametrize("val", [
+    np.arange(5, dtype=np.int32),
+    np.array([True, False, True]),
+    np.array([1 + 2j, 3 - 4j], dtype=np.complex64),
+    np.arange(3, dtype=np.int16),
+])
+def test_non_float_leaves_pass_through(comp, val):
+    c, ctx = comp.compress_for(jnp.asarray(val), 8)
+    assert c.dtype == val.dtype          # no silent cast on the wire
+    out = np.asarray(comp.decompress(c, ctx))
+    assert out.dtype == val.dtype
+    np.testing.assert_array_equal(out, val)
+
+
+def test_allreduce_pytree_compression_keeps_int_leaves_exact(hvd_init, rng):
+    """The original bug shape: an integer leaf routed through
+    allreduce_pytree(compression=...) must sum exactly."""
+    xs = [rng.normal(size=(9,)).astype(np.float32) for _ in range(8)]
+    counts = np.arange(6, dtype=np.int32)
+    specs = {"w": P(hvd.AXIS), "n": P(hvd.AXIS)}
+
+    for comp in (Compression.fp16, Compression.int8, Compression.fp8):
+        @hvd.spmd(in_specs=(specs,), out_specs=specs)
+        def step(t):
+            r = allreduce_pytree({"w": t["w"][0], "n": t["n"][0]},
+                                 op=hvd.Sum, compression=comp)
+            return {k: v[None] for k, v in r.items()}
+
+        out = step({"w": np.stack(xs), "n": np.stack([counts] * 8)})
+        n_out = hvd.get_per_rank(out["n"])[0]
+        np.testing.assert_array_equal(n_out, counts * 8)
+        assert n_out.dtype == np.int32
+
+
+# ---------------------------------------------------------------------------
+# compressed allreduce on the mesh vs the oracle
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("name", ["int8", "fp8"])
+def test_compressed_allreduce_within_quant_bound(hvd_init, rng, name):
+    xs = [rng.normal(size=(3, 11)).astype(np.float32) for _ in range(8)]
+    mean = np.mean(xs, axis=0)
+    comp = Compression.lookup(name)
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS),), out_specs=P(hvd.AXIS))
+    def step(x):
+        return allreduce_pytree(x[0], compression=comp)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))[0]
+    scale = float(np.abs(np.stack(xs)).max())
+    # mean of 8 per-rank errors, each bounded by half the headroomed grid
+    bound = 0.5 * scale * 8 / 127 if name == "int8" else scale * 0.1
+    assert np.abs(out - mean).max() <= bound + 1e-6
+
+
+def test_error_feedback_matches_numpy_oracle_over_steps(hvd_init, rng):
+    """Device EF loop == numpy_error_feedback_reduce, step for step."""
+    n = 8
+    grads = [rng.normal(size=(17,)).astype(np.float32) for _ in range(n)]
+    ef = ErrorFeedback(Compression.int8)
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS), P(hvd.AXIS)),
+              out_specs=(P(hvd.AXIS), P(hvd.AXIS)))
+    def step(g, r):
+        out, nr = allreduce_pytree(g[0], compression=ef, residual=r[0])
+        return out[None], nr[None]
+
+    res_dev = np.zeros((n, 17), np.float32)
+    res_np = [np.zeros(17) for _ in range(n)]
+    for _ in range(4):
+        out, nr = step(np.stack(grads), res_dev)
+        out_np, res_np = numpy_error_feedback_reduce(grads, res_np)
+        res_dev = np.stack(hvd.get_per_rank(nr)).reshape(n, 17)
+        np.testing.assert_allclose(hvd.get_per_rank(out)[0], out_np,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(res_dev, np.stack(res_np),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("wire", ["int8", "fp8_e4m3"])
+def test_error_feedback_residual_decay_bound(wire):
+    """The DGC property, on the numpy oracle: over N steps of a constant
+    gradient, the residual norm stays BOUNDED (it does not grow with N)
+    and the accumulated applied update tracks N*mean(grad) to within one
+    step's quantization error — the telescoping sum
+    sum_k(applied_k) = N*g - mean(residual_N)."""
+    rng = np.random.default_rng(11)
+    n, steps = 4, 32
+    grads = [rng.normal(size=(41,)) for _ in range(n)]
+    mean = np.mean(grads, axis=0)
+    res = [np.zeros(41) for _ in range(n)]
+    applied = np.zeros(41)
+    norms = []
+    for _ in range(steps):
+        out, res = numpy_error_feedback_reduce(grads, res, wire=wire)
+        applied += out
+        norms.append(max(np.linalg.norm(r) for r in res))
+    scale = max(np.abs(np.asarray(grads)).max(), 1e-30)
+    step_bound = scale * n  # one grid step of the headroomed quantizer
+    assert max(norms) <= step_bound          # bounded, not growing
+    assert norms[-1] <= 2 * np.median(norms) + 1e-9
+    drift = np.abs(applied - steps * mean).max()
+    assert drift <= step_bound / n + 1e-9    # residual/n, NOT O(steps)
+    # WITHOUT error feedback the bias accumulates linearly — the
+    # contrast that makes the residual carry worth its state
+    applied_nofb = np.zeros(41)
+    for _ in range(steps):
+        out, _ = numpy_error_feedback_reduce(
+            grads, [np.zeros(41)] * n, wire=wire)
+        applied_nofb += out
+    drift_nofb = np.abs(applied_nofb - steps * mean).max()
+    assert drift_nofb >= drift  # EF is never worse; usually ~N x better
+
+
+# ---------------------------------------------------------------------------
+# acceptance: error-feedback int8 training parity + guard fall-back
+# ---------------------------------------------------------------------------
+def _mlp_setup():
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    model = MLP()
+    opt = optax.sgd(0.05)
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    Y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+    return model, opt, loss_fn, X, Y
+
+
+def _train(model, opt, loss_fn, X, Y, compression, steps=30, **kw):
+    step = make_train_step(
+        apply_fn=lambda v, x: model.apply(v, x), loss_fn=loss_fn,
+        optimizer=opt, compression=compression, **kw)
+    state = init_train_state(model, opt, jnp.zeros((2, 8)),
+                             compression=compression)
+    x, y = shard_batch(X), shard_batch(Y)
+    loss = None
+    for _ in range(steps):
+        state, loss = step(state, x, y)
+    return step, state, float(loss)
+
+
+def test_error_feedback_int8_training_loss_parity(hvd_init):
+    """ACCEPTANCE: error-feedback int8 allreduce matches uncompressed
+    training loss within a pinned tolerance (tiny MLP, 30 SGD steps)."""
+    model, opt, loss_fn, X, Y = _mlp_setup()
+    _, _, base = _train(model, opt, loss_fn, X, Y, Compression.none)
+    _, s_ef, ef = _train(model, opt, loss_fn, X, Y,
+                         ErrorFeedback(Compression.int8))
+    assert ef == pytest.approx(base, abs=0.01)   # pinned tolerance
+    # the residual state exists, is float, and is bounded
+    leaves = jax.tree_util.tree_leaves(s_ef.residual)
+    assert leaves and all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # stateless quantization also trains on this toy surface (the EF-vs-
+    # raw drift contrast is pinned deterministically on the numpy oracle
+    # in test_error_feedback_residual_decay_bound)
+    _, _, raw = _train(model, opt, loss_fn, X, Y, Compression.int8)
+    assert abs(raw - base) < 0.01
+
+
+def test_residual_blowup_trips_fallback_and_training_continues(
+        hvd_init, monkeypatch):
+    """ACCEPTANCE: an injected residual blow-up increments the fallback
+    counter and the job keeps training, uncompressed."""
+    monkeypatch.setenv("HVD_COMPRESSION_GUARD_STEPS", "1")
+    model, opt, loss_fn, X, Y = _mlp_setup()
+    comp = ErrorFeedback(Compression.int8)
+    step = make_train_step(
+        apply_fn=lambda v, x: model.apply(v, x), loss_fn=loss_fn,
+        optimizer=opt, compression=comp)
+    state = init_train_state(model, opt, jnp.zeros((2, 8)),
+                             compression=comp)
+    x, y = shard_batch(X), shard_batch(Y)
+    for _ in range(4):                       # healthy baseline windows
+        state, _ = step(state, x, y)
+    before = metrics.COMPRESSION_FALLBACKS.get()
+    # inject the blow-up: a residual 1e7x any gradient — the next
+    # reduction consumes it, leaving a quantization error ~1e7x baseline
+    state = state._replace(residual=jax.tree_util.tree_map(
+        lambda r: r + 1e7, state.residual))
+    state, _ = step(state, x, y)
+    assert metrics.COMPRESSION_FALLBACKS.get() == before + 1
+    assert metrics.COMPRESSION_RESIDUAL_NORM.get() > 0
+    # training continues, uncompressed: residual passes through frozen
+    frozen = jax.tree_util.tree_map(np.asarray, state.residual)
+    for _ in range(3):
+        state, loss = step(state, x, y)
+    assert np.isfinite(float(loss))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        frozen, state.residual)
+
+
+def test_guard_unit_behavior():
+    g = ErrorFeedbackGuard(factor=10.0, warmup=3)
+    assert not g.observe(1.0)
+    assert not g.observe(1.2)
+    assert not g.observe(0.8)       # baseline = median(1.0, 1.2, 0.8)
+    assert not g.observe(5.0)       # within 10x
+    assert g.observe(11.0)          # diverged
+    g2 = ErrorFeedbackGuard(factor=10.0, warmup=2)
+    assert g2.observe(float("nan")) # non-finite trips immediately
+    assert g2.observe(float("inf"))
+
+
+def test_ef_scan_requires_initialized_residual(hvd_init):
+    model, opt, loss_fn, X, Y = _mlp_setup()
+    step = make_train_step(
+        apply_fn=lambda v, x: model.apply(v, x), loss_fn=loss_fn,
+        optimizer=opt, compression=ErrorFeedback(Compression.int8),
+        in_graph_steps=2)
+    state = init_train_state(model, opt, jnp.zeros((2, 8)))  # no residual
+    with pytest.raises(ValueError, match="in_graph_steps"):
+        step(state, shard_batch(X), shard_batch(Y))
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer carries the residual in optax state
+# ---------------------------------------------------------------------------
+def test_distributed_optimizer_error_feedback_state(hvd_init, rng):
+    from horovod_tpu.optim.distributed import (
+        DistributedOptimizer, _ErrorFeedbackState,
+    )
+
+    ef = ErrorFeedback(Compression.int8)
+    dopt = DistributedOptimizer(optax.sgd(0.1), compression=ef)
+    params = {"w": jnp.asarray(rng.normal(size=(13,)).astype(np.float32))}
+    state0 = dopt.init(params)
+    assert isinstance(state0, _ErrorFeedbackState)
+    assert float(jnp.abs(state0.residual["w"]).max()) == 0.0
+
+    gs = [rng.normal(size=(13,)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd(in_specs=(P(hvd.AXIS),), out_specs=(P(hvd.AXIS), P()))
+    def apply_once(g):
+        updates, new_state = dopt.update({"w": g[0]}, state0, params)
+        return updates["w"][None], new_state
+
+    upd, new_state = apply_once(np.stack(gs))
+    mean = np.mean(gs, axis=0)
+    scale = float(np.abs(np.stack(gs)).max())
+    got = np.asarray(hvd.get_per_rank(upd)[0])
+    assert np.abs(got + 0.1 * mean).max() <= 0.1 * scale * 8 / 127 + 1e-6
+    # the residual moved off zero — the carry is live state
+    assert float(jnp.abs(new_state.residual["w"]).max()) > 0.0
+
+    with pytest.raises(ValueError, match="Adasum"):
+        DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum, compression=ef)
+
+
+# ---------------------------------------------------------------------------
+# two-level allreduce (satellite: non-pow2 degrade, not raise)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("shape", [(8,), (7,), (3, 5)])
+def test_two_level_matches_flat_uncompressed(hvd_init, rng, shape):
+    xs = [rng.normal(size=shape).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return two_level_allreduce(x[0], op=hvd.Sum)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))
+    expected = np.sum(np.stack(xs), axis=0)
+    for o in out:
+        np.testing.assert_allclose(o, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_two_level_compressed_within_bound(hvd_init, rng):
+    """4 local x 2 cross: int8 rides only the cross stage, so the error
+    bound is the CROSS group's (2 summands), on local-sum magnitudes."""
+    xs = [rng.normal(size=(33,)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return two_level_allreduce(
+            x[0], op=hvd.Average, compression=Compression.int8)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))[0]
+    mean = np.mean(xs, axis=0)
+    local_sums = [np.sum(xs[i:i + 4], axis=0) for i in (0, 4)]
+    scale = float(np.abs(np.stack(local_sums)).max())
+    bound = 0.5 * scale * 2 / 127 / 8 * 2   # grid/2 per cross rank, /N
+    assert np.abs(out - mean).max() <= bound + 1e-6
+
+
+def test_two_level_non_pow2_cross_degrades_to_flat(cpu_devices, rng):
+    """SATELLITE: a 3-host world (6 ranks, local 2) must degrade to the
+    flat path with a warning counter — never raise mid-step."""
+    hvd.shutdown()
+    hvd.init(devices=cpu_devices[:6], local_size=2)
+    try:
+        assert hvd.cross_size() == 3            # non-pow2
+        before = metrics.TWO_LEVEL_FALLBACKS.get()
+        xs = [rng.normal(size=(5,)).astype(np.float32) for _ in range(6)]
+
+        @hvd.spmd
+        def step(x):
+            return two_level_allreduce(x[0], op=hvd.Sum)[None]
+
+        out = hvd.get_per_rank(step(np.stack(xs)))
+        expected = np.sum(np.stack(xs), axis=0)
+        for o in out:
+            np.testing.assert_allclose(o, expected, rtol=1e-5, atol=1e-5)
+        assert metrics.TWO_LEVEL_FALLBACKS.get() == before + 1
+    finally:
+        hvd.shutdown()
+
+
+def test_two_level_error_feedback_unwraps_to_inner(hvd_init, rng):
+    """EF over two-level degrades to the stateless inner compressor
+    (residuals are full-tensor-shaped; the cross-stage error lives on
+    the shard) — documented contract, must not crash."""
+    xs = [rng.normal(size=(8,)).astype(np.float32) for _ in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return two_level_allreduce(
+            x[0], compression=ErrorFeedback(Compression.int8))[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))[0]
+    assert np.isfinite(out).all()
+
+
+def test_two_level_int_payload_uncompressed_exact(hvd_init):
+    xs = [np.arange(6, dtype=np.int32) + r for r in range(8)]
+
+    @hvd.spmd
+    def step(x):
+        return two_level_allreduce(
+            x[0], op=hvd.Sum, compression=Compression.int8)[None]
+
+    out = hvd.get_per_rank(step(np.stack(xs)))[0]
+    np.testing.assert_array_equal(out, np.sum(np.stack(xs), axis=0))
+
+
+# ---------------------------------------------------------------------------
+# tpurun / YAML knob translation (satellite: CI/tooling)
+# ---------------------------------------------------------------------------
+def test_tpurun_compression_env_translation():
+    from horovod_tpu.run.config_parser import (
+        _CONFIG_SCHEMA, env_from_args, set_args_from_config,
+    )
+    from horovod_tpu.run.run import parse_args
+    from horovod_tpu.utils import env as env_util
+
+    args = parse_args(["-np", "2", "--compression", "int8",
+                       "--two-level-allreduce", "dummy.py"])
+    env = env_from_args(args)
+    assert env[env_util.HVD_COMPRESSION] == "int8"
+    assert env[env_util.HVD_TWO_LEVEL_ALLREDUCE] == "1"
+    assert env_util.HVD_COMPRESSION_ERROR_FEEDBACK not in env  # default on
+
+    args = parse_args(["-np", "2", "--compression", "fp8",
+                       "--no-error-feedback", "dummy.py"])
+    env = env_from_args(args)
+    assert env[env_util.HVD_COMPRESSION] == "fp8"
+    assert env[env_util.HVD_COMPRESSION_ERROR_FEEDBACK] == "0"
+
+    # YAML layer carries the same knobs
+    assert _CONFIG_SCHEMA["params"]["compression"] == "compression"
+    assert _CONFIG_SCHEMA["params"]["two_level_allreduce"] == \
+        "two_level_allreduce"
+    args = parse_args(["-np", "2", "dummy.py"])
+    set_args_from_config(
+        args, {"params": {"compression": "bf16",
+                          "two_level_allreduce": True}}, set())
+    env = env_from_args(args)
+    assert env[env_util.HVD_COMPRESSION] == "bf16"
+    assert env[env_util.HVD_TWO_LEVEL_ALLREDUCE] == "1"
+
+
+def test_make_train_step_resolves_compression_from_env(hvd_init,
+                                                       monkeypatch):
+    monkeypatch.setenv("HVD_COMPRESSION", "int8")
+    model, opt, loss_fn, X, Y = _mlp_setup()
+    _, state, loss = _train(model, opt, loss_fn, X, Y, None, steps=3)
+    assert np.isfinite(loss)
+    # EF default on: the residual structure came up with the state
+    assert jax.tree_util.tree_leaves(state.residual)
+
+
+def test_quantizer_headroom_collapse_degrades_to_passthrough():
+    """Review fix: at group sizes where fewer than two quantization
+    levels survive the summation headroom (int8 over >63 ranks), the
+    quantizer must ship uncompressed — not truncate every gradient to
+    zero."""
+    x = jnp.asarray(np.linspace(-1, 1, 32, dtype=np.float32))
+    c, ctx = Int8Compressor.compress_for(x, 128)       # 127/128 < 1 level
+    assert ctx is None and c.dtype == jnp.float32      # passthrough
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(x))
+    # e4m3 collapses later (448/group): 224 is fine, 512 is not
+    c, ctx = FP8Compressor.compress_for(x, 224)
+    assert ctx is not None and c.dtype == jnp.float8_e4m3fn
+    c, ctx = FP8Compressor.compress_for(x, 512)
+    assert ctx is None
+    # the healthy small-group path is untouched
+    c, ctx = Int8Compressor.compress_for(x, 8)
+    assert ctx is not None and c.dtype == jnp.int8

@@ -81,9 +81,22 @@ def test_what_if_emits_machine_readable_plan(autotune_dir, fixture_cm):
     by_name = {s["scenario"]: s for s in wi["scenarios"]}
     sc = by_name["fuse_buckets_2"]
     assert sc["predicted_step_us"] == pytest.approx(
-        AUTOTUNE_EXPECTED["predicted_step_us"])
+        AUTOTUNE_EXPECTED["uncompressed_step_us"])
     assert sc["plan"]["buckets"] == AUTOTUNE_EXPECTED["optimal_buckets"]
     assert sc["plan"]["overlap"] is True
+    # the staged wire-format choice on the winning partition — the plan
+    # the closed loop applies (compression ranked against fusion on the
+    # same scale)
+    cc = by_name["fuse_buckets_2_compressed"]
+    assert cc["predicted_step_us"] == pytest.approx(
+        AUTOTUNE_EXPECTED["predicted_step_us"])
+    assert cc["plan"]["buckets"] == AUTOTUNE_EXPECTED["optimal_buckets"]
+    assert cc["plan"]["compression"] == \
+        AUTOTUNE_EXPECTED["optimal_compression"]
+    # whole-wire compression what-ifs, priced by predict_collective_us
+    assert by_name["compress_int8"]["predicted_step_us"] == pytest.approx(
+        AUTOTUNE_EXPECTED["compress_int8_us"])
+    assert "compress_fp8" in by_name and "compress_bf16" in by_name
     # the serial fuse-all ceiling and the free-channel overlap bound
     assert by_name["fuse_all_comm"]["predicted_step_us"] == pytest.approx(
         AUTOTUNE_EXPECTED["fuse_all_us"])
@@ -846,3 +859,130 @@ def test_profile_guided_drives_train_step(hvd_init, monkeypatch, tmp_path,
     # the rolled-back (threshold-bucketed) step still trains
     state, loss = step(state, x, y)
     assert np.isfinite(float(np.asarray(loss)))
+
+
+# ---------------------------------------------------------------------------
+# wire-efficiency tier: compression + two-level what-ifs
+# ---------------------------------------------------------------------------
+def test_compression_choice_search_recovers_fixture_optimum(autotune_dir,
+                                                            fixture_cm):
+    """The staged per-bucket wire-format search on the hand-computed
+    partition: int8 on the 4 MiB bucket (β/4 beats its qd + scale α),
+    cast-only bf16 on the 0.5 MiB bucket (the scale α wouldn't pay)."""
+    from horovod_tpu.timeline.replay.simulator import (
+        bucket_plan_search, compression_choice_search,
+    )
+
+    _art, dags = stitch(autotune_dir)
+    results = bucket_plan_search(dags[0], fixture_cm)
+    best = results[0]
+    comp, makespan = compression_choice_search(
+        dags[0], fixture_cm, best["node_partition"])
+    # node_partition is in search order; map through _bucket_plan's wire
+    # ordering via the emitted plan instead of assuming it
+    from horovod_tpu.timeline.replay.simulator import _bucket_plan
+
+    plan = _bucket_plan(dags[0], best["node_partition"], makespan,
+                        compression=comp)
+    assert plan["compression"] == AUTOTUNE_EXPECTED["optimal_compression"]
+    assert makespan == pytest.approx(
+        AUTOTUNE_EXPECTED["predicted_step_us"], abs=1e-3)
+
+
+def test_two_level_comm_scenario_priced_by_cost_model(autotune_dir):
+    """two_level_comm appears when the cost model carries a hierarchy
+    (local_size > 1 dividing the world) and prices every all-reduce with
+    predict_collective_us(two_level=True) — absent on flat models."""
+    from horovod_tpu.timeline.comm_report import predict_collective_us
+    from horovod_tpu.timeline.replay.simulator import what_if
+
+    _art, dags = stitch(autotune_dir)
+    dag = dags[0]
+    flat_cm = CostModel(world=2, hop_latency_us=10.0)
+    names = {s["scenario"] for s in what_if(dag, flat_cm)["scenarios"]}
+    assert "two_level_comm" not in names        # no hierarchy to exploit
+
+    cm = CostModel(world=8, hop_latency_us=10.0, local_size=4)
+    wi = what_if(dag, cm)
+    by_name = {s["scenario"]: s for s in wi["scenarios"]}
+    assert "two_level_comm" in by_name
+    # the scenario's durations are exactly the shared cost model's
+    comm = [n for n in dag.nodes if n.kind == "comm"]
+    expected = sum(predict_collective_us(
+        "all-reduce", n.nbytes, 8,
+        ici_hop_latency=10e-6,
+        two_level=True, local_size=4,
+        dcn_bytes_per_sec=cm.dcn_bytes_per_sec,
+        dcn_hop_latency=cm.dcn_hop_latency_us * 1e-6) for n in comm)
+    computes = sum(n.dur_us for n in dag.nodes
+                   if n.kind == "compute") / len(dag.chains)
+    assert by_name["two_level_comm"]["predicted_step_us"] == \
+        pytest.approx(computes + expected, abs=1e-3)
+
+
+def test_compress_scenarios_present_and_ranked(autotune_dir, fixture_cm):
+    """compress_<dtype> what-ifs exist for every registered candidate
+    and land on the same predicted-µs scale as the fusion scenarios."""
+    from horovod_tpu.timeline.replay.simulator import (
+        COMPRESSION_CANDIDATES, what_if,
+    )
+
+    _art, dags = stitch(autotune_dir)
+    wi = what_if(dags[0], fixture_cm)
+    names = [s["scenario"] for s in wi["scenarios"]]
+    for comp in COMPRESSION_CANDIDATES:
+        assert f"compress_{comp}" in names
+    # ranked list is sorted by predicted step time (shared scale)
+    times = [s["predicted_step_us"] for s in wi["scenarios"]]
+    assert times == sorted(times)
+
+
+def test_applied_plan_carries_compression_through_train_step(hvd_init,
+                                                             monkeypatch):
+    """A FusionPlanSpec with per-bucket compression applies through
+    make_train_step's rebuild seam: training proceeds and the lazily
+    initialized error-feedback residual appears in the state."""
+    import optax
+
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(8)(x)
+            return nn.Dense(4)(x)
+
+    model, opt = MLP(), optax.sgd(0.05)
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    step = make_train_step(apply_fn=lambda v, x: model.apply(v, x),
+                           loss_fn=loss_fn, optimizer=opt,
+                           autotune=True)
+    state = init_train_state(model, opt, jnp.zeros((2, 6)))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, 6)).astype(np.float32)
+    Y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    x, y = shard_batch(X), shard_batch(Y)
+    state, _ = step(state, x, y)
+
+    names = ["Dense_0/bias", "Dense_0/kernel", "Dense_1/bias",
+             "Dense_1/kernel"]
+    plan = FusionPlanSpec(buckets=[names[:2], names[2:]],
+                          compression=["int8", "bf16"])
+    step.parameter_manager.apply_plan(plan)
+    import jax as _jax
+
+    for _ in range(3):
+        state, loss = step(state, x, y)
+    assert np.isfinite(float(loss))
+    assert _jax.tree_util.tree_leaves(state.residual)  # EF came up
+    step.parameter_manager.clear_plan()                # rollback path
+    state, loss = step(state, x, y)
+    assert np.isfinite(float(loss))
