@@ -1,16 +1,22 @@
 """Static + runtime collective-correctness analysis.
 
-Two halves of one story — catching "ranks disagree on which collective
+Three legs of one story — catching "ranks disagree on which collective
 runs next" *before* it becomes a hang:
 
 * **hvd_lint** (findings.py / collective_api.py / visitor.py / rules.py /
   cli.py): an AST pass over training code modelling the repo's collective
-  API surface.  Rule catalogue in rules.RULES, user docs in
-  docs/analysis.md, CLI at scripts/hvd_lint.py.
+  API surface.  Rule catalogue in rules.RULES (HVD001–HVD008), user docs
+  in docs/analysis.md, CLI at scripts/hvd_lint.py.
+* **hvd_verify** (schedule/): the interprocedural schedule model checker
+  — call graph + bounded per-rank path enumeration + pairwise per-group
+  sequence compatibility, emitting counterexample traces (HVD009–HVD012,
+  schedule.SCHEDULE_RULES).  CLI at scripts/hvd_verify.py, also
+  reachable as ``hvd_lint --model-check``.
 * **the collective sanitizer** (sanitizer.py): ``HVD_SANITIZER=1`` makes
-  every eager dispatch fingerprint itself and cross-check against all
-  peers through the rendezvous KV store, raising a diagnostic that names
-  the diverging rank and both signatures instead of deadlocking.
+  every eager dispatch fingerprint itself — group- and membership-epoch-
+  aware, vector-clock ordered — and cross-check against its group peers
+  through the rendezvous KV store, raising a diagnostic that names the
+  diverging rank and both signatures instead of deadlocking.
 """
 
 from .findings import (  # noqa: F401
@@ -28,5 +34,14 @@ from .rules import (  # noqa: F401
 )
 from .sanitizer import (  # noqa: F401
     CollectiveDivergenceError,
+    OrderIndex,
     Sanitizer,
 )
+from .schedule import (  # noqa: F401
+    SCHEDULE_RULES,
+    check_paths,
+    check_sources,
+)
+
+#: the full user-facing rule catalogue (linter + model checker)
+ALL_RULES = {**RULES, **SCHEDULE_RULES}

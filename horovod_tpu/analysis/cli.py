@@ -1,7 +1,10 @@
-"""CLI driver for hvd_lint (scripts/hvd_lint.py is the entry point).
+"""CLI drivers for hvd_lint and hvd_verify (scripts/hvd_lint.py and
+scripts/hvd_verify.py are the entry points).
 
 Exit codes: 0 clean, 1 findings, 2 usage error — the shape CI expects
-from a linter.
+from a linter.  ``hvd_lint --model-check`` runs the schedule model
+checker (analysis/schedule/) in the same session and merges its
+HVD009–HVD012 findings into the lint report.
 """
 
 from __future__ import annotations
@@ -12,6 +15,14 @@ from typing import Optional, Sequence
 
 from .findings import render_json, render_text
 from .rules import RULES, lint_paths
+
+
+def _all_rules() -> dict:
+    from .schedule import SCHEDULE_RULES
+
+    merged = dict(RULES)
+    merged.update(SCHEDULE_RULES)
+    return merged
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -34,20 +45,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the rule catalogue and exit")
     p.add_argument("--warnings-ok", action="store_true",
                    help="exit 0 when only warning-severity findings remain")
+    p.add_argument("--model-check", action="store_true",
+                   help="also run the interprocedural schedule model "
+                        "checker (HVD009-HVD012; scripts/hvd_verify.py is "
+                        "the standalone driver)")
     return p
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for rule in sorted(RULES):
-            sev, summary = RULES[rule]
+        rules = _all_rules()
+        for rule in sorted(rules):
+            sev, summary = rules[rule]
             print(f"{rule}  [{sev:7s}]  {summary}")
         return 0
     paths = args.paths or ["."]
     disable = {r.strip() for r in args.disable.split(",") if r.strip()}
     try:
-        findings = lint_paths(paths, disable=disable)
+        if args.model_check:
+            # one walk + read of the tree feeds both analyzers
+            from .findings import sort_findings
+            from .rules import lint_sources, read_sources
+            from .schedule import check_sources
+
+            sources, unreadable = read_sources(paths)
+            findings = sort_findings(
+                unreadable + lint_sources(sources, disable=disable))
+            # both analyzers report unparsable files as HVD000 — keep
+            # one finding per site, not one per analyzer
+            seen = {(f.rule, f.file, f.line, f.col) for f in findings}
+            findings = sort_findings(findings + [
+                f for f in check_sources(sources, disable=disable).findings
+                if (f.rule, f.file, f.line, f.col) not in seen])
+        else:
+            findings = lint_paths(paths, disable=disable)
     except OSError as e:
         print(f"hvd_lint: {e}", file=sys.stderr)
         return 2
@@ -58,3 +90,72 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.warnings_ok and all(f.severity == "warning" for f in findings):
         return 0
     return 1
+
+
+# ---------------------------------------------------------------------------
+# hvd_verify
+# ---------------------------------------------------------------------------
+def build_verify_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvd_verify",
+        description="Whole-program collective-schedule model checker: "
+                    "enumerates per-rank execution paths through "
+                    "rank-tainted control flow interprocedurally, "
+                    "projects each rank's collective sequence per "
+                    "communication group, and proves them pairwise "
+                    "compatible — or prints a counterexample naming the "
+                    "diverging rank set, the collective, and the exact "
+                    "branch chain.",
+    )
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to verify (default: cwd)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format (default: text)")
+    p.add_argument("--json", dest="format", action="store_const",
+                   const="json", help="shorthand for --format json")
+    p.add_argument("--entry", action="append", default=None,
+                   metavar="NAME",
+                   help="check only this entry point (function name or "
+                        "file.py::name; repeatable; default: auto-detect "
+                        "train-step seams, elastic bodies, module bodies "
+                        "and uncalled roots)")
+    p.add_argument("--max-paths", type=int, default=None,
+                   help="per-entry path budget (default: "
+                        "HVD_VERIFY_MAX_PATHS or 64)")
+    p.add_argument("--loop-bound", type=int, default=None,
+                   help="loop unroll bound (default: "
+                        "HVD_VERIFY_LOOP_BOUND or 2)")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule IDs to skip (also honours "
+                        "the HVD_LINT_DISABLE env knob)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the schedule rule catalogue and exit")
+    return p
+
+
+def main_verify(argv: Optional[Sequence[str]] = None) -> int:
+    from .schedule import (
+        SCHEDULE_RULES,
+        check_paths,
+        render_result_json,
+        render_result_text,
+    )
+
+    args = build_verify_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(SCHEDULE_RULES):
+            sev, summary = SCHEDULE_RULES[rule]
+            print(f"{rule}  [{sev:7s}]  {summary}")
+        return 0
+    paths = args.paths or ["."]
+    disable = {r.strip() for r in args.disable.split(",") if r.strip()}
+    try:
+        result = check_paths(paths, entries=args.entry,
+                             max_paths=args.max_paths,
+                             loop_bound=args.loop_bound, disable=disable)
+    except (OSError, ValueError) as e:
+        print(f"hvd_verify: {e}", file=sys.stderr)
+        return 2
+    print(render_result_json(result) if args.format == "json"
+          else render_result_text(result))
+    return 1 if result.findings else 0

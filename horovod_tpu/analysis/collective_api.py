@@ -81,8 +81,11 @@ TRACE_SAFE_DOTTED = frozenset({
 
 #: keywords whose disagreement between two sites naming the same tensor
 #: is a cross-rank signature mismatch (the coordinator would reject or,
-#: worse, deadlock on it at runtime — controller.cc:377-610)
-SIGNATURE_KEYWORDS = ("op", "root_rank", "process_set", "dtype")
+#: worse, deadlock on it at runtime — controller.cc:377-610).
+#: ``compression`` is the wire format: two ranks reducing one bucket in
+#: different formats (docs/compression.md) sum incompatible payloads.
+SIGNATURE_KEYWORDS = ("op", "root_rank", "process_set", "dtype",
+                      "compression")
 
 
 #: tails too generic to match on name alone — only these attribute bases

@@ -14,7 +14,7 @@ import json
 import re
 import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 #: severity vocabulary, ordered weakest → strongest
 SEVERITIES = ("warning", "error")
@@ -32,6 +32,8 @@ class Finding:
     col: int = 0
     severity: str = "error"
     related: str = ""   # optional "see also" site ("other.py:12")
+    #: machine-checkable payload (the model checker's counterexample)
+    extra: Dict[str, object] = field(default_factory=dict)
 
     def format(self) -> str:
         rel = f"  (see {self.related})" if self.related else ""
@@ -43,15 +45,24 @@ class Finding:
             "rule": self.rule, "message": self.message, "file": self.file,
             "line": self.line, "col": self.col, "severity": self.severity,
             **({"related": self.related} if self.related else {}),
+            **(self.extra if self.extra else {}),
         }
 
 
 @dataclass
 class Suppressions:
-    """Per-file suppression state parsed straight from source text."""
+    """Per-file suppression state parsed straight from source text.
+
+    With :meth:`attach_spans`, suppressions map through the enclosing
+    statement's line span: a ``# hvd-lint: disable=`` comment on a
+    decorator line, or on the closing paren of a multi-line call,
+    silences findings anchored anywhere in that statement — the comment
+    and the reported line need not coincide.  Without spans (syntax-error
+    files), matching stays exact-line."""
 
     by_line: Dict[int, Set[str]] = field(default_factory=dict)
     whole_file: Set[str] = field(default_factory=set)
+    spans: List[Tuple[int, int]] = field(default_factory=list)
 
     @classmethod
     def parse(cls, source: str) -> "Suppressions":
@@ -68,10 +79,31 @@ class Suppressions:
                 )
         return supp
 
+    def attach_spans(self, spans: Sequence[Tuple[int, int]]) -> None:
+        """Register statement line spans (visitor.statement_spans) so
+        suppressions attach per statement instead of per physical line."""
+        self.spans = [tuple(s) for s in spans]
+
+    def _span_of(self, line: int) -> Optional[Tuple[int, int]]:
+        best = None
+        for start, end in self.spans:
+            if start <= line <= end:
+                if best is None or (end - start) < (best[1] - best[0]):
+                    best = (start, end)
+        return best
+
+    def rules_for(self, line: int) -> Set[str]:
+        rules = set(self.by_line.get(line, ()))
+        span = self._span_of(line)
+        if span is not None:
+            for lineno in range(span[0], span[1] + 1):
+                rules |= self.by_line.get(lineno, set())
+        return rules
+
     def hides(self, finding: Finding) -> bool:
         if "all" in self.whole_file or finding.rule in self.whole_file:
             return True
-        rules = self.by_line.get(finding.line, ())
+        rules = self.rules_for(finding.line)
         return "all" in rules or finding.rule in rules
 
 

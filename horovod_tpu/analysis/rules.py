@@ -250,7 +250,12 @@ def lint_sources(sources: Sequence[Tuple[str, str]],
     for path, source in sources:
         supp[path] = Suppressions.parse(source)
         try:
-            all_facts.append(collect_facts(source, path))
+            facts = collect_facts(source, path)
+            # suppressions attach through statement spans: a disable on a
+            # decorator line or on the closing paren of a multi-line call
+            # covers the statement's reported finding line
+            supp[path].attach_spans(facts.spans)
+            all_facts.append(facts)
         except SyntaxError as e:
             findings.append(Finding(
                 rule="HVD000", message=f"syntax error: {e.msg}",
@@ -304,12 +309,14 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return sorted(set(out))
 
 
-def lint_paths(paths: Sequence[str],
-               disable: Iterable[str] = ()) -> List[Finding]:
-    """Lint files/dirs.  Raises OSError on a nonexistent path (→ CLI
-    exit 2); an unreadable file becomes an HVD000 finding without
-    discarding the rest of the run."""
-    sources = []
+def read_sources(paths: Sequence[str]) -> Tuple[List[Tuple[str, str]],
+                                                List[Finding]]:
+    """Expand + read files/dirs once for any analyzer: (path, source)
+    pairs plus HVD000 findings for unreadable files.  Raises OSError on
+    a nonexistent path (→ CLI exit 2).  Shared by lint_paths,
+    schedule.check_paths, and ``hvd_lint --model-check`` (which runs
+    both analyzers over one read of the tree)."""
+    sources: List[Tuple[str, str]] = []
     unreadable: List[Finding] = []
     for path in iter_python_files(paths):
         try:
@@ -320,6 +327,15 @@ def lint_paths(paths: Sequence[str],
                 Finding(rule="HVD000", message=f"unreadable: {e}",
                         file=path, line=1, severity="error")
             )
+    return sources, unreadable
+
+
+def lint_paths(paths: Sequence[str],
+               disable: Iterable[str] = ()) -> List[Finding]:
+    """Lint files/dirs.  Raises OSError on a nonexistent path (→ CLI
+    exit 2); an unreadable file becomes an HVD000 finding without
+    discarding the rest of the run."""
+    sources, unreadable = read_sources(paths)
     return sort_findings(
         unreadable + lint_sources(sources, disable=disable)
     )

@@ -1,31 +1,55 @@
-"""Cross-rank collective sanitizer — the runtime half of hvd_lint.
+"""Cross-rank collective sanitizer — the runtime half of hvd_verify.
 
-The failure mode the linter catches at review time (ranks disagreeing on
-which collective runs next) is, at runtime, a silent hang: every rank
-blocks in a different collective and only the stall inspector's 60-second
-post-mortem names the op.  With ``HVD_SANITIZER=1`` each eager dispatch
-is fingerprinted *before* it runs — (sequence number, op kind, tensor
-name, shape, dtype) — and cross-checked against every peer through the
-launcher's rendezvous KV store (run/http_server.py), the same transport
-the metrics pusher already rides.  A divergence raises
-:class:`CollectiveDivergenceError` on every rank that can see it, naming
-the diverging rank and both call signatures; a peer that never dispatches
-(the classic rank-guarded collective) surfaces as a timeout diagnostic
-instead of an infinite hang.
+The failure mode the static checkers catch at review time (ranks
+disagreeing on which collective runs next) is, at runtime, a silent
+hang: every rank blocks in a different collective and only the stall
+inspector's 60-second post-mortem names the op.  With ``HVD_SANITIZER=1``
+each eager dispatch is fingerprinted *before* it runs and cross-checked
+against its peers through the launcher's rendezvous KV store
+(run/http_server.py), the same transport the metrics pusher already
+rides.  A divergence raises :class:`CollectiveDivergenceError` on every
+rank that can see it, naming the diverging rank and both call
+signatures; a peer that never dispatches (the classic rank-guarded
+collective) surfaces as a timeout diagnostic instead of an infinite
+hang.
+
+**Fingerprint v2 — group- and epoch-aware.**  A fingerprint is
+``(group, epoch, seq, op, name, shape, dtype, clock)``:
+
+* ``group`` names the communication group the dispatch reduces over —
+  ``world`` for flat collectives, ``local:<node>`` / ``cross:<chunk>``
+  for the two-level stages (parallel/hierarchical.py surfaces the stage
+  plan to dispatch), ``process_set:…`` for restricted communicators.
+  Sequence numbers count **per (group, epoch)** and checks compare only
+  the group's members, so a two_level run no longer cross-matches its
+  intra-host stage on one rank against the cross-host stage on another
+  — the flat-world false mismatch this plane shipped with.
+* ``epoch`` is the elastic membership epoch (elastic/membership.py).
+  Under ``HVD_SANITIZER_EPOCH_STRICT`` (default) fingerprints only match
+  within one epoch, so a rank still draining epoch N never pairs with a
+  peer already rebuilt into N+1; set it to 0 to let checks span a
+  rebuild window while debugging elastic jobs.
+* ``clock`` is this rank's dispatch counter across *all* groups — a
+  vector-clock component.  Each rank records the clocks at which it and
+  each peer issued the shared (group, seq) dispatches; two shared
+  dispatches issued in opposite clock order on two ranks is a
+  **cross-group ordering inversion** (the runtime twin of hvd_verify's
+  HVD011) and raises instead of deadlocking with both ranks blocked in
+  different groups' collectives.
 
 This is a debug plane: every check is one KV PUT plus size-1 GET-polls
-per peer, so it multiplies eager-dispatch latency — leave it off in
-production and flip it on to turn a reproducible hang into a diagnosis.
-The compiled hot path (hvd.spmd steps) is untouched: XLA's static
-schedule already cannot reorder collectives per rank; divergence enters
-through the eager control plane this guards.
+per group peer, so it multiplies eager-dispatch latency — leave it off
+in production and flip it on to turn a reproducible hang into a
+diagnosis.  The compiled hot path (hvd.spmd steps) is untouched: XLA's
+static schedule already cannot reorder collectives per rank; divergence
+enters through the eager control plane this guards.
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 from ..utils import env as env_util
 from ..utils.logging import get_logger
@@ -38,12 +62,19 @@ log = get_logger(__name__)
 
 DEFAULT_TIMEOUT_SECONDS = 60.0
 
-#: how many verified sequence numbers each rank keeps published before
-#: garbage-collecting its own old fingerprints.  Completing sequence N
-#: proves every peer has *started* N (they all published it), so no peer
-#: can still need keys below N; the window keeps GET /sanitizer a useful
-#: recent view while bounding the launcher's store at O(window x ranks).
+#: the flat-world group label (every rank participates)
+WORLD_GROUP = "world"
+
+#: how many verified sequence numbers each rank keeps published per
+#: (group, epoch) before garbage-collecting its own old fingerprints.
+#: Completing sequence N proves every group peer has *started* N (they
+#: all published it), so no peer can still need keys below N; the window
+#: keeps GET /sanitizer a useful recent view while bounding the
+#: launcher's store at O(window x ranks x groups).
 GC_WINDOW = 64
+
+#: how many recent shared dispatches per peer the ordering index keeps
+ORDER_WINDOW = 32
 
 
 class CollectiveDivergenceError(RuntimeError):
@@ -52,14 +83,25 @@ class CollectiveDivergenceError(RuntimeError):
     otherwise become."""
 
 
+def group_key(group: str) -> str:
+    """KV-safe group slug: the store key format is
+    ``<group>.<epoch>.<seq>.<rank>``, so the group must not contain the
+    separator."""
+    return str(group).replace(".", "_").replace("/", "_")
+
+
 def fingerprint(seq: int, *, op: str, name: str, shape: Sequence[int],
-                dtype) -> dict:
+                dtype, group: str = WORLD_GROUP, epoch: int = 0,
+                clock: int = 0) -> dict:
     return {
         "seq": int(seq),
         "op": str(op),
         "name": str(name),
         "shape": [int(d) for d in shape],
         "dtype": str(dtype),
+        "group": str(group),
+        "epoch": int(epoch),
+        "clock": int(clock),
     }
 
 
@@ -68,63 +110,209 @@ def _sig(fp: dict) -> str:
             f"dtype={fp['dtype']})")
 
 
+class OrderIndex:
+    """Happens-before index over one rank's view of its own and its
+    peers' dispatch clocks.
+
+    ``observe(peer, key, mine, theirs)`` records that the shared
+    dispatch ``key`` (a ``(group, epoch, seq)`` triple) was issued at
+    local clock ``mine`` and at ``peer``'s clock ``theirs``; it returns
+    the earlier shared key that ``peer`` ordered the *other* way, if
+    any.  Within one rank clocks are totally ordered, so for two shared
+    dispatches a and b: a→b here and b→a there means each rank can block
+    in a different group's collective — a deadlock no per-group sequence
+    check can see.
+
+    Comparisons never cross membership epochs: an elastic rebuild (or a
+    peer relaunched into a new epoch) resets that peer's clock, so an
+    epoch-N entry ordered against an epoch-N+1 entry would read as a
+    spurious inversion."""
+
+    def __init__(self, window: int = ORDER_WINDOW):
+        self.window = int(window)
+        self._mine: Dict[Tuple, int] = {}
+        self._mine_order: list = []
+        self._theirs: Dict[int, Dict[Tuple, int]] = {}
+        self._recent: Dict[int, list] = {}
+
+    def observe(self, peer: int, key: Tuple, mine: int,
+                theirs: int) -> Optional[Tuple]:
+        peer_clocks = self._theirs.setdefault(peer, {})
+        recent = self._recent.setdefault(peer, [])
+        inverted = None
+        for prev in recent:
+            if prev[1] != key[1]:
+                continue  # a different epoch: clocks are not comparable
+            pm, pt = self._mine.get(prev), peer_clocks.get(prev)
+            if pm is None or pt is None:
+                continue
+            if (pm < mine) != (pt < theirs):
+                inverted = prev
+                break
+        if key not in self._mine:
+            self._mine_order.append(key)
+        self._mine[key] = mine
+        peer_clocks[key] = theirs
+        recent.append(key)
+        if len(recent) > self.window:
+            dropped = recent.pop(0)
+            peer_clocks.pop(dropped, None)
+        # bound the local clock map too (a long run must not grow it
+        # per dispatch forever): keep enough history to serve every
+        # peer's window; an evicted key simply stops being comparable
+        limit = self.window * (len(self._theirs) + 1) * 2
+        while len(self._mine_order) > limit:
+            self._mine.pop(self._mine_order.pop(0), None)
+        return inverted
+
+
 class Sanitizer:
     """One rank's sanitizer: publishes this rank's fingerprint for each
-    collective sequence number and verifies every peer published an
-    identical one before the dispatch proceeds."""
+    collective sequence number and verifies every group peer published
+    an identical one before the dispatch proceeds."""
 
     def __init__(self, rank: int, size: int, addr: str, port: int,
                  secret: Optional[bytes] = None,
-                 timeout: float = DEFAULT_TIMEOUT_SECONDS):
+                 timeout: float = DEFAULT_TIMEOUT_SECONDS,
+                 epoch_fn=None, epoch_strict: Optional[bool] = None):
         self.rank = int(rank)
         self.size = int(size)
         self.addr = addr
         self.port = int(port)
         self.secret = secret
         self.timeout = float(timeout)
-        self._seq = 0
+        self.epoch_fn = epoch_fn
+        if epoch_strict is None:
+            epoch_strict = env_util.get_bool(
+                env_util.HVD_SANITIZER_EPOCH_STRICT, True)
+        self.epoch_strict = bool(epoch_strict)
+        self._seqs: Dict[Tuple[str, int], int] = {}
+        self._last_epoch: Dict[str, int] = {}
+        self._clock = 0
+        self._order = OrderIndex()
         self._lock = threading.Lock()
 
-    def check(self, *, op: str, name: str, shape: Sequence[int],
-              dtype) -> int:
-        """Fingerprint + cross-check one collective dispatch.  Returns the
-        sequence number it verified; raises CollectiveDivergenceError on
-        signature divergence or a silent peer."""
+    # -- internals -----------------------------------------------------------
+    def _epoch(self) -> int:
+        if self.epoch_fn is not None:
+            try:
+                return int(self.epoch_fn())
+            except Exception:  # noqa: BLE001 — a broken epoch source
+                return 0       # must not fail the check
+        return 0
+
+    def _next(self, group: str, epoch: int) -> Tuple[int, int, Optional[int]]:
+        """(seq, clock, retired_epoch): ``retired_epoch`` is the group's
+        previous epoch the first time a new one is seen — the caller
+        garbage-collects its stranded fingerprints (an elastic job must
+        not leak a window of keys per epoch bump)."""
+        with self._lock:
+            seq = self._seqs.get((group, epoch), 0)
+            self._seqs[(group, epoch)] = seq + 1
+            self._clock += 1
+            prev = self._last_epoch.get(group)
+            self._last_epoch[group] = epoch
+            retired = prev if prev is not None and prev != epoch else None
+            return seq, self._clock, retired
+
+    def _gc_epoch(self, group: str, epoch: int) -> None:
+        """Best-effort delete of this rank's remaining fingerprints for a
+        retired (group, epoch) — the keys the rolling per-seq GC never
+        reaches once the epoch stops advancing."""
+        try:
+            from ..run.http_client import delete_kv
+            from ..run.http_server import SANITIZER_SCOPE
+
+            last = self._seqs.get((group, epoch), 0)
+            for seq in range(max(0, last - GC_WINDOW), last):
+                delete_kv(self.addr, self.port, SANITIZER_SCOPE,
+                          self._kv_key(group, epoch, seq, self.rank),
+                          self.secret)
+        except Exception:  # noqa: BLE001 — GC must never fail a check
+            pass
+
+    @staticmethod
+    def _kv_key(group: str, epoch: int, seq: int, rank: int) -> str:
+        return f"{group_key(group)}.{epoch}.{seq}.{rank}"
+
+    def _raise(self, msg: str) -> None:
+        from .. import metrics
+
+        metrics.SANITIZER_MISMATCHES.inc()
+        raise CollectiveDivergenceError(msg)
+
+    # -- the check -----------------------------------------------------------
+    def check(self, *, op: str, name: str, shape: Sequence[int], dtype,
+              group: str = WORLD_GROUP,
+              peers: Optional[Sequence[int]] = None,
+              epoch: Optional[int] = None) -> int:
+        """Fingerprint + cross-check one collective dispatch within its
+        communication group.  ``peers`` is the group's member ranks
+        (default: all ranks — the flat world).  Returns the per-(group,
+        epoch) sequence number it verified; raises
+        CollectiveDivergenceError on signature divergence, a silent
+        peer, or a cross-group ordering inversion."""
         from ..run.http_client import get_kv, put_kv
         from ..run.http_server import SANITIZER_SCOPE
 
         from .. import metrics
 
-        with self._lock:
-            seq = self._seq
-            self._seq += 1
-        mine = fingerprint(seq, op=op, name=name, shape=shape, dtype=dtype)
+        if epoch is None:
+            epoch = self._epoch()
+        match_epoch = epoch if self.epoch_strict else 0
+        members = sorted(int(p) for p in peers) if peers is not None \
+            else list(range(self.size))
+        if self.rank not in members:
+            raise ValueError(
+                f"rank {self.rank} dispatched a collective for group "
+                f"'{group}' it is not a member of (members: {members})")
+        seq, clock, retired_epoch = self._next(group, match_epoch)
+        if retired_epoch is not None:
+            self._gc_epoch(group, retired_epoch)
+        mine = fingerprint(seq, op=op, name=name, shape=shape, dtype=dtype,
+                           group=group, epoch=epoch, clock=clock)
         put_kv(self.addr, self.port, SANITIZER_SCOPE,
-               f"{seq}.{self.rank}", json.dumps(mine).encode(), self.secret)
-        for peer in range(self.size):
+               self._kv_key(group, match_epoch, seq, self.rank),
+               json.dumps(mine).encode(), self.secret)
+        for peer in members:
             if peer == self.rank:
                 continue
             raw = get_kv(self.addr, self.port, SANITIZER_SCOPE,
-                         f"{seq}.{peer}", self.secret,
-                         wait=True, timeout=self.timeout)
+                         self._kv_key(group, match_epoch, seq, peer),
+                         self.secret, wait=True, timeout=self.timeout)
             if raw is None:
-                metrics.SANITIZER_MISMATCHES.inc()
-                raise CollectiveDivergenceError(
+                self._raise(
                     f"collective sanitizer: rank {peer} published no "
-                    f"fingerprint for sequence {seq} within "
-                    f"{self.timeout:.0f}s while rank {self.rank} "
-                    f"dispatched {_sig(mine)} — rank {peer} is running a "
-                    "different collective schedule (rank-guarded "
-                    "collective, early exit, or a hang upstream)"
+                    f"fingerprint for sequence {seq} of group '{group}' "
+                    f"(epoch {epoch}) within {self.timeout:.0f}s while "
+                    f"rank {self.rank} dispatched {_sig(mine)} — rank "
+                    f"{peer} is running a different collective schedule "
+                    "(rank-guarded collective, early exit, a hang "
+                    "upstream, or a different membership epoch under "
+                    "HVD_SANITIZER_EPOCH_STRICT)"
                 )
             theirs = json.loads(raw)
-            if {k: theirs[k] for k in ("op", "name", "shape", "dtype")} != \
-                    {k: mine[k] for k in ("op", "name", "shape", "dtype")}:
-                metrics.SANITIZER_MISMATCHES.inc()
-                raise CollectiveDivergenceError(
-                    f"collective sanitizer: divergence at sequence {seq} — "
-                    f"rank {self.rank} dispatched {_sig(mine)} but rank "
-                    f"{peer} dispatched {_sig(theirs)}"
+            if {k: theirs.get(k) for k in ("op", "name", "shape", "dtype")} \
+                    != {k: mine[k] for k in ("op", "name", "shape",
+                                             "dtype")}:
+                self._raise(
+                    f"collective sanitizer: divergence at sequence {seq} "
+                    f"of group '{group}' (epoch {epoch}) — rank "
+                    f"{self.rank} dispatched {_sig(mine)} but rank {peer} "
+                    f"dispatched {_sig(theirs)}"
+                )
+            inverted = self._order.observe(
+                peer, (group, match_epoch, seq), clock,
+                int(theirs.get("clock", 0)))
+            if inverted is not None:
+                g2, _, s2 = inverted
+                self._raise(
+                    "collective sanitizer: cross-group ordering inversion "
+                    f"— rank {self.rank} issued sequence {s2} of group "
+                    f"'{g2}' before sequence {seq} of group '{group}' "
+                    f"({_sig(mine)}), but rank {peer} issued them in the "
+                    "opposite order; each rank blocks in a different "
+                    "group's collective"
                 )
         metrics.SANITIZER_CHECKS.inc()
         if seq >= GC_WINDOW:
@@ -134,7 +322,9 @@ class Sanitizer:
                 from ..run.http_client import delete_kv
 
                 delete_kv(self.addr, self.port, SANITIZER_SCOPE,
-                          f"{seq - GC_WINDOW}.{self.rank}", self.secret)
+                          self._kv_key(group, match_epoch,
+                                       seq - GC_WINDOW, self.rank),
+                          self.secret)
             except Exception:  # noqa: BLE001 — GC must never fail a check
                 pass
         return seq
@@ -151,7 +341,8 @@ _instance_lock = threading.Lock()
 def _build_from_env():
     """The process sanitizer, from launcher-provided env: enabled by
     HVD_SANITIZER, carried by the metrics rendezvous (addr/port/secret
-    the launcher already exports for the pusher)."""
+    the launcher already exports for the pusher), epoch-fed by the
+    elastic membership plane."""
     if not env_util.get_bool(env_util.HVD_SANITIZER, False):
         return None
     from .. import core
@@ -171,10 +362,14 @@ def _build_from_env():
     secret = bytes.fromhex(secret_hex) if secret_hex else None
     timeout = env_util.get_float(env_util.HVD_SANITIZER_TIMEOUT_SECONDS,
                                  DEFAULT_TIMEOUT_SECONDS)
+    from ..elastic import membership
+
     s = Sanitizer(core.process_rank(), size, addr, port,
-                  secret=secret, timeout=timeout)
+                  secret=secret, timeout=timeout,
+                  epoch_fn=membership.current_epoch)
     log.info("collective sanitizer active: rank %d/%d via %s:%d "
-             "(timeout %.0fs)", s.rank, s.size, addr, port, timeout)
+             "(timeout %.0fs, epoch_strict=%s)", s.rank, s.size, addr,
+             port, timeout, s.epoch_strict)
     return s
 
 
@@ -200,8 +395,11 @@ def reset() -> None:
         _instance = _UNSET
 
 
-def maybe_check(*, op: str, name: str, shape: Sequence[int], dtype) -> None:
+def maybe_check(*, op: str, name: str, shape: Sequence[int], dtype,
+                group: str = WORLD_GROUP,
+                peers: Optional[Sequence[int]] = None) -> None:
     """The eager._dispatch_guard hook: no-op unless HVD_SANITIZER=1."""
     s = instance()
     if s is not None:
-        s.check(op=op, name=name, shape=shape, dtype=dtype)
+        s.check(op=op, name=name, shape=shape, dtype=dtype,
+                group=group, peers=peers)

@@ -1,0 +1,33 @@
+"""Whole-program collective-schedule model checker (`hvd_verify`).
+
+The static half of the correctness story the runtime sanitizer
+(analysis/sanitizer.py) covers at dispatch time: build an
+interprocedural call graph over the training program, enumerate the
+execution paths each rank can take through rank-tainted control flow,
+project every path's collective sequence *per communication group*
+(flat world, intra-host local, cross-host, process sets, per-epoch
+elastic worlds), and prove the sequences pairwise compatible — or emit
+a machine-checkable counterexample naming the diverging rank set, the
+collective, and the exact branch chain (file:line per decision).
+
+Rules HVD009–HVD012 (SCHEDULE_RULES, docs/analysis.md):
+
+* HVD009 — schedule divergence within one group;
+* HVD010 — blocking collective reachable on a strict subset of ranks;
+* HVD011 — cross-group ordering inversion (intra vs cross stages);
+* HVD012 — collective on an abort/cleanup path that peers skip.
+
+Entry points: ``scripts/hvd_verify.py`` and ``hvd_lint --model-check``.
+Bounds: HVD_VERIFY_MAX_PATHS / HVD_VERIFY_LOOP_BOUND (utils/env.py).
+"""
+
+from .checker import (  # noqa: F401
+    CheckResult,
+    SCHEDULE_RULES,
+    check_paths,
+    check_sources,
+    render_result_json,
+    render_result_text,
+)
+from .ir import Collective, Entry, FunctionInfo  # noqa: F401
+from .paths import Decision, Dispatch, Enumerator, Path  # noqa: F401

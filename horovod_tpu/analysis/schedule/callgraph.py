@@ -1,0 +1,114 @@
+"""Interprocedural call graph + entry-point selection.
+
+Resolution is tail-name based, like the linter's collective matching
+(collective_api.py): `self._flush(x)`, `module._flush(x)` and a bare
+`_flush(x)` all resolve to a function *named* ``_flush``.  Ambiguity is
+handled conservatively — a call site binds to a same-file definition
+first, and to a cross-file definition only when exactly one file defines
+the name; otherwise the call stays unresolved (no inlining, no false
+interprocedural findings).
+
+Entry points, in the order the ISSUE names them:
+
+* **train-step seams** — functions wrapped by ``hvd.spmd``/``jax.jit``
+  (``step = hvd.spmd(one_step)``) or decorated so;
+* **elastic bodies** — functions passed to ``hvd.elastic.run(fn, state)``,
+  checked as per-epoch worlds;
+* **roots** — module top-level bodies and functions no analyzed code
+  calls (the ``main()``s and library API surface a user script dispatches
+  from).
+
+Entries whose transitive closure dispatches no collective are pruned
+before enumeration — most of a real repo never touches the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .ir import Entry, FunctionInfo, called_names, has_collective
+
+
+class CallGraph:
+    def __init__(self, functions: List[FunctionInfo]):
+        self.functions = functions
+        self._by_name: Dict[str, List[FunctionInfo]] = {}
+        for fn in functions:
+            self._by_name.setdefault(fn.name, []).append(fn)
+        self._dispatches: Dict[str, bool] = {}
+
+    # -- resolution ----------------------------------------------------------
+    def resolve(self, target: str,
+                from_file: Optional[str] = None) -> Optional[FunctionInfo]:
+        """The definition a call to ``target`` binds to, or None when the
+        name is unknown or ambiguous across files."""
+        candidates = self._by_name.get(target)
+        if not candidates:
+            return None
+        if from_file is not None:
+            same = [fn for fn in candidates if fn.site.file == from_file]
+            if len(same) == 1:
+                return same[0]
+            if len(same) > 1:
+                return None  # same-file overloads (class methods) — skip
+        return candidates[0] if len(candidates) == 1 else None
+
+    # -- reachability --------------------------------------------------------
+    def dispatches(self, fn: FunctionInfo,
+                   _stack: Optional[Set[str]] = None) -> bool:
+        """Whether ``fn`` (transitively) dispatches any collective."""
+        key = fn.qualname
+        if key in self._dispatches:
+            return self._dispatches[key]
+        stack = _stack or set()
+        if key in stack:
+            return False
+        if has_collective(fn.body):
+            self._dispatches[key] = True
+            return True
+        stack = stack | {key}
+        out = False
+        for name in called_names(fn.body):
+            callee = self.resolve(name, from_file=fn.site.file)
+            if callee is not None and self.dispatches(callee, stack):
+                out = True
+                break
+        self._dispatches[key] = out
+        return out
+
+    # -- entry points --------------------------------------------------------
+    def entries(self, explicit: Optional[List[str]] = None) -> List[Entry]:
+        """Model-checking entry points.  ``explicit`` (function names or
+        ``file::name`` qualnames) overrides auto-detection."""
+        if explicit:
+            out = []
+            for spec in explicit:
+                matched = [fn for fn in self.functions
+                           if fn.name == spec or fn.qualname == spec
+                           or fn.qualname.endswith(spec)]
+                if not matched:
+                    # a typo'd --entry must be a usage error, not a
+                    # green "verified 0 entries" (rules.py applies the
+                    # same rule to nonexistent paths)
+                    raise ValueError(
+                        f"--entry {spec!r} matches no function in the "
+                        "checked files")
+                out.extend(Entry(fn=fn, kind="root") for fn in matched)
+            return out
+
+        all_called: Set[str] = set()
+        for fn in self.functions:
+            all_called |= called_names(fn.body)
+        out = []
+        for fn in self.functions:
+            if not self.dispatches(fn):
+                continue
+            if fn.name == "<module>":
+                out.append(Entry(fn=fn, kind="module"))
+            elif fn.elastic:
+                out.append(Entry(fn=fn, kind="elastic"))
+            elif fn.wrapped:
+                out.append(Entry(fn=fn, kind="wrapped"))
+            elif fn.name not in all_called:
+                out.append(Entry(fn=fn, kind="root"))
+        return out

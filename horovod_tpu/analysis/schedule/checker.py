@@ -1,0 +1,431 @@
+"""Pairwise schedule compatibility + counterexample construction.
+
+For every entry point, every pair of enumerated paths that could be two
+ranks of the *same* run (identical uniform decisions, differing on at
+least one rank/data/exception decision) is compared group by group:
+
+* their per-group collective sequences must be identical — a conflict at
+  position *k* is **HVD009** (schedule divergence: the ranks negotiate
+  different ops/names/signatures and deadlock), a strict-prefix
+  relationship is **HVD010** (a blocking collective only a subset of
+  ranks reaches — the classic rank-guarded collective, interprocedural),
+  and a subset collective sitting on an exception/cleanup path is
+  **HVD012** (peers that did not raise skip the drain);
+* when all per-group sequences agree but two groups interleave in
+  opposite orders on the two paths, that is **HVD011** (cross-group
+  ordering inversion: intra-host vs cross-host stages issued in a
+  different relative order deadlock even though each group's own
+  schedule matches — the static twin of the sanitizer's vector-clock
+  check).
+
+Each finding carries a machine-checkable counterexample: the entry, the
+group, the collective, both projected sequences, and the exact branch
+chain (file:line, condition, arm) that separates the two rank sets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..findings import Finding, Suppressions, sort_findings
+from .callgraph import CallGraph
+from .ir import Entry
+from .paths import (
+    DEFAULT_LOOP_BOUND,
+    DEFAULT_MAX_PATHS,
+    Decision,
+    Dispatch,
+    Enumerator,
+    Path,
+)
+
+#: the model checker's rule catalogue (merged into rules.RULES for
+#: --list-rules and severity lookup; docs/analysis.md is the user copy)
+SCHEDULE_RULES: Dict[str, Tuple[str, str]] = {
+    "HVD009": ("error",
+               "schedule divergence: ranks project different collective "
+               "sequences for one communication group"),
+    "HVD010": ("error",
+               "potential deadlock: blocking collective reachable on a "
+               "strict subset of ranks"),
+    "HVD011": ("error",
+               "cross-group ordering inversion: collectives of two groups "
+               "issued in a different relative order on different ranks"),
+    "HVD012": ("error",
+               "collective reachable from an abort/cleanup path that "
+               "peers skip"),
+}
+
+
+def _fmt_seq(events: Sequence[Dispatch], limit: int = 8) -> List[str]:
+    out = [f"{d.collective.describe()} @ {d.collective.site}"
+           for d in events[:limit]]
+    if len(events) > limit:
+        out.append(f"… {len(events) - limit} more")
+    return out
+
+
+def _chain_dicts(decisions: Iterable[Decision]) -> List[dict]:
+    out = []
+    for d in decisions:
+        f, _, line = d.site.rpartition(":")
+        out.append({
+            "file": f, "line": int(line) if line.isdigit() else 0,
+            "kind": d.kind, "flavor": d.flavor,
+            "condition": d.condition, "taken": d.taken,
+        })
+    return out
+
+
+def _rank_set(decisions: Sequence[Decision]) -> str:
+    """A symbolic name for the rank set a divergent decision chain
+    selects — the checker proves schedules per *decision*, so the rank
+    set is the ranks on which those conditions evaluate this way."""
+    if not decisions:
+        return "all ranks"
+    bits = []
+    for d in decisions[:4]:
+        rel = {"then": "is true", "else": "is false",
+               "raised": "raises", "no raise": "does not raise",
+               "enter once": "is true", "skip": "is false"}.get(
+                   d.taken, d.taken)
+        bits.append(f"({d.condition}) at {d.site} {rel}")
+    return "ranks where " + " and ".join(bits)
+
+
+def _differing(a: Path, b: Path) -> Tuple[Tuple[Decision, ...],
+                                          Tuple[Decision, ...]]:
+    """The divergent decisions that separate the two paths (symmetric
+    difference, order preserved)."""
+    da, db = a.divergent_decisions(), b.divergent_decisions()
+    only_a = tuple(d for d in da if d not in db)
+    only_b = tuple(d for d in db if d not in da)
+    return only_a, only_b
+
+
+class _Dedup:
+    def __init__(self):
+        self._seen: Set[Tuple] = set()
+
+    def fresh(self, *key) -> bool:
+        if key in self._seen:
+            return False
+        self._seen.add(key)
+        return True
+
+
+def _finding(rule: str, message: str, dispatch: Dispatch,
+             counterexample: dict) -> Finding:
+    site = dispatch.collective.site
+    return Finding(
+        rule=rule, message=message, file=site.file, line=site.line,
+        col=site.col, severity=SCHEDULE_RULES[rule][0],
+        extra={"counterexample": counterexample},
+    )
+
+
+def _counterexample(entry: Entry, group: Optional[str], dispatch: Dispatch,
+                    a: Path, b: Path, chain_a, chain_b) -> dict:
+    return {
+        "entry": entry.fn.qualname,
+        "entry_kind": entry.kind,
+        "world": entry.world,
+        "group": group,
+        "collective": {
+            "op": dispatch.collective.op,
+            "name": dispatch.collective.name,
+            "file": dispatch.collective.site.file,
+            "line": dispatch.collective.site.line,
+        },
+        "rank_set_a": _rank_set(chain_a),
+        "rank_set_b": _rank_set(chain_b),
+        "branch_chain_a": _chain_dicts(chain_a),
+        "branch_chain_b": _chain_dicts(chain_b),
+        "call_stack": list(dispatch.stack),
+        "schedule_a": _fmt_seq([d for d in a.events
+                                if group is None
+                                or d.collective.group == group]),
+        "schedule_b": _fmt_seq([d for d in b.events
+                                if group is None
+                                or d.collective.group == group]),
+    }
+
+
+def _check_pair(entry: Entry, a: Path, b: Path,
+                dedup: _Dedup) -> List[Finding]:
+    chain_a, chain_b = _differing(a, b)
+    groups = sorted({d.collective.group for d in a.events}
+                    | {d.collective.group for d in b.events})
+    out: List[Finding] = []
+    all_equal = True
+    for group in groups:
+        sa = [d for d in a.events if d.collective.group == group]
+        sb = [d for d in b.events if d.collective.group == group]
+        k = 0
+        while k < len(sa) and k < len(sb) and sa[k].key() == sb[k].key():
+            k += 1
+        if k == len(sa) and k == len(sb):
+            continue  # this group's schedules agree
+        all_equal = False
+        if k < len(sa) and k < len(sb):
+            da, db = sa[k], sb[k]
+            if not dedup.fresh("HVD009", group, da.collective.site,
+                               db.collective.site):
+                continue
+            out.append(_finding(
+                "HVD009",
+                f"schedule divergence in group '{group}': "
+                f"{_rank_set(chain_a)} dispatch "
+                f"{da.collective.describe()} as collective #{k + 1} while "
+                f"{_rank_set(chain_b)} dispatch "
+                f"{db.collective.describe()} at "
+                f"{db.collective.site} — the group deadlocks at "
+                "negotiation",
+                da, _counterexample(entry, group, da, a, b,
+                                    chain_a, chain_b),
+            ))
+            continue
+        # strict prefix: the longer path dispatches collectives the
+        # other rank set never reaches
+        longer, shorter = (a, b) if len(sa) > len(sb) else (b, a)
+        extra = (sa if len(sa) > len(sb) else sb)[k]
+        chain_l = chain_a if longer is a else chain_b
+        chain_s = chain_b if longer is a else chain_a
+        rule = "HVD012" if extra.collective.cleanup else "HVD010"
+        if not dedup.fresh(rule, group, extra.collective.site):
+            continue
+        if rule == "HVD012":
+            msg = (
+                f"collective {extra.collective.describe()} runs on an "
+                f"abort/cleanup path ({_rank_set(chain_l)}) that "
+                f"{_rank_set(chain_s) if chain_s else 'peers'} skip — "
+                "ranks that did not raise never join it"
+            )
+        else:
+            msg = (
+                f"blocking collective {extra.collective.describe()} in "
+                f"group '{group}' is reachable only by "
+                f"{_rank_set(chain_l)}; "
+                f"{_rank_set(chain_s) if chain_s else 'the other ranks'} "
+                "never dispatch it and the group deadlocks"
+            )
+        out.append(_finding(
+            rule, msg, extra,
+            _counterexample(entry, group, extra, longer, shorter,
+                            chain_l, chain_s),
+        ))
+    if all_equal and len(groups) > 1:
+        out.extend(_check_inversion(entry, a, b, groups, dedup,
+                                    chain_a, chain_b))
+    return out
+
+
+def _check_inversion(entry: Entry, a: Path, b: Path, groups, dedup: _Dedup,
+                     chain_a, chain_b) -> List[Finding]:
+    """All per-group sequences agree — do the groups interleave in the
+    same order?  Position maps: the n-th dispatch of group g is the same
+    logical collective on both paths (their per-group sequences are
+    equal), so opposite relative order of (g,i) vs (h,j) is a deadlock:
+    each rank set blocks in a different group's collective."""
+
+    def order(p: Path) -> Dict[Tuple[str, int], int]:
+        counts: Dict[str, int] = {}
+        out = {}
+        for pos, d in enumerate(p.events):
+            g = d.collective.group
+            out[(g, counts.get(g, 0))] = pos
+            counts[g] = counts.get(g, 0) + 1
+        return out
+
+    oa, ob = order(a), order(b)
+    common = sorted(set(oa) & set(ob), key=lambda k: oa[k])
+    found: List[Finding] = []
+    for i in range(len(common)):
+        for j in range(i + 1, len(common)):
+            x, y = common[i], common[j]
+            if x[0] == y[0]:
+                continue
+            if (oa[x] < oa[y]) == (ob[x] < ob[y]):
+                continue
+            da = a.events[oa[y]]
+            db_ev = b.events[ob[x]]
+            if not dedup.fresh("HVD011", x[0], y[0],
+                               da.collective.site):
+                continue
+            found.append(_finding(
+                "HVD011",
+                f"cross-group ordering inversion: {_rank_set(chain_a)} "
+                f"issue {da.collective.describe()} (group '{y[0]}') after "
+                f"group '{x[0]}', but {_rank_set(chain_b)} issue "
+                f"{db_ev.collective.describe()} (group '{x[0]}') after "
+                f"group '{y[0]}' — each rank set blocks in a different "
+                "group's collective",
+                da, _counterexample(entry, None, da, a, b,
+                                    chain_a, chain_b),
+            ))
+    return found
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+def _env_int(name_attr: str, default: int) -> int:
+    try:
+        from ...utils import env as env_util
+
+        return env_util.get_int(getattr(env_util, name_attr), default)
+    except Exception:  # noqa: BLE001 — standalone use outside the package
+        return default
+
+
+class CheckResult:
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self.entries: int = 0
+        self.paths_explored: int = 0
+        self.truncated: bool = False
+
+
+def check_sources(sources: Sequence[Tuple[str, str]], *,
+                  entries: Optional[List[str]] = None,
+                  max_paths: Optional[int] = None,
+                  loop_bound: Optional[int] = None,
+                  disable: Iterable[str] = ()) -> CheckResult:
+    """Model-check (path, source) pairs as one program.  Mirrors
+    rules.lint_sources: suppression comments and HVD_LINT_DISABLE apply
+    to HVD009–HVD012 exactly as to the linter's rules."""
+    from ..rules import _disabled_from_env
+
+    if max_paths is None:
+        max_paths = _env_int("HVD_VERIFY_MAX_PATHS", DEFAULT_MAX_PATHS)
+    if loop_bound is None:
+        loop_bound = _env_int("HVD_VERIFY_LOOP_BOUND", DEFAULT_LOOP_BOUND)
+    disabled = set(disable) | _disabled_from_env()
+
+    result = CheckResult()
+    functions = []
+    supp: Dict[str, Suppressions] = {}
+    for path, source in sources:
+        s = Suppressions.parse(source)
+        try:
+            import ast
+
+            from .extract import Extractor
+
+            tree = ast.parse(source, filename=path)  # ONE parse per file
+            infos = Extractor(path, tree).extract()
+        except SyntaxError as e:
+            result.findings.append(Finding(
+                rule="HVD000", message=f"syntax error: {e.msg}", file=path,
+                line=e.lineno or 1, col=e.offset or 0, severity="error",
+            ))
+            continue
+        try:
+            s.attach_spans(statement_spans(tree))
+        except Exception:  # noqa: BLE001 — spans are best-effort
+            pass
+        supp[path] = s
+        functions.extend(infos)
+
+    graph = CallGraph(functions)
+    enum = Enumerator(graph, max_paths=max_paths, loop_bound=loop_bound)
+    dedup = _Dedup()
+    findings = list(result.findings)
+    for entry in graph.entries(explicit=entries):
+        res = enum.enumerate(entry)
+        result.entries += 1
+        result.paths_explored += len(res.paths)
+        result.truncated = result.truncated or res.truncated
+        by_uniform: Dict[Tuple, List[Path]] = {}
+        for p in res.paths:
+            by_uniform.setdefault(p.uniform_key(), []).append(p)
+        for group in by_uniform.values():
+            for i in range(len(group)):
+                for j in range(i + 1, len(group)):
+                    a, b = group[i], group[j]
+                    if a.divergent_decisions() == b.divergent_decisions():
+                        continue  # same rank behavior — not two rank sets
+                    findings.extend(_check_pair(entry, a, b, dedup))
+    result.findings = sort_findings([
+        f for f in findings
+        if f.rule not in disabled
+        and not (f.file in supp and supp[f.file].hides(f))
+    ])
+    return result
+
+
+def check_paths(paths: Sequence[str], *,
+                entries: Optional[List[str]] = None,
+                max_paths: Optional[int] = None,
+                loop_bound: Optional[int] = None,
+                disable: Iterable[str] = ()) -> CheckResult:
+    """Model-check files/dirs.  Raises OSError on a nonexistent path
+    (CLI exit 2), like rules.lint_paths."""
+    from ..rules import read_sources
+
+    sources, unreadable = read_sources(paths)
+    result = check_sources(sources, entries=entries, max_paths=max_paths,
+                           loop_bound=loop_bound, disable=disable)
+    result.findings = sort_findings(unreadable + result.findings)
+    return result
+
+
+def statement_spans(tree) -> List[Tuple[int, int]]:
+    """(start, end) line spans for suppression mapping — re-exported from
+    the visitor so both drivers share one definition."""
+    from ..visitor import statement_spans as _spans
+
+    return _spans(tree)
+
+
+def render_result_text(result: CheckResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f.format())
+        ce = f.extra.get("counterexample") if f.extra else None
+        if not ce:
+            continue
+        lines.append(f"    entry: {ce['entry']} [{ce['entry_kind']}, "
+                     f"{ce['world']} world]")
+        if ce.get("group"):
+            lines.append(f"    group: {ce['group']}")
+        if ce.get("call_stack"):
+            for frame in ce["call_stack"]:
+                lines.append(f"    via {frame}")
+        for label, chain_key, sched_key in (
+                ("A", "branch_chain_a", "schedule_a"),
+                ("B", "branch_chain_b", "schedule_b")):
+            chain = ce.get(chain_key) or []
+            lines.append(f"    rank set {label}: "
+                         + (ce.get(f"rank_set_{label.lower()}")
+                            or "all ranks"))
+            for d in chain:
+                lines.append(
+                    f"      -> {d['file']}:{d['line']} {d['kind']} "
+                    f"({d['condition']}) takes '{d['taken']}' "
+                    f"[{d['flavor']}]")
+            for s in ce.get(sched_key) or ["(no collectives)"]:
+                lines.append(f"      dispatches {s}")
+    n_err = sum(1 for f in result.findings if f.severity == "error")
+    n_warn = len(result.findings) - n_err
+    tail = (f"hvd_verify: {len(result.findings)} finding(s) "
+            f"({n_err} error(s), {n_warn} warning(s))"
+            if result.findings else "hvd_verify: OK — no findings")
+    tail += (f"  [{result.entries} entr(ies), "
+             f"{result.paths_explored} path(s)"
+             + (", BOUNDED — raise HVD_VERIFY_MAX_PATHS for more"
+                if result.truncated else "") + "]")
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_result_json(result: CheckResult) -> str:
+    import json
+
+    return json.dumps({
+        "findings": [f.as_dict() for f in result.findings],
+        "count": len(result.findings),
+        "entries": result.entries,
+        "paths_explored": result.paths_explored,
+        "truncated": result.truncated,
+    }, indent=1)

@@ -1,0 +1,402 @@
+"""AST → schedule IR lowering (one file at a time).
+
+Shares the linter's model of the collective surface (collective_api) and
+its taint discipline (visitor.py): ``if``/``while`` conditions are
+classified *rank*-flavored when keyed on a ``rank()``-family call or a
+local tainted by one, *data*-flavored when keyed on a traced function's
+own inputs, *uniform* otherwise.  On top of the flat facts the linter
+collects, this keeps the tree structure — arms, loops, try/except, calls
+— because the model checker needs whole-path ordering, not single
+statements.
+
+Group assignment for a collective call site:
+
+* ``axis_index_groups=<expr>`` → ``local`` / ``cross`` when the expression
+  text names one, else ``groups:<expr>``;
+* ``process_set=<expr>`` → ``process_set:<expr>``;
+* ``two_level=True`` / ``hierarchical=True`` kwargs, or a direct call to
+  ``two_level_allreduce`` / ``hierarchical_allreduce``, expand into the
+  three stage dispatches the runtime actually issues — reduce-scatter on
+  the local group, the reduction on the cross group, all-gather on the
+  local group (parallel/hierarchical.py);
+* everything else → ``world``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .. import collective_api as api
+from ..visitor import _dotted, _sig_source, _tail
+from .ir import (
+    FLAVOR_DATA,
+    FLAVOR_EXCEPTION,
+    FLAVOR_RANK,
+    FLAVOR_UNIFORM,
+    GROUP_CROSS,
+    GROUP_LOCAL,
+    GROUP_WORLD,
+    Branch,
+    Call,
+    Collective,
+    Event,
+    FunctionInfo,
+    Loop,
+    Raise,
+    Return,
+    Site,
+)
+
+#: direct hierarchical entry points that expand into stage dispatches
+_TWO_LEVEL_TAILS = frozenset({"two_level_allreduce", "hierarchical_allreduce"})
+
+#: call tails that never resolve to user schedule code — don't record
+#: Call events for them (keeps paths small and resolution unambiguous)
+_OPAQUE_TAILS = frozenset({
+    "print", "len", "range", "enumerate", "zip", "sorted", "isinstance",
+    "int", "float", "str", "list", "dict", "set", "tuple", "getattr",
+    "setattr", "hasattr", "super", "type", "format", "min", "max", "sum",
+    "abs", "append", "extend", "update", "items", "keys", "values", "get",
+    "join", "split", "strip", "reshape", "astype", "mean", "copy",
+})
+
+
+def _truthy_const(node) -> bool:
+    return isinstance(node, ast.Constant) and bool(node.value)
+
+
+def _expr_text(node, limit: int = 60) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # noqa: BLE001 — exotic node
+        return "<expr>"
+    return text if len(text) <= limit else text[: limit - 1] + "…"
+
+
+def classify_groups_expr(text: str) -> str:
+    """Map an ``axis_index_groups=`` expression to a group label by its
+    source text — ``_local_groups()`` and friends carry their meaning in
+    the name; anything else keeps the expression as an opaque label (two
+    sites agree on the group iff they spell the same expression)."""
+    low = text.lower()
+    if "local" in low:
+        return GROUP_LOCAL
+    if "cross" in low or "dcn" in low:
+        return GROUP_CROSS
+    return f"groups:{text}"
+
+
+class _Frame:
+    __slots__ = ("traced", "params", "rank_tainted", "data_tainted")
+
+    def __init__(self, traced: bool, params: Set[str]):
+        self.traced = traced
+        self.params = params
+        self.rank_tainted: Set[str] = set()
+        self.data_tainted: Set[str] = set()
+
+
+class Extractor:
+    """One file's extraction pass: produces a FunctionInfo per def (and
+    one for the module body) with structured event lists."""
+
+    def __init__(self, path: str, tree: ast.AST):
+        self.path = path
+        self.tree = tree
+        self.functions: List[FunctionInfo] = []
+        self._frames: List[_Frame] = [_Frame(False, set())]
+        self._wrapped = self._wrapped_names(tree)
+        self._elastic = self._elastic_bodies(tree)
+        # whole-file def names: a local ``def broadcast_(…)`` shadows the
+        # framework collective everywhere in the file (visitor.py rule)
+        self._local_defs = {
+            n.name for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+    # -- module-level discovery ---------------------------------------------
+    @staticmethod
+    def _wrapped_names(tree) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and api.is_trace_wrapper(_tail(node.func)) \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+        return names
+
+    @staticmethod
+    def _elastic_bodies(tree) -> Set[str]:
+        """Functions passed to ``hvd.elastic.run(fn, …)`` — per-epoch
+        entry points (elastic/membership.py run wrapper)."""
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _tail(node.func) == "run":
+                d = _dotted(node.func)
+                if len(d) >= 2 and d[-2] == "elastic" and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    names.add(node.args[0].id)
+        return names
+
+    def extract(self) -> List[FunctionInfo]:
+        module = FunctionInfo(
+            name="<module>", site=Site(self.path, 1), traced=False,
+        )
+        module.body = self._lower_block(self.tree.body)
+        self.functions.append(module)
+        return self.functions
+
+    # -- helpers shared with the linter's visitor ---------------------------
+    @property
+    def _frame(self) -> _Frame:
+        return self._frames[-1]
+
+    def _rank_dep(self, expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and api.is_rank_call(_tail(node)):
+                return True
+            if isinstance(node, ast.Name) \
+                    and any(node.id in f.rank_tainted for f in self._frames):
+                return True
+        return False
+
+    def _data_dep(self, expr) -> bool:
+        f = self._frame
+        if not f.traced:
+            return False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) \
+                    and (node.id in f.params or node.id in f.data_tainted):
+                return True
+        return False
+
+    def _flavor(self, test) -> str:
+        if self._rank_dep(test):
+            return FLAVOR_RANK
+        if self._data_dep(test):
+            return FLAVOR_DATA
+        return FLAVOR_UNIFORM
+
+    def _taint_targets(self, targets, value) -> None:
+        rank = self._rank_dep(value)
+        data = self._data_dep(value)
+        if not (rank or data):
+            return
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    if rank:
+                        self._frame.rank_tainted.add(node.id)
+                    if data:
+                        self._frame.data_tainted.add(node.id)
+
+    def _site(self, node) -> Site:
+        return Site(self.path, node.lineno, getattr(node, "col_offset", 0))
+
+    # -- collective lowering -------------------------------------------------
+    def _collective_events(self, node: ast.Call, cleanup: str) -> List[Event]:
+        tail = _tail(node.func)
+        site = self._site(node)
+        name_kw = None
+        sig: Dict[str, str] = {}
+        group = GROUP_WORLD
+        staged = tail in _TWO_LEVEL_TAILS
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                name_kw = kw.value.value
+            elif kw.arg in api.SIGNATURE_KEYWORDS:
+                sig[kw.arg] = _sig_source(kw.value)
+            elif kw.arg == "axis_index_groups" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                group = classify_groups_expr(_expr_text(kw.value))
+            elif kw.arg == "process_set" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                group = f"process_set:{_expr_text(kw.value)}"
+            elif kw.arg in ("two_level", "hierarchical") \
+                    and _truthy_const(kw.value):
+                staged = True
+        if staged:
+            # the three stage dispatches the runtime issues
+            # (parallel/hierarchical.py: local RS → cross AR → local AG)
+            return [
+                Collective(op="reducescatter", name=name_kw,
+                           group=GROUP_LOCAL, signature={}, site=site,
+                           cleanup=cleanup),
+                Collective(op="allreduce", name=name_kw, group=GROUP_CROSS,
+                           signature=sig, site=site, cleanup=cleanup),
+                Collective(op="allgather", name=name_kw, group=GROUP_LOCAL,
+                           signature={}, site=site, cleanup=cleanup),
+            ]
+        return [Collective(op=tail, name=name_kw, group=group, signature=sig,
+                           site=site, cleanup=cleanup)]
+
+    def _expr_events(self, expr, cleanup: str = "") -> List[Event]:
+        """Collective + call events inside one expression, in source
+        order (good enough for left-to-right evaluation)."""
+        if expr is None:
+            return []
+        out: List[Event] = []
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _tail(node.func)
+            d = _dotted(node.func)
+            is_coll = api.is_collective_call(d) or tail in _TWO_LEVEL_TAILS
+            # a file-local def shadowing a collective name isn't the
+            # framework op (visitor.py applies the same rule)
+            if is_coll and isinstance(node.func, ast.Name) \
+                    and tail in self._local_defs \
+                    and tail not in _TWO_LEVEL_TAILS:
+                is_coll = False
+            if is_coll:
+                out.extend(self._collective_events(node, cleanup))
+            elif tail and tail not in _OPAQUE_TAILS \
+                    and not api.is_trace_wrapper(tail):
+                out.append(Call(target=tail, site=self._site(node)))
+        out.sort(key=lambda ev: (ev.site.line, ev.site.col))
+        return out
+
+    # -- statement lowering --------------------------------------------------
+    def _lower_block(self, stmts, cleanup: str = "") -> List[Event]:
+        out: List[Event] = []
+        for stmt in stmts:
+            out.extend(self._lower_stmt(stmt, cleanup))
+        return out
+
+    def _lower_stmt(self, stmt, cleanup: str) -> List[Event]:  # noqa: C901
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._lower_function(stmt)
+            return []
+        if isinstance(stmt, ast.ClassDef):
+            # methods become plain named functions (tail-name resolution)
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._lower_function(sub)
+            return []
+        if isinstance(stmt, ast.Return):
+            return self._expr_events(stmt.value, cleanup) \
+                + [Return(self._site(stmt))]
+        if isinstance(stmt, ast.Raise):
+            return self._expr_events(stmt.exc, cleanup) \
+                + [Raise(self._site(stmt))]
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            if value is not None:
+                self._taint_targets(targets, value)
+            return self._expr_events(value, cleanup)
+        if isinstance(stmt, ast.Expr):
+            return self._expr_events(stmt.value, cleanup)
+        if isinstance(stmt, (ast.If, ast.While)):
+            return self._lower_branch(stmt, cleanup)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            body = self._lower_block(stmt.body, cleanup) \
+                + self._lower_block(stmt.orelse, cleanup)
+            if not body:
+                return []
+            return [Loop(kind="for", site=self._site(stmt), body=body)]
+        if isinstance(stmt, ast.Try):
+            return self._lower_try(stmt, cleanup)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            out: List[Event] = []
+            for item in stmt.items:
+                out.extend(self._expr_events(item.context_expr, cleanup))
+            return out + self._lower_block(stmt.body, cleanup)
+        if isinstance(stmt, ast.Assert):
+            return self._expr_events(stmt.test, cleanup)
+        if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue, ast.Import,
+                             ast.ImportFrom, ast.Global, ast.Nonlocal,
+                             ast.Delete)):
+            return []
+        return []
+
+    def _lower_branch(self, stmt, cleanup: str) -> List[Event]:
+        flavor = self._flavor(stmt.test)
+        pre = self._expr_events(stmt.test, cleanup)
+        body = self._lower_block(stmt.body, cleanup)
+        orelse = self._lower_block(stmt.orelse, cleanup)
+        kind = "if" if isinstance(stmt, ast.If) else "while"
+        if kind == "while" and flavor == FLAVOR_UNIFORM:
+            # every rank runs the same trip count — a bounded loop
+            if not (body or orelse):
+                return pre
+            return pre + [Loop(kind="while", site=self._site(stmt),
+                               body=body)] + orelse
+        if not (body or orelse):
+            return pre
+        return pre + [Branch(
+            kind=kind, flavor=flavor, condition=_expr_text(stmt.test),
+            site=self._site(stmt), body=body, orelse=orelse,
+        )]
+
+    def _lower_try(self, stmt: ast.Try, cleanup: str) -> List[Event]:
+        """Normal path: try body + else.  Exceptional path: the handler —
+        modelled as an exception-flavored branch *after* the body, since
+        exceptions strike per rank (a collective in a handler is only
+        reached by the ranks that raised: HVD012's shape).  ``finally``
+        runs on both sides, so it stays unflavored."""
+        out = self._lower_block(stmt.body, cleanup)
+        handler_events: List[Event] = []
+        for handler in stmt.handlers:
+            handler_events.extend(
+                self._lower_block(handler.body, cleanup or "except"))
+        if handler_events:
+            first = stmt.handlers[0]
+            cond = _expr_text(first.type) if first.type is not None \
+                else "Exception"
+            out.append(Branch(
+                kind="try", flavor=FLAVOR_EXCEPTION,
+                condition=f"except {cond}", site=self._site(first),
+                body=handler_events, orelse=[],
+            ))
+        out.extend(self._lower_block(stmt.orelse, cleanup))
+        out.extend(self._lower_block(stmt.finalbody, cleanup))
+        return out
+
+    def _lower_function(self, node) -> None:
+        traced = (
+            self._frame.traced
+            or node.name in self._wrapped
+            or any(self._decorator_traced(d) for d in node.decorator_list)
+        )
+        a = node.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+        info = FunctionInfo(
+            name=node.name, site=self._site(node), traced=traced,
+            wrapped=node.name in self._wrapped,
+            elastic=node.name in self._elastic,
+        )
+        self.functions.append(info)  # registered first: shadows collectives
+        self._frames.append(_Frame(traced, params))
+        try:
+            info.body = self._lower_block(node.body)
+        finally:
+            self._frames.pop()
+
+    @staticmethod
+    def _decorator_traced(dec) -> bool:
+        if api.is_trace_wrapper(_tail(dec)):
+            return True
+        if isinstance(dec, ast.Call):
+            if api.is_trace_wrapper(_tail(dec.func)):
+                return True
+            if _tail(dec.func) == "partial" and dec.args \
+                    and api.is_trace_wrapper(_tail(dec.args[0])):
+                return True
+        return False
+
+
+def extract_file(source: str, path: str) -> List[FunctionInfo]:
+    """Parse + lower one file.  Raises SyntaxError on unparsable input —
+    the driver turns that into an HVD000 finding like the linter does."""
+    tree = ast.parse(source, filename=path)
+    return Extractor(path, tree).extract()

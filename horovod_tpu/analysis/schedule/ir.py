@@ -1,0 +1,161 @@
+"""Schedule IR — what the model checker reasons about.
+
+One :class:`FunctionInfo` per ``def`` (plus a pseudo-function for each
+module's top-level body) holding an ordered event list: collectives with
+their communication *group*, calls to other functions, branches tagged
+with the taint flavor that decides whether ranks can take different arms,
+and loops.  The extractor (extract.py) lowers Python ASTs into this IR;
+the enumerator (paths.py) walks it to project per-rank collective
+sequences; the checker (checker.py) compares those sequences pairwise
+per group.
+
+Communication groups are symbolic labels, not rank lists: a whole-world
+collective is ``world``, an intra-host stage is ``local``, a cross-host
+stage is ``cross``, a restricted communicator is ``process_set:<expr>``,
+and a raw ``axis_index_groups=`` argument classifies by its source text.
+Two collectives commute in the schedule iff their groups differ — that
+is exactly the property the runtime sanitizer's vector clock enforces
+(analysis/sanitizer.py), and what HVD011 checks statically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+#: group labels for the built-in hierarchies
+GROUP_WORLD = "world"
+GROUP_LOCAL = "local"
+GROUP_CROSS = "cross"
+
+#: branch flavors, by who can take different arms
+FLAVOR_UNIFORM = "uniform"      # all ranks take the same arm (unknown which)
+FLAVOR_RANK = "rank"            # condition is rank-tainted: arms differ by rank
+FLAVOR_DATA = "data"            # per-rank data decides (inside traced code)
+FLAVOR_EXCEPTION = "exception"  # exceptions strike per rank
+
+#: flavors on which two ranks of ONE run may legitimately disagree
+DIVERGENT_FLAVORS = frozenset({FLAVOR_RANK, FLAVOR_DATA, FLAVOR_EXCEPTION})
+
+
+@dataclass(frozen=True)
+class Site:
+    file: str
+    line: int
+    col: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}"
+
+
+@dataclass
+class Collective:
+    """One collective dispatch: the unit the schedules are made of."""
+
+    op: str                                  # tail name: "allreduce", "psum"…
+    name: Optional[str]                      # constant name= kw, if any
+    group: str                               # communication group label
+    signature: Dict[str, str]                # normalized signature kwargs
+    site: Site
+    cleanup: str = ""                        # "" | "except" — abort-path flag
+
+    def key(self) -> Tuple:
+        """Schedule-equality key: two dispatches match iff these agree."""
+        return (self.op, self.name, self.group,
+                tuple(sorted(self.signature.items())))
+
+    def describe(self) -> str:
+        bits = [f"name={self.name!r}"] if self.name else []
+        bits += [f"{k}={v}" for k, v in sorted(self.signature.items())]
+        inner = ", ".join(bits)
+        return f"{self.op}({inner})" if inner else f"{self.op}()"
+
+
+@dataclass
+class Call:
+    """A call to a (possibly) user-defined function, inlined by the
+    enumerator when the callgraph can resolve it."""
+
+    target: str                              # tail name of the callee
+    site: Site
+
+
+@dataclass
+class Branch:
+    kind: str                                # "if" | "while" | "try"
+    flavor: str                              # FLAVOR_*
+    condition: str                           # source text of the test
+    site: Site
+    body: List["Event"] = field(default_factory=list)
+    orelse: List["Event"] = field(default_factory=list)
+
+
+@dataclass
+class Loop:
+    """A uniform loop (``for``, or ``while`` on an untainted condition):
+    every rank runs the same (unknown) trip count, bounded-unrolled."""
+
+    kind: str                                # "for" | "while"
+    site: Site
+    body: List["Event"] = field(default_factory=list)
+
+
+@dataclass
+class Return:
+    site: Site
+
+
+@dataclass
+class Raise:
+    site: Site
+
+
+Event = Union[Collective, Call, Branch, Loop, Return, Raise]
+
+
+@dataclass
+class FunctionInfo:
+    name: str                                # bare name ("<module>" for files)
+    site: Site
+    traced: bool                             # under spmd/jit
+    body: List[Event] = field(default_factory=list)
+    wrapped: bool = False                    # passed to spmd/jit/elastic.run
+    elastic: bool = False                    # body of hvd.elastic.run(fn, …)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.site.file}::{self.name}"
+
+
+@dataclass
+class Entry:
+    """A model-checking entry point and why it was chosen."""
+
+    fn: FunctionInfo
+    kind: str            # "module" | "root" | "wrapped" | "elastic"
+
+    @property
+    def world(self) -> str:
+        """Elastic bodies re-execute per membership epoch: their schedule
+        is checked per-epoch world, which the reports call out."""
+        return "elastic" if (self.kind == "elastic" or self.fn.elastic) \
+            else "static"
+
+
+def walk_events(events: List[Event]):
+    """Yield every event in a body, recursing into branches and loops."""
+    for ev in events:
+        yield ev
+        if isinstance(ev, Branch):
+            yield from walk_events(ev.body)
+            yield from walk_events(ev.orelse)
+        elif isinstance(ev, Loop):
+            yield from walk_events(ev.body)
+
+
+def has_collective(events: List[Event]) -> bool:
+    return any(isinstance(ev, Collective) for ev in walk_events(events))
+
+
+def called_names(events: List[Event]) -> set:
+    return {ev.target for ev in walk_events(events) if isinstance(ev, Call)}

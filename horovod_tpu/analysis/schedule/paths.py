@@ -1,0 +1,267 @@
+"""Bounded symbolic enumeration of per-rank execution paths.
+
+A *path* is one way one rank can execute an entry point: a tuple of
+branch decisions plus the collective sequence those decisions project.
+Decisions carry the taint flavor of their branch:
+
+* **uniform** decisions are taken identically by every rank of one run —
+  two paths model the same run only when their uniform decisions agree;
+* **rank / data / exception** decisions may differ *between ranks of the
+  same run* — these are the decisions a counterexample's branch chain is
+  made of.
+
+Loops are unrolled up to the loop bound (HVD_VERIFY_LOOP_BOUND); the
+total number of paths per entry is capped (HVD_VERIFY_MAX_PATHS) with
+the truncation surfaced to the caller — a bounded "verified" is reported
+as bounded, never as exhaustive.  Calls are inlined through the call
+graph with cycle detection; each projected collective remembers its call
+stack so counterexamples can print the interprocedural route.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Tuple
+
+from .callgraph import CallGraph
+from .ir import (
+    DIVERGENT_FLAVORS,
+    Branch,
+    Call,
+    Collective,
+    Entry,
+    FunctionInfo,
+    Loop,
+    Raise,
+    Return,
+)
+
+DEFAULT_MAX_PATHS = 64
+DEFAULT_LOOP_BOUND = 2
+
+
+@dataclass(frozen=True)
+class Decision:
+    site: str                 # "file:line"
+    kind: str                 # "if" | "while" | "try" | "loop"
+    flavor: str
+    condition: str
+    taken: str                # "then" | "else" | "raised" | "Nx" …
+
+    def divergent(self) -> bool:
+        return self.flavor in DIVERGENT_FLAVORS
+
+
+@dataclass(frozen=True)
+class Dispatch:
+    """One projected collective dispatch on one path."""
+
+    collective: Collective
+    stack: Tuple[str, ...]    # call sites from the entry ("file:line fn")
+
+    def key(self) -> Tuple:
+        return self.collective.key()
+
+
+@dataclass
+class _Partial:
+    decisions: Tuple[Decision, ...] = ()
+    events: Tuple[Dispatch, ...] = ()
+    terminated: Optional[str] = None      # None | "return" | "raise"
+
+
+@dataclass(frozen=True)
+class Path:
+    entry: Entry
+    decisions: Tuple[Decision, ...]
+    events: Tuple[Dispatch, ...]
+
+    def uniform_key(self) -> Tuple[Decision, ...]:
+        return tuple(d for d in self.decisions if not d.divergent())
+
+    def divergent_decisions(self) -> Tuple[Decision, ...]:
+        return tuple(d for d in self.decisions if d.divergent())
+
+
+@dataclass
+class EnumerationResult:
+    paths: List[Path] = field(default_factory=list)
+    truncated: bool = False
+
+
+class Enumerator:
+    def __init__(self, graph: CallGraph, *,
+                 max_paths: int = DEFAULT_MAX_PATHS,
+                 loop_bound: int = DEFAULT_LOOP_BOUND):
+        self.graph = graph
+        self.max_paths = max(1, int(max_paths))
+        self.loop_bound = max(0, int(loop_bound))
+        self._truncated = False
+        # per-callee path summaries (relative call stacks), so a callee
+        # is enumerated once per session instead of once per caller
+        # partial path — without this, nested calls go exponential
+        self._fn_cache: dict = {}
+        self._fn_in_progress: set = set()
+        # per-branch-arm / per-loop-body enumerations, same reason
+        self._arm_cache: dict = {}
+        self._body_cache: dict = {}
+
+    # -- public --------------------------------------------------------------
+    def enumerate(self, entry: Entry) -> EnumerationResult:
+        self._truncated = False
+        partials = self._block(entry.fn.body, entry.fn,
+                               inline=(entry.fn.qualname,))
+        seen = set()
+        paths: List[Path] = []
+        for p in partials:
+            path = Path(entry=entry, decisions=p.decisions, events=p.events)
+            key = (path.decisions, tuple(d.key() for d in path.events))
+            if key not in seen:
+                seen.add(key)
+                paths.append(path)
+        return EnumerationResult(paths=paths, truncated=self._truncated)
+
+    # -- internals -----------------------------------------------------------
+    # Call stacks are attached only when a callee summary is spliced into
+    # a caller (_compose), so _block always enumerates with relative
+    # stacks and every sub-enumeration — callee bodies, branch arms, loop
+    # bodies — is computed once and reused, keeping the whole pass
+    # polynomial in program size (times the path cap).
+    def _cap(self, partials: List[_Partial]) -> List[_Partial]:
+        if len(partials) > self.max_paths:
+            self._truncated = True
+            return partials[: self.max_paths]
+        return partials
+
+    @staticmethod
+    def _compose(p: _Partial, sub: _Partial,
+                 frame: Optional[str] = None,
+                 pre: Tuple[Decision, ...] = ()) -> _Partial:
+        events = sub.events if frame is None else tuple(
+            Dispatch(collective=d.collective, stack=(frame,) + d.stack)
+            for d in sub.events)
+        term = sub.terminated
+        if frame is not None and term == "return":
+            term = None  # a return only exits the callee
+        return _Partial(
+            decisions=p.decisions + pre + sub.decisions,
+            events=p.events + events,
+            terminated=term,
+        )
+
+    def _block(self, events, fn: FunctionInfo,
+               inline: Tuple[str, ...]) -> List[_Partial]:
+        partials = [_Partial()]
+        for ev in events:
+            nxt: List[_Partial] = []
+            for p in partials:
+                if p.terminated:
+                    nxt.append(p)
+                    continue
+                nxt.extend(self._event(ev, p, fn, inline))
+            partials = self._cap(nxt)
+        return partials
+
+    def _event(self, ev, p: _Partial, fn: FunctionInfo,
+               inline: Tuple[str, ...]) -> List[_Partial]:
+        if isinstance(ev, Collective):
+            return [replace(p, events=p.events
+                            + (Dispatch(collective=ev, stack=()),))]
+        if isinstance(ev, Return):
+            return [replace(p, terminated="return")]
+        if isinstance(ev, Raise):
+            return [replace(p, terminated="raise")]
+        if isinstance(ev, Call):
+            return self._call(ev, p, fn, inline)
+        if isinstance(ev, Branch):
+            return self._branch(ev, p, fn, inline)
+        if isinstance(ev, Loop):
+            return self._loop(ev, p, fn, inline)
+        return [p]
+
+    def _call(self, ev: Call, p: _Partial, fn: FunctionInfo,
+              inline: Tuple[str, ...]) -> List[_Partial]:
+        callee = self.graph.resolve(ev.target, from_file=fn.site.file)
+        if callee is None or callee.qualname in inline \
+                or callee.qualname in self._fn_in_progress:
+            return [p]  # opaque / recursive — no schedule contribution
+        subs = self._fn_summary(callee)
+        if not subs:
+            return [p]
+        frame = f"{ev.site} {ev.target}()"
+        return [self._compose(p, sub, frame=frame) for sub in subs]
+
+    def _fn_summary(self, callee: FunctionInfo) -> List[_Partial]:
+        """The callee's own path summaries (call stacks relative to the
+        callee), computed once and reused at every call site.  Summaries
+        with no decisions, events, or raise are collapsed away."""
+        key = callee.qualname
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        self._fn_in_progress.add(key)
+        try:
+            subs = self._block(callee.body, callee, inline=(key,))
+        finally:
+            self._fn_in_progress.discard(key)
+        seen = set()
+        pruned: List[_Partial] = []
+        for sub in subs:
+            term = "raise" if sub.terminated == "raise" else "return"
+            if not (sub.decisions or sub.events or term == "raise"):
+                continue
+            k = (sub.decisions, tuple(d.key() for d in sub.events), term)
+            if k in seen:
+                continue
+            seen.add(k)
+            pruned.append(_Partial(decisions=sub.decisions,
+                                   events=sub.events, terminated=term))
+        self._fn_cache[key] = pruned
+        return pruned
+
+    def _arms(self, ev: Branch, fn: FunctionInfo, inline: Tuple[str, ...]):
+        cached = self._arm_cache.get(id(ev))
+        if cached is not None:
+            return cached
+        arms = [("then", ev.body), ("else", ev.orelse)]
+        if ev.kind == "try":
+            arms = [("no raise", ev.orelse), ("raised", ev.body)]
+        elif ev.kind == "while":
+            arms = [("enter once", ev.body), ("skip", ev.orelse)]
+        out = [(taken, self._block(arm, fn, inline)) for taken, arm in arms]
+        self._arm_cache[id(ev)] = out
+        return out
+
+    def _branch(self, ev: Branch, p: _Partial, fn: FunctionInfo,
+                inline: Tuple[str, ...]) -> List[_Partial]:
+        site = str(ev.site)
+        out: List[_Partial] = []
+        for taken, subs in self._arms(ev, fn, inline):
+            d = Decision(site=site, kind=ev.kind, flavor=ev.flavor,
+                         condition=ev.condition, taken=taken)
+            for sub in subs:
+                out.append(self._compose(p, sub, pre=(d,)))
+        return out
+
+    def _loop(self, ev: Loop, p: _Partial, fn: FunctionInfo,
+              inline: Tuple[str, ...]) -> List[_Partial]:
+        site = str(ev.site)
+        body_variants = self._body_cache.get(id(ev))
+        if body_variants is None:
+            body_variants = self._block(ev.body, fn, inline)
+            self._body_cache[id(ev)] = body_variants
+        out: List[_Partial] = []
+        for k in range(self.loop_bound + 1):
+            d = Decision(site=site, kind="loop", flavor="uniform",
+                         condition=ev.kind, taken=f"{k} iteration(s)")
+            seeds = [replace(p, decisions=p.decisions + (d,))]
+            for _ in range(k):
+                nxt: List[_Partial] = []
+                for seed in seeds:
+                    if seed.terminated:
+                        nxt.append(seed)
+                        continue
+                    for sub in body_variants:
+                        nxt.append(self._compose(seed, sub))
+                seeds = self._cap(nxt)
+            out.extend(seeds)
+        return self._cap(out)

@@ -116,6 +116,35 @@ class FileFacts:
     env_reads: List[EnvRead] = field(default_factory=list)
     mutable_defaults: List[Tuple[int, int, str]] = field(default_factory=list)
     bare_excepts: List[Tuple[int, int]] = field(default_factory=list)
+    #: statement line spans for suppression mapping (statement_spans)
+    spans: List[Tuple[int, int]] = field(default_factory=list)
+
+
+def statement_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    """(start, end) line spans per statement, for suppression mapping
+    (findings.Suppressions.attach_spans): a simple statement spans its
+    whole source extent — a suppression on the closing paren of a
+    multi-line call attaches to the call's reported line — and a
+    compound statement (def/class/if/for/try…) spans its *header* only,
+    decorators included, so a suppression on a decorator line attaches
+    to findings anchored in the signature without silencing the body."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body \
+                and isinstance(body[0], ast.stmt):
+            start = node.lineno
+            decorators = getattr(node, "decorator_list", None)
+            if decorators:
+                start = min([d.lineno for d in decorators] + [start])
+            end = max(start, body[0].lineno - 1)
+            spans.append((start, end))
+        else:
+            spans.append((node.lineno,
+                          getattr(node, "end_lineno", None) or node.lineno))
+    return spans
 
 
 class _Frame:
@@ -407,4 +436,5 @@ def collect_facts(source: str, path: str) -> FileFacts:
     tree = ast.parse(source, filename=path)
     v = FactVisitor(path, tree)
     v.visit(tree)
+    v.facts.spans = statement_spans(tree)
     return v.facts

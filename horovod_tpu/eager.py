@@ -45,13 +45,19 @@ from .timeline.timeline import timeline
 from .utils import env as env_util
 
 
-def _dispatch_guard(name: str, op: str, tensors):
+def _dispatch_guard(name: str, op: str, tensors, stages=None):
     """Shared pre-dispatch path for eager collectives: collective
     sanitizer fingerprint check (HVD_SANITIZER=1; analysis/sanitizer.py) +
     stall watchdog + timeline NEGOTIATE span + metrics (bytes/calls/
     latency per op) + (in multi-controller jobs) the native controller
     handshake that guarantees identical op ordering across processes (see
-    runtime/eager_controller.py)."""
+    runtime/eager_controller.py).
+
+    ``stages`` (a list of parallel/hierarchical.py DispatchStage) is the
+    per-group dispatch sequence of a hierarchical collective: the
+    sanitizer then fingerprints each stage against its own group's
+    members — the two-level intra-host and cross-host stages stop
+    cross-matching against the flat world."""
     import contextlib
     import time as _time
 
@@ -68,7 +74,14 @@ def _dispatch_guard(name: str, op: str, tensors):
         _faults.on_dispatch(name)
         # Before the watchdog/negotiation: a divergence must raise the
         # sanitizer's diagnostic, not mature into a stall warning first.
-        _sanitizer.maybe_check(op=op, name=name, shape=shape, dtype=dtype)
+        if stages:
+            for st in stages:
+                _sanitizer.maybe_check(op=st.op, name=name, shape=shape,
+                                       dtype=dtype, group=st.group,
+                                       peers=st.peers)
+        else:
+            _sanitizer.maybe_check(op=op, name=name, shape=shape,
+                                   dtype=dtype)
         mon = metrics.on()
         t0 = _time.perf_counter() if mon else 0.0
         t_neg = t0
@@ -133,7 +146,8 @@ def _spmd_op(fn, *, out_sharded: bool):
     )
 
 
-def allreduce_(tensors, *, op: str = Average, name: Optional[str] = None):
+def allreduce_(tensors, *, op: str = Average, name: Optional[str] = None,
+               two_level: Optional[bool] = None):
     """Eager allreduce.  ``tensors``: list of per-rank arrays (len == size())
     or a rank-sharded global array.  Returns the same structure, reduced.
 
@@ -141,15 +155,33 @@ def allreduce_(tensors, *, op: str = Average, name: Optional[str] = None):
     (reference horovod/torch/mpi_ops.py:72-129) — async dispatch is native
     to JAX, so the returned arrays are futures already; materializing them
     is the ``synchronize`` step.
+
+    ``two_level`` selects the hierarchical local/cross decomposition
+    (default: the HVD_TWO_LEVEL_ALLREDUCE knob); the dispatch guard then
+    fingerprints the per-group stage plan so a sanitized run checks each
+    stage against its own group (parallel/hierarchical.py
+    process_stage_plan).
     """
+    from .parallel import hierarchical as _hier
+
     name = name or "allreduce.eager"
-    with _dispatch_guard(name, "allreduce", tensors):
+    if two_level is None:
+        two_level = _hier.use_two_level_default()
+    # mirror the dispatch exactly: collectives.allreduce only takes the
+    # two-level path for the ops the decomposition supports.  The stage
+    # plan only feeds sanitizer fingerprints — skip the topology math on
+    # the (common) unsanitized path.
+    staged = (two_level and op in (Average, Sum, Adasum)
+              and _sanitizer.instance() is not None)
+    stages = _hier.process_stage_plan("allreduce") if staged else None
+    with _dispatch_guard(name, "allreduce", tensors, stages=stages):
         as_list = _is_per_rank_list(tensors)
         x = put_per_rank(list(tensors)) if as_list else tensors
 
         def body(v):
             with rank_context((core.AXIS,)):
-                return collectives.allreduce(v[0], op=op)[None]
+                return collectives.allreduce(
+                    v[0], op=op, two_level=two_level)[None]
 
         out = _spmd_op(body, out_sharded=True)(x)
         return get_per_rank(out) if as_list else out

@@ -104,6 +104,12 @@ TRACED_CALLS = registry.counter(
 TRACED_BYTES = registry.counter(
     "hvd_collectives_traced_bytes_total",
     "Per-rank payload bytes of traced collectives.", ("op",))
+TRACED_GROUP_CALLS = registry.counter(
+    "hvd_collectives_traced_group_total",
+    "Traced collectives dispatched over a restricted communication "
+    "group (two-level local/cross stages, process sets) — the group-"
+    "labelled inventory the schedule checker and sanitizer reason "
+    "about.", ("op", "group"))
 
 STEP_SECONDS = registry.histogram(
     "hvd_step_seconds",
@@ -264,6 +270,23 @@ def record_traced(op: str, tensor) -> None:
                            getattr(tensor, "dtype", "float32"))
         if nb:
             TRACED_BYTES.labels(op).inc(nb)
+    except Exception:  # noqa: BLE001 — tracing must never fail on metrics
+        pass
+
+
+def record_traced_group(op: str, group: str) -> None:
+    """Group-labelled traced-collective inventory (two-level local/cross
+    stages, process sets) — rides its own counter so the user-visible
+    per-op dispatch (already counted by :func:`record_traced` at the
+    call seam) is not double-counted.  ``group`` here is the group
+    *family* (``local`` / ``cross`` / ``process_set:…``): tracing emits
+    one program for every device, so there is no single concrete group
+    instance to name — the sanitizer's runtime fingerprints key the
+    concrete instances (``local:<node>``, ``cross:<chunk>``)."""
+    if not registry.enabled:
+        return
+    try:
+        TRACED_GROUP_CALLS.labels(op, group).inc()
     except Exception:  # noqa: BLE001 — tracing must never fail on metrics
         pass
 

@@ -28,9 +28,23 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import core
+from .. import metrics as _metrics
 from ..core import Average, Sum
 from ..utils import env as env_util
 from .compression import Compression
+
+
+def dispatch_group_label(process_set=None) -> str:
+    """The communication-group label a dispatch reduces over — ``world``
+    for the flat mesh, ``process_set:<ranks>`` for a restricted
+    communicator.  The label vocabulary is a protocol string documented
+    in docs/analysis.md: the traced inventory
+    (metrics.record_traced_group), the runtime sanitizer fingerprints
+    (analysis/sanitizer.py), and the static schedule checker
+    (analysis/schedule/ir.py) all spell the same family names."""
+    if process_set is None:
+        return "world"
+    return "process_set:" + ",".join(str(r) for r in process_set.ranks)
 
 
 class FusionPlan:
@@ -237,6 +251,13 @@ def fused_allreduce(
         groups, group_size = None, core.size()
     else:
         groups, group_size = process_set.groups(), process_set.size()
+    # group identity surfaced to dispatch: restricted-communicator
+    # reductions ride the group-labelled traced inventory (the flat
+    # world is the unlabelled default, counted at the collectives seam)
+    group_label = dispatch_group_label(process_set)
+    if group_label != "world":
+        for _ in tensors:
+            _metrics.record_traced_group("allreduce", group_label)
     if residuals is not None and len(residuals) != len(tensors):
         raise ValueError(
             f"error-feedback residual list has {len(residuals)} entries "

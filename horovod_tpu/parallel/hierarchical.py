@@ -20,6 +20,9 @@ parameter_manager.cc — ours is a candidate knob in optim/autotune.py).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -41,6 +44,62 @@ def _cross_groups_for_chunk() -> list:
     ls = core.local_size()
     return [
         [n * ls + r for n in range(core.cross_size())] for r in range(ls)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# group identity surfaced to dispatch (the sanitizer/model-checker seam)
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class DispatchStage:
+    """One stage of a hierarchical dispatch, as the group/epoch-aware
+    sanitizer fingerprints it (analysis/sanitizer.py): the op kind, the
+    communication-group label, and the group's member ranks."""
+
+    op: str
+    group: str
+    peers: Tuple[int, ...]
+
+
+def process_group_members(rank: int, size: int,
+                          local_size: int) -> Tuple[Tuple[int, ...],
+                                                    Tuple[int, ...]]:
+    """(local members, cross members) of ``rank`` on the flat 1-D rank
+    line — the pure topology shared by the device-plane groups above and
+    the process-plane sanitizer stage plan below."""
+    node, chunk = divmod(rank, local_size)
+    local = tuple(range(node * local_size, (node + 1) * local_size))
+    cross = tuple(n * local_size + chunk
+                  for n in range(size // local_size))
+    return local, cross
+
+
+def process_stage_plan(op: str = "allreduce", *,
+                       rank: Optional[int] = None,
+                       size: Optional[int] = None,
+                       local_size: Optional[int] = None
+                       ) -> Optional[List[DispatchStage]]:
+    """The per-group dispatch sequence a two-level collective issues on
+    ``rank``, over *controller processes* — what the sanitizer must
+    fingerprint so the intra-host and cross-host stages check against
+    their own groups instead of the flat world.  None when the process
+    topology is trivial (single host, single process per host, or an
+    uneven split): the dispatch is then one flat-world collective."""
+    if rank is None:
+        rank = core.process_rank()
+    if size is None:
+        size = core.process_size()
+    if local_size is None:
+        local_size = env_util.get_int(env_util.HVD_LOCAL_SIZE, 0) or 1
+    if size <= 1 or local_size <= 1 or local_size >= size \
+            or size % local_size:
+        return None
+    local, cross = process_group_members(rank, size, local_size)
+    node, chunk = divmod(rank, local_size)
+    return [
+        DispatchStage("reducescatter", f"local:{node}", local),
+        DispatchStage(op, f"cross:{chunk}", cross),
+        DispatchStage("allgather", f"local:{node}", local),
     ]
 
 
@@ -77,6 +136,7 @@ def hierarchical_allreduce(tensor, *, op: str = Average):
     if pad:
         flat = jnp.pad(flat, (0, pad))
 
+    _record_stage_inventory(flat)
     shard = lax.psum_scatter(
         flat, axis, scatter_dimension=0, tiled=True,
         axis_index_groups=_local_groups(),
@@ -91,6 +151,25 @@ def hierarchical_allreduce(tensor, *, op: str = Average):
     if op == Average:
         out = out / core.size()
     return out
+
+
+def _record_stage_inventory(flat) -> None:
+    """Group-labelled traced inventory for the three hierarchical stages
+    (runs at trace time, once per compile).  Labels are the group
+    *families* (``local`` / ``cross``) — the same vocabulary hvd_verify
+    projects statically; the sanitizer's runtime fingerprints key the
+    concrete instances (``local:<node>``, ``cross:<chunk>``,
+    process_stage_plan).  The user-facing ``allreduce`` dispatch itself
+    is already counted once by collectives.allreduce — these ride the
+    separate ``hvd_collectives_traced_group_total`` counter only."""
+    try:
+        from .. import metrics as _metrics
+
+        _metrics.record_traced_group("reducescatter", "local")
+        _metrics.record_traced_group("allreduce", "cross")
+        _metrics.record_traced_group("allgather", "local")
+    except Exception:  # noqa: BLE001 — accounting never breaks tracing
+        pass
 
 
 def _count_two_level_fallback(reason: str) -> None:
@@ -183,6 +262,7 @@ def two_level_allreduce(tensor, *, op: str = Average,
     if pad:
         flat = jnp.pad(flat, (0, pad))
 
+    _record_stage_inventory(flat)
     shard = lax.psum_scatter(
         flat, axis, scatter_dimension=0, tiled=True,
         axis_index_groups=_local_groups(),

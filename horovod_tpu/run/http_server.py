@@ -256,22 +256,34 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         snaps.append(({"rank": "launcher"}, registry.snapshot()))
         return snaps
 
-    def _sanitizer_table(self) -> Dict[str, Dict[str, object]]:
-        """Published collective fingerprints grouped by sequence number:
-        ``{"5": {"0": {...}, "1": {...}}}`` — the live view of which rank
-        is ahead/behind when the sanitizer (or an operator) is chasing a
-        divergence."""
+    def _sanitizer_table(self) -> Dict[str, Dict[str, Dict[str, object]]]:
+        """Published collective fingerprints partitioned by communication
+        group, then ``<epoch>.<seq>``, then rank:
+        ``{"world": {"0.5": {"0": {...}, "1": {...}}}}`` — the live view
+        of which rank is ahead/behind *within each group* when the
+        sanitizer (or an operator) is chasing a divergence.  Keys are
+        ``<group>.<epoch>.<seq>.<rank>`` (analysis/sanitizer.py); legacy
+        two-part ``<seq>.<rank>`` keys render under ``world`` epoch 0."""
         store: Dict[str, bytes] = self.server.store  # type: ignore
         with self.server.lock:  # type: ignore
             raw = {k[len(_SANITIZER_PREFIX):]: v for k, v in store.items()
                    if k.startswith(_SANITIZER_PREFIX)}
-        table: Dict[str, Dict[str, object]] = {}
+        table: Dict[str, Dict[str, Dict[str, object]]] = {}
         for key, val in raw.items():
-            seq, _, rank = key.partition(".")
+            parts = key.split(".")
+            if len(parts) == 4:
+                group, epoch, seq, rank = parts
+            elif len(parts) == 2:
+                group, epoch = "world", "0"
+                seq, rank = parts
+            else:
+                continue
             try:
-                table.setdefault(seq, {})[rank] = json.loads(val)
+                decoded: object = json.loads(val)
             except (ValueError, TypeError):
-                table.setdefault(seq, {})[rank] = "<undecodable>"
+                decoded = "<undecodable>"
+            table.setdefault(group, {}).setdefault(
+                f"{epoch}.{seq}", {})[rank] = decoded
         return table
 
     def _health_report(self) -> Dict[str, object]:

@@ -104,7 +104,11 @@ HVD_METRICS_PUSH_SECONDS = "HVD_METRICS_PUSH_SECONDS"  # push interval (default 
 # collective sanitizer + linter (horovod_tpu/analysis/)
 HVD_SANITIZER = "HVD_SANITIZER"                        # 1 fingerprints every eager dispatch
 HVD_SANITIZER_TIMEOUT_SECONDS = "HVD_SANITIZER_TIMEOUT_SECONDS"  # peer wait (default 60)
+HVD_SANITIZER_EPOCH_STRICT = "HVD_SANITIZER_EPOCH_STRICT"  # 0 lets checks span membership epochs (default 1)
 HVD_LINT_DISABLE = "HVD_LINT_DISABLE"                  # comma list of rule IDs hvd_lint skips
+# schedule model checker (analysis/schedule/, scripts/hvd_verify.py)
+HVD_VERIFY_MAX_PATHS = "HVD_VERIFY_MAX_PATHS"          # per-entry path budget (default 64)
+HVD_VERIFY_LOOP_BOUND = "HVD_VERIFY_LOOP_BOUND"        # loop unroll bound (default 2)
 # dPRO-style replay engine (horovod_tpu/timeline/replay/)
 HVD_REPLAY_CLOCK_SYNC = "HVD_REPLAY_CLOCK_SYNC"        # 0 skips the init-time clock handshake
 HVD_REPLAY_CLOCK_SAMPLES = "HVD_REPLAY_CLOCK_SAMPLES"  # handshake round trips (default 8)

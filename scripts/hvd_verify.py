@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""hvd_verify: whole-program collective-schedule model checker.
+
+Where hvd_lint flags single-statement smells, this proves (bounded)
+schedule compatibility across ranks interprocedurally: it builds a call
+graph over the given training code, enumerates the execution paths each
+rank can take through rank-tainted branches (loops unrolled up to
+HVD_VERIFY_LOOP_BOUND, at most HVD_VERIFY_MAX_PATHS paths per entry),
+projects every path's collective sequence per communication group
+(world / intra-host local / cross-host / process sets / per-epoch
+elastic worlds), and checks the sequences pairwise:
+
+    HVD009  schedule divergence within one group
+    HVD010  blocking collective reachable on a strict subset of ranks
+    HVD011  cross-group ordering inversion (intra vs cross stages)
+    HVD012  collective on an abort/cleanup path that peers skip
+
+A finding prints a counterexample trace — the diverging rank set, the
+collective, and the exact branch chain (file:line per decision) — in
+text and, with ``--json``, as a machine-checkable payload.
+
+Run::
+
+    python scripts/hvd_verify.py examples/ horovod_tpu/   # verify the repo
+    python scripts/hvd_verify.py --json my_train.py       # CI consumption
+    python scripts/hvd_verify.py --entry train_step my_train.py
+    python scripts/hvd_verify.py --list-rules
+
+Suppress like the linter: ``# hvd-lint: disable=HVD010`` on the site (or
+anywhere in the enclosing statement), ``# hvd-lint: disable-file=…`` for
+the file.  Exit codes: 0 clean, 1 findings, 2 usage error.  The runtime
+counterpart is the group/epoch-aware HVD_SANITIZER=1 collective
+sanitizer (docs/analysis.md).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from horovod_tpu.analysis.cli import main_verify  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main_verify())

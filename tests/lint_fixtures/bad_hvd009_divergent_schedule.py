@@ -1,0 +1,20 @@
+"""Known-bad: interprocedural schedule divergence (HVD009) — rank 0
+reaches an allreduce through one helper while the other ranks reach a
+broadcast through another; the linter's single-statement HVD001 cannot
+see it (no collective is lexically inside the branch), the model
+checker's path projection can."""
+import horovod_tpu as hvd
+
+
+def _reduce(x):
+    return hvd.allreduce(x, name="loss")
+
+
+def _sync(x):
+    return hvd.broadcast(x, root_rank=0, name="step")
+
+
+def train(x):
+    if hvd.rank() == 0:
+        return _reduce(x)
+    return _sync(x)

@@ -1,0 +1,14 @@
+"""Known-bad: a blocking collective reachable on a strict subset of
+ranks (HVD010) — the checkpoint flush allgathers shards, but only rank 0
+ever calls it; every other rank sails past and rank 0 blocks forever."""
+import horovod_tpu as hvd
+
+
+def _flush(state):
+    return hvd.allgather(state, name="ckpt.shards")
+
+
+def checkpoint(state):
+    if hvd.rank() == 0:
+        state = _flush(state)
+    return state

@@ -1,0 +1,17 @@
+"""Known-bad: cross-group ordering inversion (HVD011) — both arms run
+one intra-host and one cross-host collective (per-group sequences
+match!), but in opposite orders: local-rank-0 processes block in the
+local stage while the others block in the cross stage."""
+from jax import lax
+
+import horovod_tpu as hvd
+
+
+def step(g):
+    if hvd.local_rank() == 0:
+        g = lax.psum(g, "hvd", axis_index_groups=_local_groups())
+        g = lax.psum(g, "hvd", axis_index_groups=_cross_groups())
+    else:
+        g = lax.psum(g, "hvd", axis_index_groups=_cross_groups())
+        g = lax.psum(g, "hvd", axis_index_groups=_local_groups())
+    return g

@@ -1,0 +1,18 @@
+"""Known-bad: collective on an abort/cleanup path (HVD012) — the drain
+allreduce runs only on ranks whose step raised; peers that did not raise
+never join it, so the cleanup deadlocks exactly when it matters."""
+import horovod_tpu as hvd
+
+
+def _step(s):
+    return hvd.allreduce(s, name="grads")
+
+
+def train(state, steps):
+    try:
+        for _ in range(steps):
+            state = _step(state)
+    except RuntimeError:
+        state = hvd.allreduce(state, name="drain")
+        raise
+    return state

@@ -1,0 +1,19 @@
+"""Known-good twin of bad_hvd009: both arms reach the *same* collective
+schedule through different helpers — per-rank logging may diverge, the
+wire schedule does not."""
+import horovod_tpu as hvd
+
+
+def _reduce_quiet(x):
+    return hvd.allreduce(x, name="loss")
+
+
+def _reduce_verbose(x):
+    print("step")
+    return hvd.allreduce(x, name="loss")
+
+
+def train(x):
+    if hvd.rank() == 0:
+        return hvd.allreduce(x, name="loss")
+    return hvd.allreduce(x, name="loss")

@@ -1,0 +1,15 @@
+"""Known-good twin of bad_hvd010: every rank joins the allgather; only
+the write inside the rank guard is rank-local (no collective)."""
+import horovod_tpu as hvd
+
+
+def _write(shards):
+    with open("/tmp/ckpt", "w") as f:
+        f.write(str(len(shards)))
+
+
+def checkpoint(state):
+    shards = hvd.allgather(state, name="ckpt.shards")
+    if hvd.rank() == 0:
+        _write(shards)
+    return state

@@ -1,0 +1,15 @@
+"""Known-good twin of bad_hvd011: both arms issue the two groups'
+collectives in the same relative order (local stage first)."""
+from jax import lax
+
+import horovod_tpu as hvd
+
+
+def step(g):
+    if hvd.local_rank() == 0:
+        g = lax.psum(g, "hvd", axis_index_groups=_local_groups())
+        g = lax.psum(g, "hvd", axis_index_groups=_cross_groups())
+    else:
+        g = lax.psum(g * 2.0, "hvd", axis_index_groups=_local_groups())
+        g = lax.psum(g, "hvd", axis_index_groups=_cross_groups())
+    return g

@@ -1,0 +1,19 @@
+"""Known-good twin of bad_hvd012: the handler only does rank-local
+cleanup (log + re-raise); the collective schedule is identical whether
+or not this rank raised — survivors are released by the coordinated
+abort plane (elastic/abort.py), not by a cleanup collective."""
+import horovod_tpu as hvd
+
+
+def _step(s):
+    return hvd.allreduce(s, name="grads")
+
+
+def train(state, steps):
+    try:
+        for _ in range(steps):
+            state = _step(state)
+    except RuntimeError as e:
+        print(f"aborting: {e}")
+        raise
+    return state

@@ -1,0 +1,20 @@
+"""Suppression line-mapping fixtures (satellite): the disable comment
+sits on a *decorator line* or on the *closing paren of a multi-line
+call* — away from the line the finding is reported on — and must still
+attach, mapped through the enclosing statement's line span."""
+import functools
+
+import horovod_tpu as hvd
+
+
+@functools.lru_cache  # known-shared accumulator; hvd-lint: disable=HVD005
+def cached(x, acc=[]):
+    acc.append(x)
+    return acc
+
+
+def fire_and_forget(x):
+    hvd.allreduce(
+        x,
+        op=hvd.Sum,
+    )  # warm-up dispatch, result unused; hvd-lint: disable=HVD008

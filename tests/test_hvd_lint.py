@@ -86,6 +86,57 @@ def test_suppression_comments_silence_findings():
     assert lint_paths([_fixture("suppressed.py")]) == []
 
 
+def test_suppression_maps_through_statement_spans():
+    """SATELLITE fix: a disable comment on a decorator line or on the
+    closing paren of a multi-line call attaches to the statement's
+    reported finding line (suppressed_spans.py pins both shapes)."""
+    assert lint_paths([_fixture("suppressed_spans.py")]) == [], \
+        [f.format() for f in lint_paths([_fixture("suppressed_spans.py")])]
+
+
+def test_decorator_line_suppression_attaches_to_signature():
+    src = (
+        "import functools\n"
+        "@functools.lru_cache  # hvd-lint: disable=HVD005\n"
+        "def f(acc=[]):\n"
+        "    return acc\n"
+    )
+    assert lint_sources([("d.py", src)]) == []
+    # without the span mapping the finding anchors on line 3, not 2
+    stripped = src.replace("  # hvd-lint: disable=HVD005", "")
+    assert [(f.rule, f.line) for f in lint_sources([("d.py", stripped)])] \
+        == [("HVD005", 3)]
+
+
+def test_closing_paren_suppression_attaches_to_call_line():
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def f(x):\n"
+        "    hvd.allreduce(\n"
+        "        x,\n"
+        "    )  # hvd-lint: disable=HVD008\n"
+    )
+    assert lint_sources([("c.py", src)]) == []
+    stripped = src.replace("  # hvd-lint: disable=HVD008", "")
+    assert [(f.rule, f.line) for f in lint_sources([("c.py", stripped)])] \
+        == [("HVD008", 3)]
+
+
+def test_span_suppression_does_not_leak_into_function_body():
+    """The decorator/header span must not silence findings in the body —
+    the mapping is per statement, not per function."""
+    src = (
+        "import functools\n"
+        "@functools.wraps  # hvd-lint: disable=HVD006\n"
+        "def f(x):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except:\n"
+        "        return None\n"
+    )
+    assert [f.rule for f in lint_sources([("b.py", src)])] == ["HVD006"]
+
+
 def test_file_level_suppression():
     src = (
         "# hvd-lint: disable-file=HVD006\n"

@@ -1,0 +1,319 @@
+"""hvd_verify: the interprocedural collective-schedule model checker
+(horovod_tpu/analysis/schedule/).
+
+Fixture corpus under tests/lint_fixtures/ pins one known-bad and one
+known-good snippet per schedule rule (exact rule IDs + finding lines);
+the repo self-verification runs from tier-1 so a new interprocedural
+rank-guarded collective fails fast with its counterexample trace — the
+pattern of tests/test_hvd_lint.py, one analysis layer up."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.analysis import ALL_RULES, RULES
+from horovod_tpu.analysis.schedule import (
+    SCHEDULE_RULES,
+    check_paths,
+    check_sources,
+    render_result_json,
+    render_result_text,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+VERIFY_CLI = os.path.join(REPO, "scripts", "hvd_verify.py")
+LINT_CLI = os.path.join(REPO, "scripts", "hvd_lint.py")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# rule → (bad fixture, expected finding lines, good fixture)
+CORPUS = {
+    "HVD009": ("bad_hvd009_divergent_schedule.py", [10],
+               "good_hvd009_divergent_schedule.py"),
+    "HVD010": ("bad_hvd010_subset_barrier.py", [8],
+               "good_hvd010_subset_barrier.py"),
+    "HVD011": ("bad_hvd011_ordering_inversion.py", [13],
+               "good_hvd011_ordering_inversion.py"),
+    "HVD012": ("bad_hvd012_abort_path.py", [16],
+               "good_hvd012_abort_path.py"),
+}
+
+
+def test_corpus_covers_every_schedule_rule():
+    assert set(CORPUS) == set(SCHEDULE_RULES), \
+        "fixture corpus out of sync with the schedule rule catalogue"
+    # and the merged user-facing catalogue has no ID collisions
+    assert set(ALL_RULES) == set(RULES) | set(SCHEDULE_RULES)
+    assert not set(RULES) & set(SCHEDULE_RULES)
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_known_bad_fixture_fires_exact_rule_and_lines(rule):
+    bad, lines, _good = CORPUS[rule]
+    result = check_paths([_fixture(bad)])
+    findings = result.findings
+    assert findings, f"{bad} produced no findings"
+    assert {f.rule for f in findings} == {rule}, \
+        f"{bad}: expected only {rule}, got {[f.format() for f in findings]}"
+    assert [f.line for f in findings] == lines
+    assert all(f.file.endswith(bad) for f in findings)
+    assert all(f.severity == SCHEDULE_RULES[rule][0] for f in findings)
+    # every finding carries a machine-checkable counterexample
+    for f in findings:
+        ce = f.extra["counterexample"]
+        assert ce["entry"] and ce["collective"]["op"]
+        assert ce["branch_chain_a"] or ce["branch_chain_b"]
+
+
+@pytest.mark.parametrize("rule", sorted(CORPUS))
+def test_known_good_fixture_is_clean(rule):
+    _bad, _lines, good = CORPUS[rule]
+    result = check_paths([_fixture(good)])
+    assert result.findings == [], \
+        [f.format() for f in result.findings]
+
+
+def test_repo_self_verification_clean():
+    """Tier-1 acceptance: hvd_verify over horovod_tpu/ + examples/ must
+    stay finding-free (intentional per-group sites are annotated in
+    source) — a new interprocedural divergence fails the suite with its
+    counterexample text."""
+    result = check_paths([os.path.join(REPO, "examples"),
+                          os.path.join(REPO, "horovod_tpu")])
+    assert result.findings == [], render_result_text(result)
+    assert result.entries > 10           # it actually analyzed the repo
+    assert result.paths_explored > result.entries
+
+
+def test_counterexample_names_rank_set_collective_and_branch_chain():
+    """The acceptance-criteria shape: a seeded divergence names the
+    diverging rank set, the collective, and the exact branch chain
+    (file:line per decision) in text AND in JSON."""
+    bad = _fixture("bad_hvd009_divergent_schedule.py")
+    result = check_paths([bad])
+    text = render_result_text(result)
+    assert "hvd.rank() == 0" in text                 # the rank set
+    assert "allreduce(name='loss')" in text          # the collective
+    assert f"{bad}:18" in text                       # decision file:line
+    assert "takes 'then'" in text and "takes 'else'" in text
+    payload = json.loads(render_result_json(result))
+    ce = payload["findings"][0]["counterexample"]
+    assert "hvd.rank() == 0" in ce["rank_set_a"]
+    assert ce["collective"] == {"op": "allreduce", "name": "loss",
+                                "file": bad, "line": 10}
+    chain = ce["branch_chain_a"]
+    assert chain and chain[0]["file"] == bad and chain[0]["line"] == 18
+    assert chain[0]["flavor"] == "rank" and chain[0]["taken"] == "then"
+    assert ce["call_stack"] and "_reduce()" in ce["call_stack"][0]
+
+
+def test_json_output_schema():
+    """The --json contract CI consumes: stable top-level keys, stable
+    finding keys, stable counterexample keys."""
+    proc = subprocess.run(
+        [sys.executable, VERIFY_CLI, "--json",
+         _fixture("bad_hvd010_subset_barrier.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert set(payload) == {"findings", "count", "entries",
+                            "paths_explored", "truncated"}
+    assert payload["count"] == 1 and not payload["truncated"]
+    f = payload["findings"][0]
+    assert {"rule", "message", "file", "line", "col", "severity",
+            "counterexample"} <= set(f)
+    assert set(f["counterexample"]) == {
+        "entry", "entry_kind", "world", "group", "collective",
+        "rank_set_a", "rank_set_b", "branch_chain_a", "branch_chain_b",
+        "call_stack", "schedule_a", "schedule_b"}
+    assert {"file", "line", "kind", "flavor", "condition", "taken"} == \
+        set(f["counterexample"]["branch_chain_a"][0])
+
+
+def test_cli_self_verification_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, VERIFY_CLI, "examples"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_cli_list_rules_and_usage_error():
+    proc = subprocess.run(
+        [sys.executable, VERIFY_CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for rule in SCHEDULE_RULES:
+        assert rule in proc.stdout
+    bad = subprocess.run(
+        [sys.executable, VERIFY_CLI, "no_such_dir_xyz"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+
+
+def test_hvd_lint_model_check_merges_findings():
+    """`hvd_lint --model-check` runs both analyses in one session: the
+    schedule findings ride the lint report (and the lint-only run stays
+    blind to them)."""
+    bad = _fixture("bad_hvd010_subset_barrier.py")
+    lint_only = subprocess.run(
+        [sys.executable, LINT_CLI, "--format", "json", bad],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert lint_only.returncode == 0, lint_only.stdout  # HVD001 can't see it
+    merged = subprocess.run(
+        [sys.executable, LINT_CLI, "--model-check", "--format", "json",
+         bad],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert merged.returncode == 1, merged.stdout + merged.stderr
+    rules = {f["rule"] for f in json.loads(merged.stdout)["findings"]}
+    assert "HVD010" in rules
+
+
+def test_suppression_comment_silences_schedule_finding():
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def f(x):\n"
+        "    if hvd.rank() == 0:\n"
+        "        x = hvd.allgather(x)  # hvd-lint: disable=HVD010\n"
+        "    return x\n"
+    )
+    assert check_sources([("f.py", src)]).findings == []
+    # …and the same source without the comment fires
+    assert [f.rule for f in check_sources(
+        [("f.py", src.replace("  # hvd-lint: disable=HVD010", ""))]
+    ).findings] == ["HVD010"]
+
+
+def test_disable_env_knob_applies(monkeypatch):
+    bad = _fixture("bad_hvd012_abort_path.py")
+    monkeypatch.setenv("HVD_LINT_DISABLE", "HVD012")
+    assert check_paths([bad]).findings == []
+
+
+def test_max_paths_env_knob_bounds_and_reports(monkeypatch):
+    """HVD_VERIFY_MAX_PATHS caps enumeration and surfaces the bound —
+    a truncated verification must never read as exhaustive."""
+    src = "import horovod_tpu as hvd\n" + "\n".join(
+        f"def f{i}(x):\n"
+        f"    if hvd.rank() == {i}:\n"
+        f"        x = hvd.allreduce(x, name='g{i}')\n"
+        f"    else:\n"
+        f"        x = hvd.allreduce(x, name='g{i}')\n"
+        for i in range(8)
+    ) + "\ndef main(x):\n" + "\n".join(
+        f"    x = f{i}(x)" for i in range(8)) + "\n    return x\n"
+    monkeypatch.setenv("HVD_VERIFY_MAX_PATHS", "4")
+    result = check_sources([("many.py", src)])
+    assert result.truncated
+    assert "BOUNDED" in render_result_text(result)
+    monkeypatch.setenv("HVD_VERIFY_MAX_PATHS", "4096")
+    assert not check_sources([("many.py", src)]).truncated
+
+
+def test_loop_bound_unrolls_schedules():
+    """A rank-guarded *extra* iteration diverges the schedule only when
+    the loop is actually unrolled — HVD_VERIFY_LOOP_BOUND=0 turns the
+    loop body off and must lose the finding."""
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def train(x, n):\n"
+        "    for _ in range(n):\n"
+        "        if hvd.rank() == 0:\n"
+        "            x = hvd.allreduce(x, name='g')\n"
+        "    return x\n"
+    )
+    assert [f.rule for f in check_sources([("l.py", src)]).findings] \
+        == ["HVD010"]
+    assert check_sources([("l.py", src)], loop_bound=0).findings == []
+
+
+def test_entry_selection_restricts_the_check():
+    bad = _fixture("bad_hvd009_divergent_schedule.py")
+    # only the helpers: each is a straight line, nothing to compare
+    result = check_paths([bad], entries=["_reduce", "_sync"])
+    assert result.findings == []
+    result = check_paths([bad], entries=["train"])
+    assert [f.rule for f in result.findings] == ["HVD009"]
+
+
+def test_entry_no_match_is_usage_error():
+    """A typo'd --entry must not verify zero entries and report OK."""
+    bad = _fixture("bad_hvd009_divergent_schedule.py")
+    with pytest.raises(ValueError, match="no function"):
+        check_paths([bad], entries=["train_stpe"])
+    proc = subprocess.run(
+        [sys.executable, VERIFY_CLI, "--entry", "train_stpe", bad],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_elastic_run_body_is_an_entry():
+    """Functions passed to hvd.elastic.run are per-epoch entry points —
+    checked even though the file also 'calls' them (the wrapper)."""
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def body(state):\n"
+        "    if hvd.rank() == 0:\n"
+        "        state = hvd.broadcast(state, root_rank=0, name='sync')\n"
+        "    return state\n"
+        "def main(state):\n"
+        "    return hvd.elastic.run(body, state)\n"
+    )
+    result = check_sources([("e.py", src)])
+    assert [f.rule for f in result.findings] == ["HVD010"]
+    ce = result.findings[0].extra["counterexample"]
+    assert ce["world"] == "elastic"
+
+
+def test_two_level_kwarg_expands_to_stage_groups():
+    """A two_level=True dispatch models the three per-group stages the
+    runtime issues — so a rank-guarded two-level allreduce reports the
+    divergence against the local/cross groups, not a flat world."""
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def f(x):\n"
+        "    if hvd.rank() == 0:\n"
+        "        x = hvd.allreduce(x, name='g', two_level=True)\n"
+        "    return x\n"
+    )
+    findings = check_sources([("t.py", src)]).findings
+    assert findings and all(f.rule == "HVD010" for f in findings)
+    groups = {f.extra["counterexample"]["group"] for f in findings}
+    assert groups == {"local", "cross"}
+
+
+def test_compression_wire_format_is_part_of_the_signature():
+    """Two rank sets reducing one tensor in different wire formats
+    (docs/compression.md) sum incompatible payloads — a schedule
+    divergence even though op/name/dtype agree."""
+    src = (
+        "import horovod_tpu as hvd\n"
+        "def step(x):\n"
+        "    if hvd.rank() < 4:\n"
+        "        x = hvd.allreduce(x, name='g', compression='int8')\n"
+        "    else:\n"
+        "        x = hvd.allreduce(x, name='g', compression='bf16')\n"
+        "    return x\n"
+    )
+    findings = check_sources([("w.py", src)]).findings
+    assert [f.rule for f in findings] == ["HVD009"]
+    assert "int8" in findings[0].message and "bf16" in findings[0].message
+
+
+def test_syntax_error_becomes_finding():
+    result = check_sources([("broken.py", "def f(:\n")])
+    assert [f.rule for f in result.findings] == ["HVD000"]

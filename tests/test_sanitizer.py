@@ -133,8 +133,9 @@ def test_silent_peer_times_out_with_diagnostic(server):
 
 
 def test_sanitizer_http_table(server):
-    """GET /sanitizer renders the fingerprint table grouped by sequence
-    then rank — the live who-is-ahead view."""
+    """GET /sanitizer renders the fingerprint table partitioned by
+    communication group, then <epoch>.<seq>, then rank — the live
+    who-is-ahead view, per group."""
     s0, s1 = _pair(server)
     _run_ranks(
         lambda: s0.check(op="allreduce", name="g", shape=(2,),
@@ -144,10 +145,15 @@ def test_sanitizer_http_table(server):
     )
     table = http_client.get_sanitizer("127.0.0.1", server.port,
                                       secret=SECRET)
-    assert set(table) == {"0"}
-    assert set(table["0"]) == {"0", "1"}
-    assert table["0"]["1"]["op"] == "allreduce"
-    assert table["0"]["0"]["shape"] == [2]
+    assert set(table) == {"world"}
+    assert set(table["world"]) == {"0.0"}
+    assert set(table["world"]["0.0"]) == {"0", "1"}
+    assert table["world"]["0.0"]["1"]["op"] == "allreduce"
+    assert table["world"]["0.0"]["0"]["shape"] == [2]
+    # fingerprint v2 fields ride along
+    assert table["world"]["0.0"]["0"]["group"] == "world"
+    assert table["world"]["0.0"]["0"]["epoch"] == 0
+    assert table["world"]["0.0"]["0"]["clock"] >= 1
 
 
 def test_fingerprint_gc_bounds_the_store(server, monkeypatch):
@@ -167,8 +173,9 @@ def test_fingerprint_gc_bounds_the_store(server, monkeypatch):
     _run_ranks(rank(s0), rank(s1))
     table = http_client.get_sanitizer("127.0.0.1", server.port,
                                       secret=SECRET)
-    assert "0" not in table and "1" not in table, table.keys()
-    assert "4" in table  # the recent window survives
+    world = table["world"]
+    assert "0.0" not in world and "0.1" not in world, world.keys()
+    assert "0.4" in world  # the recent window survives
 
 
 def test_disabled_by_default(monkeypatch):
@@ -204,6 +211,219 @@ def test_build_from_env(monkeypatch, server):
         san_mod.reset()
 
 
+# ---------------------------------------------------------------------------
+# fingerprint v2: groups, epochs, vector-clock ordering
+# ---------------------------------------------------------------------------
+def _six(server, timeout=30.0):
+    """One sanitizer per rank of a 6-rank / local-2 / cross-3 world —
+    the PR 7 two_level fallback world (tests/test_compression.py)."""
+    return [Sanitizer(r, 6, "127.0.0.1", server.port, secret=SECRET,
+                      timeout=timeout) for r in range(6)]
+
+
+def test_two_level_six_rank_world_no_false_mismatch(server):
+    """SATELLITE regression: a two_level run fingerprints its intra-host
+    and cross-host stages against their own groups — on a real 6-rank /
+    cross-3 world the old flat-world sanitizer reported false mismatches
+    between ranks sitting in different groups at the same global
+    sequence number; the group-aware protocol must verify clean."""
+    from horovod_tpu.parallel.hierarchical import process_stage_plan
+
+    sans = _six(server)
+    before = metrics.SANITIZER_MISMATCHES.labels().get()
+
+    def rank(s):
+        plan = process_stage_plan("allreduce", rank=s.rank, size=6,
+                                  local_size=2)
+        assert plan is not None and len(plan) == 3
+
+        def go():
+            for step in range(2):
+                for st in plan:
+                    s.check(op=st.op, name=f"grad.{step}", shape=(4,),
+                            dtype="float32", group=st.group,
+                            peers=st.peers)
+            return "ok"
+        return go
+
+    results = _run_ranks(*[rank(s) for s in sans])
+    assert results == ["ok"] * 6, results
+    assert metrics.SANITIZER_MISMATCHES.labels().get() == before
+    # and the table is partitioned by group
+    table = http_client.get_sanitizer("127.0.0.1", server.port,
+                                      secret=SECRET)
+    assert {"local:0", "local:1", "local:2",
+            "cross:0", "cross:1"} <= set(table)
+
+
+def test_two_level_divergence_within_one_group_caught(server):
+    """…and a real injected divergence *within* one group is still
+    caught: rank 3 dispatches a different tensor in its local all-gather
+    stage — its local peer (rank 2) and rank 3 itself raise naming both
+    signatures; the other two hosts and both cross groups stay clean."""
+    from horovod_tpu.parallel.hierarchical import process_stage_plan
+
+    sans = _six(server)
+
+    def rank(s):
+        plan = process_stage_plan("allreduce", rank=s.rank, size=6,
+                                  local_size=2)
+
+        def go():
+            for st in plan:
+                name = "grad.0"
+                if s.rank == 3 and st.op == "allgather":
+                    name = "DIVERGED"   # the injected bug
+                s.check(op=st.op, name=name, shape=(4,),
+                        dtype="float32", group=st.group, peers=st.peers)
+            return "ok"
+        return go
+
+    results = _run_ranks(*[rank(s) for s in sans])
+    assert results[0] == "ok" and results[1] == "ok"
+    assert results[4] == "ok" and results[5] == "ok"
+    for r in (2, 3):
+        assert isinstance(results[r], CollectiveDivergenceError), results[r]
+        msg = str(results[r])
+        assert "local:1" in msg and "DIVERGED" in msg and "grad.0" in msg
+
+
+def _publish(server, rank, group, seq, clock, epoch=0, **over):
+    """Hand-publish a peer fingerprint (deterministic async-overlap
+    driver for the ordering tests)."""
+    import json as _json
+
+    from horovod_tpu.run.http_client import put_kv
+    from horovod_tpu.run.http_server import SANITIZER_SCOPE
+
+    fp = san_mod.fingerprint(
+        seq, op=over.get("op", "allreduce"), name=over.get("name", "g"),
+        shape=over.get("shape", (2,)), dtype=over.get("dtype", "float32"),
+        group=group, epoch=epoch, clock=clock)
+    put_kv("127.0.0.1", server.port, SANITIZER_SCOPE,
+           f"{group}.{epoch}.{seq}.{rank}", _json.dumps(fp).encode(),
+           SECRET)
+
+
+def test_cross_group_ordering_inversion_raises(server):
+    """The vector-clock happens-before index: the peer issued the two
+    groups' dispatches in the opposite clock order (an async overlap
+    that will deadlock whenever the overlap window closes) — the check
+    raises an ordering-inversion diagnostic instead of letting the
+    schedules silently cross."""
+    s0 = Sanitizer(0, 2, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=5.0)
+    _publish(server, 1, "ga", 0, clock=2)   # peer: gb first, ga second
+    _publish(server, 1, "gb", 0, clock=1)
+    s0.check(op="allreduce", name="g", shape=(2,), dtype="float32",
+             group="ga", peers=[0, 1])
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        s0.check(op="allreduce", name="g", shape=(2,), dtype="float32",
+                 group="gb", peers=[0, 1])
+    msg = str(ei.value)
+    assert "ordering inversion" in msg
+    assert "ga" in msg and "gb" in msg
+
+
+def test_matching_cross_group_order_passes(server):
+    s0 = Sanitizer(0, 2, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=5.0)
+    _publish(server, 1, "ga", 0, clock=1)   # peer agrees: ga then gb
+    _publish(server, 1, "gb", 0, clock=2)
+    s0.check(op="allreduce", name="g", shape=(2,), dtype="float32",
+             group="ga", peers=[0, 1])
+    s0.check(op="allreduce", name="g", shape=(2,), dtype="float32",
+             group="gb", peers=[0, 1])
+
+
+def test_order_index_window_bounds_memory():
+    idx = san_mod.OrderIndex(window=2)
+    assert idx.observe(1, ("a", 0, 0), 1, 1) is None
+    assert idx.observe(1, ("b", 0, 0), 2, 2) is None
+    assert idx.observe(1, ("c", 0, 0), 3, 3) is None
+    # ("a",0,0) fell out of the window — an inversion against it is no
+    # longer visible, but the recent pair still is
+    assert idx.observe(1, ("d", 0, 0), 4, 1) is not None
+
+
+def test_order_index_never_compares_across_epochs():
+    """An elastic rebuild (or a peer relaunched into a new epoch) resets
+    the peer's clock — epoch-N entries must not read as inversions
+    against epoch-N+1 entries."""
+    idx = san_mod.OrderIndex(window=8)
+    assert idx.observe(1, ("g", 0, 5), 100, 5000) is None
+    # peer restarted: its clock for the new epoch starts near zero
+    assert idx.observe(1, ("h", 1, 0), 101, 1) is None
+    # …but a genuine inversion within the new epoch still fires
+    assert idx.observe(1, ("g", 1, 0), 102, 0) is not None
+
+
+def test_epoch_strict_partitions_checks(server):
+    """HVD_SANITIZER_EPOCH_STRICT (default): a peer still publishing
+    under the previous membership epoch never matches — the check times
+    out with a diagnostic that names the epoch hypothesis."""
+    s0 = Sanitizer(0, 2, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=0.8, epoch_fn=lambda: 1, epoch_strict=True)
+    _publish(server, 1, "world", 0, clock=1, epoch=0)  # stale epoch key
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        s0.check(op="allreduce", name="g", shape=(2,), dtype="float32")
+    assert "membership epoch" in str(ei.value)
+    assert "epoch 1" in str(ei.value)
+
+
+def test_epoch_lenient_spans_rebuild_window(server):
+    """HVD_SANITIZER_EPOCH_STRICT=0: checks span epochs (keys collapse
+    to epoch 0) so a mid-rebuild window can still be debugged."""
+    s0 = Sanitizer(0, 2, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=5.0, epoch_fn=lambda: 1, epoch_strict=False)
+    _publish(server, 1, "world", 0, clock=1, epoch=0)
+    seq = s0.check(op="allreduce", name="g", shape=(2,), dtype="float32")
+    assert seq == 0
+
+
+def test_epoch_transition_gc_reclaims_retired_epoch(server):
+    """An elastic epoch bump must not strand the previous epoch's
+    fingerprint window in the launcher store forever — the first check
+    under the new epoch garbage-collects this rank's retired keys."""
+    epoch = [0]
+    s0 = Sanitizer(0, 1, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=2.0, epoch_fn=lambda: epoch[0])
+    for i in range(3):
+        s0.check(op="allreduce", name=f"g.{i}", shape=(1,), dtype="f",
+                 peers=[0])
+    table = http_client.get_sanitizer("127.0.0.1", server.port,
+                                      secret=SECRET)
+    assert {"0.0", "0.1", "0.2"} <= set(table["world"])
+    epoch[0] = 1   # the membership plane commits a new world
+    s0.check(op="allreduce", name="g.0", shape=(1,), dtype="f", peers=[0])
+    table = http_client.get_sanitizer("127.0.0.1", server.port,
+                                      secret=SECRET)
+    assert "1.0" in table["world"]
+    assert not {"0.0", "0.1", "0.2"} & set(table["world"]), \
+        table["world"].keys()
+
+
+def test_non_member_dispatch_is_an_error(server):
+    s0 = Sanitizer(0, 4, "127.0.0.1", server.port, secret=SECRET)
+    with pytest.raises(ValueError, match="not a member"):
+        s0.check(op="allreduce", name="g", shape=(2,), dtype="float32",
+                 group="cross:1", peers=[1, 3])
+
+
+def test_per_group_sequences_are_independent(server):
+    """Sequence numbers count per (group, epoch): interleaving groups on
+    one rank must not advance the other group's counter (the flat-world
+    bug was exactly a shared counter)."""
+    s0 = Sanitizer(0, 1, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=2.0)
+    assert s0.check(op="allreduce", name="a", shape=(1,), dtype="f",
+                    group="ga", peers=[0]) == 0
+    assert s0.check(op="allreduce", name="b", shape=(1,), dtype="f",
+                    group="gb", peers=[0]) == 0
+    assert s0.check(op="allreduce", name="c", shape=(1,), dtype="f",
+                    group="ga", peers=[0]) == 1
+
+
 class _Recorder:
     def __init__(self):
         self.calls = []
@@ -228,6 +448,37 @@ def test_eager_dispatch_guard_invokes_sanitizer(hvd_init, monkeypatch):
     assert rec.calls[0]["name"] == "san.probe"
     assert tuple(rec.calls[0]["shape"]) == (3,)
     assert "float32" in str(rec.calls[0]["dtype"])
+    # flat dispatches fingerprint the world group
+    assert rec.calls[0].get("group", "world") == "world"
+
+
+def test_eager_two_level_dispatch_fingerprints_stages(hvd_init,
+                                                      monkeypatch):
+    """The group-identity seam: an eager two-level allreduce fingerprints
+    its three per-group stages (local RS → cross AR → local AG) instead
+    of one flat-world dispatch, so the sanitizer checks each stage
+    against its own group's process peers."""
+    from horovod_tpu import core
+
+    rec = _Recorder()
+    monkeypatch.setattr(san_mod, "_instance", rec)
+    # pretend this controller is process rank 2 of a 6-process / 2-per-
+    # host job (the sanitizer plane is per *process*, not per device)
+    monkeypatch.setattr(core, "process_rank", lambda: 2)
+    monkeypatch.setattr(core, "process_size", lambda: 6)
+    monkeypatch.setenv("HVD_LOCAL_SIZE", "2")
+    vals = [np.full((4,), float(r + 1), np.float32)
+            for r in range(hvd_init.size())]
+    out = eager.allreduce_(vals, op=hvd_init.Sum, name="tl.probe",
+                           two_level=True)
+    np.testing.assert_allclose(np.asarray(out[0]), np.full((4,), 36.0))
+    assert [c["op"] for c in rec.calls] == \
+        ["reducescatter", "allreduce", "allgather"]
+    assert [c["group"] for c in rec.calls] == \
+        ["local:1", "cross:0", "local:1"]
+    assert [tuple(c["peers"]) for c in rec.calls] == \
+        [(2, 3), (0, 2, 4), (2, 3)]
+    assert all(c["name"] == "tl.probe" for c in rec.calls)
 
 
 def _worker_sanitizer_divergence():
