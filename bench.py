@@ -41,8 +41,22 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:27-41
-MODEL_FLOPS_PER_IMG = 12.27e9               # 3x forward, analytic
-V5E_PEAK_FLOPS = 197e12                     # bf16 per chip
+# MFU constants live in horovod_tpu/utils/flops.py (single-sourced with
+# the hvd_mfu gauge and the comm report; HVD_PEAK_FLOPS overrides the
+# peak) — every leg's mfu field routes through _mfu() below
+
+
+def _mfu(img_sec_per_chip) -> "float | None":
+    """First-class MFU for a bench leg, computed through utils/flops so
+    the bench JSON and the ``hvd_mfu`` gauge can never disagree; None on
+    any failure (same null-on-failure contract as the delta legs)."""
+    try:
+        from horovod_tpu.utils import flops as _flops
+
+        v = _flops.image_model_mfu(float(img_sec_per_chip))
+        return round(v, 4) if v > 0 else None
+    except Exception:  # noqa: BLE001 — mfu must never cost the number
+        return None
 
 PROBE_TIMEOUT_S = 90       # jax.devices() normally returns in seconds
 RUN_TIMEOUT_S = 560        # compile (~40 s) + 3 measured iters, generous
@@ -72,14 +86,14 @@ def _measure() -> None:
     ])
     result = run(args)
     per_chip = result["img_sec_per_chip"]
-    mfu = per_chip * MODEL_FLOPS_PER_IMG / V5E_PEAK_FLOPS
     print("RESULT " + json.dumps({
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_DEVICE, 3),
-        "mfu": round(mfu, 4),
-        "mfu_note": "12.27 GF/img analytic / 197 TFLOPS v5e peak; "
+        "mfu": _mfu(per_chip),
+        "mfu_note": "12.27 GF/img analytic / peak from utils/flops "
+                    "(197 TFLOPS v5e unless HVD_PEAK_FLOPS); "
                     "see docs/PERF.md for the profile",
     }))
 
@@ -110,7 +124,8 @@ def _measure_autotuned() -> None:
     ])
     result = run(args)
     print("RESULT " + json.dumps(
-        {"img_sec_per_chip": round(result["img_sec_per_chip"], 2)}))
+        {"img_sec_per_chip": round(result["img_sec_per_chip"], 2),
+         "mfu": _mfu(result["img_sec_per_chip"])}))
 
 
 def _measure_compressed() -> None:
@@ -137,7 +152,8 @@ def _measure_compressed() -> None:
     ])
     result = run(args)
     print("RESULT " + json.dumps(
-        {"img_sec_per_chip": round(result["img_sec_per_chip"], 2)}))
+        {"img_sec_per_chip": round(result["img_sec_per_chip"], 2),
+         "mfu": _mfu(result["img_sec_per_chip"])}))
 
 
 def _compression_delta(default_per_chip: float) -> dict:
@@ -162,12 +178,14 @@ def _compression_delta(default_per_chip: float) -> dict:
             at = float(payload["img_sec_per_chip"])
             return {
                 "compressed_img_sec_per_chip": round(at, 2),
+                "compressed_mfu": payload.get("mfu"),
                 "compression_delta_pct": round(
                     (at - default_per_chip) / default_per_chip * 100.0, 2),
             }
     except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
         reason = f"{type(e).__name__}: {e}"
-    return {"compression_delta_pct": None, "compression_error": reason}
+    return {"compression_delta_pct": None, "compressed_mfu": None,
+            "compression_error": reason}
 
 
 def _run_child(flag: str, timeout_s: float):
@@ -213,12 +231,14 @@ def _autotune_delta(default_per_chip: float) -> dict:
             at = float(payload["img_sec_per_chip"])
             return {
                 "autotuned_img_sec_per_chip": round(at, 2),
+                "autotuned_mfu": payload.get("mfu"),
                 "autotune_delta_pct": round(
                     (at - default_per_chip) / default_per_chip * 100.0, 2),
             }
     except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
         reason = f"{type(e).__name__}: {e}"
-    return {"autotune_delta_pct": None, "autotune_error": reason}
+    return {"autotune_delta_pct": None, "autotuned_mfu": None,
+            "autotune_error": reason}
 
 
 def _probe() -> str:

@@ -62,6 +62,13 @@ _SANITIZER_PREFIX = f"/{SANITIZER_SCOPE}/"
 REPLAY_SCOPE = "replay"
 REPLAY_SUMMARY_KEY = "summary"
 
+# compute-anatomy profiler (timeline/profiler.py): each rank pushes its
+# window anatomy under profile/<rank> at finalize; GET /profile renders
+# the per-rank anatomies plus the cross-rank aggregate (per-segment
+# slowest rank, mean MFU, worst host gap — docs/profiling.md)
+PROFILE_SCOPE = "profile"
+_PROFILE_PREFIX = f"/{PROFILE_SCOPE}/"
+
 # profile-guided autotune loop (optim/profile_guided.py): the tuner (or
 # scripts/hvd_autotune.py --push) publishes one record per plan event
 # under plan.<n>; GET /autotune renders the per-plan table plus the
@@ -176,6 +183,29 @@ def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
         "ready": ready,
         "blocklist": _load(keys.get(BLOCKLIST_KEY)) or [],
     }
+
+
+def build_profile_report(store: Dict[str, bytes]) -> Dict[str, object]:
+    """The compute-anatomy table from a store snapshot: every pushed
+    per-rank anatomy plus the cross-rank aggregate, computed by the SAME
+    :func:`~horovod_tpu.timeline.profiler.aggregate_anatomies` the
+    offline CLI uses (``GET /profile``, docs/profiling.md)."""
+    per_rank: Dict[str, object] = {}
+    for k, v in store.items():
+        if not k.startswith(_PROFILE_PREFIX):
+            continue
+        rank = k[len(_PROFILE_PREFIX):]
+        try:
+            per_rank[rank] = json.loads(v)
+        except (ValueError, TypeError):
+            per_rank[rank] = "<undecodable>"
+    valid = {r: a for r, a in per_rank.items() if isinstance(a, dict)}
+    aggregate = None
+    if valid:
+        from ..timeline.profiler import aggregate_anatomies
+
+        aggregate = aggregate_anatomies(valid)
+    return {"ranks": per_rank, "aggregate": aggregate}
 
 
 def build_autotune_report(store: Dict[str, bytes]) -> Dict[str, object]:
@@ -353,6 +383,12 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             self._reply(200, json.dumps(build_autotune_report(store))
                         .encode(), content_type="application/json")
             return
+        if path == "/profile":
+            with self.server.lock:  # type: ignore
+                store = dict(self.server.store)  # type: ignore
+            self._reply(200, json.dumps(build_profile_report(store))
+                        .encode(), content_type="application/json")
+            return
         store: Dict[str, bytes] = self.server.store  # type: ignore
         with self.server.lock:  # type: ignore
             val = store.get(self.path)
@@ -474,6 +510,12 @@ class RendezvousServer:
         """In-process equivalent of GET /autotune."""
         with self._httpd.lock:  # type: ignore[attr-defined]
             return build_autotune_report(
+                dict(self._httpd.store))  # type: ignore[attr-defined]
+
+    def profile_report(self) -> Dict[str, object]:
+        """In-process equivalent of GET /profile."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return build_profile_report(
                 dict(self._httpd.store))  # type: ignore[attr-defined]
 
     def clear_scope(self, scope: str) -> None:

@@ -379,7 +379,11 @@ def model_scaling(
 def collective_report(
     step_fn,
     *args,
-    peak_flops: float = 197e12,
+    # None → utils/flops.peak_flops(): the ONE peak constant (v5e
+    # 197e12 unless HVD_PEAK_FLOPS overrides) every MFU number divides
+    # by — a hardware change can't desync this report from bench.py or
+    # the compute-anatomy profiler
+    peak_flops: Optional[float] = None,
     ici_bytes_per_sec: float = 186e9,   # v5e: ~186 GB/s per ICI direction
     ici_hop_latency: float = 1e-6,      # ~1 µs per ICI neighbor hop
     sizes=(8, 16, 32, 64),
@@ -408,6 +412,11 @@ def collective_report(
     than fused buckets even at equal bytes — the reference's whole fusion
     rationale (SURVEY §2.1)."""
     import jax
+
+    if peak_flops is None:
+        from ..utils.flops import peak_flops as _peak_flops
+
+        peak_flops = _peak_flops()
 
     lowered = step_fn.lower(*args, **kwargs) if hasattr(step_fn, "lower") \
         else jax.jit(step_fn).lower(*args, **kwargs)

@@ -35,6 +35,25 @@ NEGOTIATE_PREFIX = "NEGOTIATE_"
 #: per-rank clock-offset sidecar written by Timeline.initialize
 CLOCK_SYNC_FILE = "clock_sync.json"
 
+#: per-rank compute-anatomy artifact written by the profiler
+#: (timeline/profiler.py); its segment events merge into the Chrome
+#: trace as their own per-rank row group
+COMPUTE_JSON = "compute.json"
+
+
+def load_profile_artifact(trace_dir: str, rank: int) -> dict:
+    """One rank's parsed ``compute.json`` (``{}`` when absent or
+    undecodable — a rank that never profiled is normal, not an error)."""
+    p = os.path.join(trace_dir, str(rank), COMPUTE_JSON)
+    if not os.path.isfile(p):
+        return {}
+    try:
+        with open(p) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (ValueError, OSError):
+        return {}
+
 
 def load_rank_events(path: str) -> List[dict]:
     """Parse one comm.json leniently: a live (unfinalized) file has no
@@ -121,6 +140,8 @@ def merge_traces(trace_dir: str, align_clocks: bool = True) -> dict:
         aligned, shift, offsets = clock_shifts(trace_dir, ranks)
     else:
         aligned, shift, offsets = False, {}, {}
+    from .profiler import COMPUTE_PID_BASE
+
     events: List[dict] = []
     for rank, path in ranks.items():
         events.append({"name": "process_name", "ph": "M", "pid": rank,
@@ -133,6 +154,26 @@ def merge_traces(trace_dir: str, align_clocks: bool = True) -> dict:
             if aligned and "ts" in ev:
                 ev["ts"] = float(ev["ts"]) + shift[rank]
             events.append(ev)
+        # compute-anatomy segments (compute.json): own row group per
+        # rank, shifted onto the shared clock exactly like comm events.
+        # A 'local'-clock artifact (profiler ran without the timeline)
+        # shares no origin with comm.json — merging it would place the
+        # rows at nonsense offsets, so it is skipped.
+        artifact = load_profile_artifact(trace_dir, rank)
+        prof = artifact.get("events", []) \
+            if artifact.get("clock") != "local" else []
+        if prof:
+            cpid = COMPUTE_PID_BASE + rank
+            events.append({"name": "process_name", "ph": "M", "pid": cpid,
+                           "args": {"name": f"rank {rank} compute"}})
+            events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": cpid, "args": {"sort_index": rank}})
+            for ev in prof:
+                ev = dict(ev)
+                ev["pid"] = cpid
+                if aligned and "ts" in ev:
+                    ev["ts"] = float(ev["ts"]) + shift[rank]
+                events.append(ev)
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"source": "hvd_trace_merge",
@@ -219,6 +260,13 @@ def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
     stragglered, its total negotiation wait (a chronically low
     total = chronically late rank), and ``unmatched_spans`` — B/E pairs
     that never closed, the signature of a truncated live trace.
+
+    When any rank carries a ``compute.json`` (the compute-anatomy
+    profiler, timeline/profiler.py), ``segments`` extends the straggler
+    story to the compute side: per profiled step block, each rank's
+    device time, the SLOWEST rank, and the max−min spread — so "rank 3
+    is late" localizes to "rank 3's backward is 10% slower", not just a
+    negotiation wait.
     """
     per_rank: Dict[int, Dict[str, dict]] = {}
     unmatched: Dict[int, int] = {}
@@ -252,7 +300,7 @@ def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
     rows.sort(key=lambda r: -r["spread_us"])
     if top:
         rows = rows[:top]
-    return {
+    report = {
         "tensors": rows,
         "ranks": {
             str(r): {
@@ -263,4 +311,36 @@ def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
             }
             for r in per_rank
         },
+    }
+    segments = segment_straggler_report(trace_dir, per_rank.keys())
+    if segments:
+        report["segments"] = segments
+    return report
+
+
+def segment_straggler_report(trace_dir: str, ranks) -> Dict[str, dict]:
+    """Per-compute-segment slowest-rank table from the ranks'
+    ``compute.json`` anatomies: ``{segment: {per_rank_device_us,
+    slowest_rank, spread_us}}`` (empty when nobody profiled).  The
+    reduction is :func:`~horovod_tpu.timeline.profiler
+    .aggregate_anatomies` — the same one behind ``GET /profile`` and
+    ``hvd_profile`` — so this table can never disagree with them on
+    who the slowest rank is."""
+    from .profiler import aggregate_anatomies
+
+    anatomies = {}
+    for rank in ranks:
+        anatomy = load_profile_artifact(trace_dir, rank).get("anatomy")
+        if isinstance(anatomy, dict):
+            anatomies[str(rank)] = anatomy
+    if not anatomies:
+        return {}
+    agg = aggregate_anatomies(anatomies)
+    return {
+        name: {
+            "per_rank_device_us": s["per_rank_device_us"],
+            "slowest_rank": int(s["slowest_rank"]),
+            "spread_us": s["spread_us"],
+        }
+        for name, s in agg["segments"].items()
     }

@@ -216,6 +216,16 @@ class Timeline:
             log.debug("clock sync skipped: %s", e)
 
     def shutdown(self) -> None:
+        # flush any open compute-anatomy profiler BEFORE the writer
+        # closes: compute.json events share this timeline's clock, and
+        # a job torn down mid-window must still land its artifact next
+        # to comm.json (timeline/profiler.py)
+        try:
+            from .profiler import finalize_active
+
+            finalize_active()
+        except Exception as e:  # noqa: BLE001
+            log.debug("profiler finalize on shutdown failed: %s", e)
         with self._lock:
             if self._writer is not None:
                 self._writer.close()

@@ -70,6 +70,7 @@ def make_train_step(
     autotune: Optional[bool] = None,
     autotune_log_file: Optional[str] = None,
     profile_guided: Optional[bool] = None,
+    profile: Optional[bool] = None,
     in_graph_steps: int = 1,
 ):
     """Returns ``step(state, batch, labels) -> (state, loss)`` compiled SPMD
@@ -112,6 +113,16 @@ def make_train_step(
       past the guard band).  Exposed as ``step.profile_guided_tuner``.
       The GP prior is warm-started from the α–β cost model
       (HVD_AUTOTUNE_WARM_START=0 disables).
+    * ``profile`` (default: the ``HVD_PROFILE`` env, docs/profiling.md)
+      arms the compute-anatomy profiler: inside its step window
+      (``HVD_PROFILE_START_STEP``/``END_STEP``) the step runs DECOMPOSED
+      — forward / backward / grad_allreduce / optimizer_update as
+      separately-jitted programs with a device sync at each boundary —
+      so each block's device time, ``cost_analysis`` flops/bytes, and
+      the inter-dispatch host gaps are measured and reduced into a
+      per-rank ``compute.json`` next to ``comm.json``.  Window steps pay
+      the decomposition (no cross-block fusion, one sync per block);
+      steps outside it run the normal fused program untouched.
     * ``in_graph_steps > 1`` compiles a ``lax.scan`` of that many
       optimizer steps over the SAME batch into one program, so host
       dispatch is amortized away (the synthetic-benchmark mode: the
@@ -151,9 +162,15 @@ def make_train_step(
         ef = (isinstance(comp, ErrorFeedback) or plan_comp) \
             and not hier and not tlvl
 
-        def per_rank_step(state: TrainState, x, y):
-            def compute_loss(params):
-                variables = {"params": params, **state.model_state}
+        # The step's four blocks as shared helpers: per_rank_step (the
+        # fused program) and the compute-anatomy profiler's decomposed
+        # segments (make_profile_fns) both call THESE, so the profiled
+        # window runs the same math it attributes.  jax.named_scope
+        # threads the block names into HLO op metadata, so a real
+        # jax.profiler capture (HVD_PROFILE_XLA=1) carries them too.
+        def _compute_loss(params, model_state, x, y):
+            with jax.named_scope("hvd_forward"):
+                variables = {"params": params, **model_state}
                 if has_batch_stats:
                     logits, updates = apply_fn(
                         variables, x, train=True, mutable=["batch_stats"]
@@ -162,60 +179,68 @@ def make_train_step(
                 logits = apply_fn(variables, x)
                 return loss_fn(logits, y), {}
 
+        def _reduce_grads(grads, residual):
+            with jax.named_scope("hvd_grad_allreduce"):
+                if tlvl:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: two_level_allreduce(g, op=op,
+                                                      compression=comp),
+                        grads,
+                    )
+                elif hier:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: hierarchical_allreduce(g, op=op), grads
+                    )
+                elif ef:
+                    if not jax.tree_util.tree_leaves(residual):
+                        if in_graph_steps > 1:
+                            raise ValueError(
+                                "error-feedback compression with "
+                                "in_graph_steps > 1 needs an initialized "
+                                "residual (lax.scan carries must keep one "
+                                "structure) — build the state with "
+                                "init_train_state(..., compression=...)")
+                        # lazy init at trace time: the first compiled step
+                        # returns the full residual structure, later calls
+                        # carry it (one extra re-trace, no extra step work)
+                        residual = jax.tree_util.tree_map(
+                            jnp.zeros_like, grads)
+                    grads, residual = allreduce_pytree(
+                        grads, op=op, compression=comp,
+                        threshold_bytes=threshold_b,
+                        named_buckets=named_buckets,
+                        bucket_compression=bucket_compression,
+                        residual=residual,
+                    )
+                else:
+                    grads = allreduce_pytree(
+                        grads, op=op, compression=comp,
+                        threshold_bytes=threshold_b,
+                        named_buckets=named_buckets,
+                        bucket_compression=bucket_compression,
+                    )
+            return grads, residual
+
+        def _apply_update(state, grads, new_model_state, residual):
+            with jax.named_scope("hvd_optimizer_update"):
+                updates, opt_state = optimizer.update(
+                    grads, state.opt_state, state.params
+                )
+                import optax
+
+                params = optax.apply_updates(state.params, updates)
+            return TrainState(params, opt_state, new_model_state,
+                              state.step + 1, residual)
+
+        def per_rank_step(state: TrainState, x, y):
             (loss, new_model_state), grads = jax.value_and_grad(
-                compute_loss, has_aux=True
+                lambda p: _compute_loss(p, state.model_state, x, y),
+                has_aux=True,
             )(state.params)
-
-            residual = state.residual
-            if tlvl:
-                grads = jax.tree_util.tree_map(
-                    lambda g: two_level_allreduce(g, op=op,
-                                                  compression=comp),
-                    grads,
-                )
-            elif hier:
-                grads = jax.tree_util.tree_map(
-                    lambda g: hierarchical_allreduce(g, op=op), grads
-                )
-            elif ef:
-                if not jax.tree_util.tree_leaves(residual):
-                    if in_graph_steps > 1:
-                        raise ValueError(
-                            "error-feedback compression with "
-                            "in_graph_steps > 1 needs an initialized "
-                            "residual (lax.scan carries must keep one "
-                            "structure) — build the state with "
-                            "init_train_state(..., compression=...)")
-                    # lazy init at trace time: the first compiled step
-                    # returns the full residual structure, later calls
-                    # carry it (one extra re-trace, no extra step work)
-                    residual = jax.tree_util.tree_map(
-                        jnp.zeros_like, grads)
-                grads, residual = allreduce_pytree(
-                    grads, op=op, compression=comp,
-                    threshold_bytes=threshold_b,
-                    named_buckets=named_buckets,
-                    bucket_compression=bucket_compression,
-                    residual=residual,
-                )
-            else:
-                grads = allreduce_pytree(
-                    grads, op=op, compression=comp,
-                    threshold_bytes=threshold_b,
-                    named_buckets=named_buckets,
-                    bucket_compression=bucket_compression,
-                )
+            grads, residual = _reduce_grads(grads, state.residual)
             loss = collectives.allreduce(loss, op=Average)
-
-            updates, opt_state = optimizer.update(
-                grads, state.opt_state, state.params
-            )
-            import optax
-
-            params = optax.apply_updates(state.params, updates)
             return (
-                TrainState(params, opt_state, new_model_state,
-                           state.step + 1, residual),
+                _apply_update(state, grads, new_model_state, residual),
                 loss,
             )
 
@@ -232,7 +257,70 @@ def make_train_step(
             out_specs=(state_spec, P()),
             donate_argnums=(0,) if donate else (),
         )
-        return fn, ef
+
+        def make_profile_fns():
+            """Separately-jitted step segments for the compute-anatomy
+            profiler (timeline/profiler.py): the SAME block helpers as
+            per_rank_step, split at block boundaries so each block's
+            device time is host-visible.  Per-rank intermediates (loss,
+            gradients, batch-stat updates) cross segment boundaries as
+            stacked arrays — leading axis = rank, sharded P(AXIS) — so
+            every rank round-trips its OWN values and no collective is
+            smuggled into the wrong segment."""
+
+            def _stack(t):
+                return jax.tree_util.tree_map(lambda l: l[None], t)
+
+            def _unstack(t):
+                return jax.tree_util.tree_map(lambda l: l[0], t)
+
+            def forward_seg(state, x, y):
+                loss, _ = _compute_loss(state.params, state.model_state,
+                                        x, y)
+                return loss[None]
+
+            def backward_seg(state, x, y):
+                (loss, new_ms), grads = jax.value_and_grad(
+                    lambda p: _compute_loss(p, state.model_state, x, y),
+                    has_aux=True,
+                )(state.params)
+                return loss[None], _stack(new_ms), _stack(grads)
+
+            def reduce_seg(state, loss_st, grads_st):
+                grads, residual = _reduce_grads(_unstack(grads_st),
+                                                state.residual)
+                loss = collectives.allreduce(loss_st[0], op=Average)
+                return grads, residual, loss
+
+            def opt_seg(state, new_ms_st, grads, residual, loss):
+                return (_apply_update(state, grads, _unstack(new_ms_st),
+                                      residual), loss)
+
+            data = (P(core.AXIS), P(core.AXIS))
+            return {
+                "forward": spmd(forward_seg,
+                                in_specs=(state_spec,) + data,
+                                out_specs=P(core.AXIS)),
+                "backward": spmd(backward_seg,
+                                 in_specs=(state_spec,) + data,
+                                 out_specs=(P(core.AXIS), P(core.AXIS),
+                                            P(core.AXIS))),
+                "grad_allreduce": spmd(
+                    reduce_seg,
+                    in_specs=(state_spec, P(core.AXIS), P(core.AXIS)),
+                    out_specs=(P(), P(), P())),
+                # no donation on the decomposed path: the window-entry
+                # warm-up executes the chain once with results discarded
+                # (so compile time never reads as host gap), which a
+                # donated state buffer would not survive.  Cost: one
+                # extra live params copy during the profile window only.
+                "optimizer_update": spmd(
+                    opt_seg,
+                    in_specs=(state_spec, P(core.AXIS), P(), P(), P()),
+                    out_specs=(state_spec, P())),
+            }
+
+        return fn, ef, make_profile_fns
 
     if autotune is None:
         autotune = env_util.get_bool(env_util.HVD_AUTOTUNE)
@@ -271,11 +359,17 @@ def make_train_step(
         # path reduces per leaf and would silently drop named_buckets
         # while the tuner reports the plan applied.  box keeps the
         # original hier so rollback (plan=None) restores it.
-        fn, ef = _build(threshold_b, hier and plan is None, named,
-                        comp, bucket_comp, two_level and plan is None)
+        fn, ef, profile_factory = _build(
+            threshold_b, hier and plan is None, named,
+            comp, bucket_comp, two_level and plan is None)
+        # any rebuild (new plan, elastic epoch, guard trip) invalidates
+        # the profiler's cached decomposed segments — they must re-jit
+        # against the same knobs as the fused program
+        box.pop("profile_fns", None)
         box.update(
             fn=fn, threshold=threshold_b, hier=hier, plan=plan,
             ef_active=ef, compression=comp,
+            profile_factory=profile_factory,
             core_epoch=core._require_init().epoch,
         )
 
@@ -302,6 +396,103 @@ def make_train_step(
     from .timeline.timeline import timeline
 
     import time as _time
+
+    # Compute-anatomy profiler (timeline/profiler.py, docs/profiling.md):
+    # None when off, so steps outside a window pay a single None check.
+    if profile is None:
+        from .timeline import profiler as _profiler_mod
+
+        profiler = _profiler_mod.from_env()
+    elif profile:
+        from .timeline.profiler import ComputeProfiler
+
+        profiler = ComputeProfiler(enabled=True)
+        profiler = profiler if profiler.enabled else None
+    else:
+        profiler = None
+
+    def _segment_cost(fn, args):
+        """cost_analysis flops/bytes for one decomposed segment, plus
+        the AOT-compiled executable (used for the window's calls so the
+        lowering isn't compiled twice)."""
+        try:
+            compiled = fn.lower(*args).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else {}
+            ca = ca or {}
+            return {
+                "compiled": compiled,
+                "flops": float(ca["flops"]) if "flops" in ca else None,
+                "bytes": float(ca["bytes accessed"])
+                if "bytes accessed" in ca else None,
+            }
+        except Exception as e:  # noqa: BLE001 — profiling must not kill the step
+            log.debug("segment cost analysis failed: %s", e)
+            return {"compiled": None, "flops": None, "bytes": None}
+
+    def _profiled_step(state, x, y):
+        """One train step on the decomposed path: each block dispatched
+        and synced under a profiler segment span.  Identical math to
+        box['fn'] (same block helpers, same knobs); in_graph_steps > 1
+        python-loops the chain — lax.scan re-feeds the same batch, so k
+        sequential chains are exactly the scanned program's semantics."""
+        if box.get("ef_active") and not jax.tree_util.tree_leaves(
+                state.residual):
+            # materialize the lazy error-feedback residual BEFORE the
+            # segments compile: the AOT executables are pinned to the
+            # state's pytree structure, and reduce_seg's trace-time
+            # lazy init would grow it on the NEXT step's state —
+            # crashing the cached call (the fused jit path re-traces,
+            # AOT doesn't)
+            state = state._replace(residual=jax.tree_util.tree_map(
+                jnp.zeros_like, state.params))
+        first = "profile_fns" not in box
+        if first:
+            box["profile_fns"] = {"fns": box["profile_factory"](),
+                                  "costs": {}}
+        fns, costs = box["profile_fns"]["fns"], box["profile_fns"]["costs"]
+
+        def _prep(name, *args):
+            """Compile (AOT, so cost_analysis and the executable come
+            from ONE compile) and run a segment once with the result
+            discarded — the window-entry warm-up that keeps compile
+            time out of the recorded spans (it would otherwise read as
+            a giant host gap on step 1)."""
+            c = _segment_cost(fns[name], args)
+            costs[name] = c
+            out = (c["compiled"] or fns[name])(*args)
+            jax.block_until_ready(out)
+            return out
+
+        if first:
+            _prep("forward", state, x, y)
+            loss_st, new_ms_st, grads_st = _prep("backward", state, x, y)
+            grads, residual, loss = _prep("grad_allreduce",
+                                          state, loss_st, grads_st)
+            _prep("optimizer_update",
+                  state, new_ms_st, grads, residual, loss)
+
+        def run(name, *args):
+            c = costs[name]
+            return profiler.run_segment(name, c["compiled"] or fns[name],
+                                        *args, flops=c["flops"],
+                                        nbytes=c["bytes"])
+
+        with profiler.step_span():
+            for _ in range(max(in_graph_steps, 1)):
+                # timing-only extra pass: XLA fuses fwd+bwd inside
+                # value_and_grad, so a standalone forward is the only
+                # host-visible way to split them — "backward" below
+                # therefore includes a forward recompute (backward-only
+                # ≈ backward − forward; docs/profiling.md)
+                run("forward", state, x, y)
+                loss_st, new_ms_st, grads_st = run("backward", state, x, y)
+                grads, residual, loss = run("grad_allreduce",
+                                            state, loss_st, grads_st)
+                state, loss = run("optimizer_update",
+                                  state, new_ms_st, grads, residual, loss)
+        return state, loss
 
     # Step-cadence metrics: blocking on the result every step would
     # serialize the async dispatch pipeline (the very thing the compiled
@@ -401,6 +592,22 @@ def make_train_step(
                 _rebuild(box["threshold"], box["hier"], box.get("plan"))
         if not under_trace and metrics.on():
             _record_step_metrics(x)
+        if not under_trace:
+            box["profiled_last"] = False
+        if profiler is not None and not under_trace and profiler.on_step():
+            # capture window: the decomposed per-segment path, wrapped
+            # in the same timeline STEP span as a normal step so the
+            # comm.json window and compute.json envelopes stay aligned
+            box["profiled_last"] = True
+            if timeline.active:
+                timeline.record_step(owner="train_step")
+                timeline.mark_cycle_start()
+                with timeline.span("train_step", "STEP"):
+                    result = _profiled_step(state, x, y)
+            else:
+                result = _profiled_step(state, x, y)
+            _maybe_guard(result[0])
+            return result
         if timeline.active and not under_trace:
             timeline.record_step(owner="train_step")
             timeline.mark_cycle_start()
@@ -454,6 +661,7 @@ def make_train_step(
                 "will idle in its baseline phase")
 
     if pm is None and tuner is None:
+        _invoke.compute_profiler = profiler
         return _invoke
 
     warm_start = env_util.get_bool(env_util.HVD_AUTOTUNE_WARM_START, True)
@@ -467,9 +675,13 @@ def make_train_step(
         if tuner is not None and tuner.active and not under_trace:
             # dispatch-to-dispatch interval: real step time in steady
             # state with zero added synchronization (same honesty
-            # argument as hvd_step_seconds)
+            # argument as hvd_step_seconds).  An interval spanning a
+            # compute-profiler window step measures the decomposed
+            # path (~2x, plus the one-time segment compile) — feeding
+            # it to the loop would mis-score knobs or read as a false
+            # plan regression, so those steps don't count.
             now = _time.perf_counter()
-            if pg_last[0]:
+            if pg_last[0] and not box.get("profiled_last"):
                 tuner.on_step(now - pg_last[0])
             pg_last[0] = now
         if pm is None or pm.frozen:
@@ -511,6 +723,12 @@ def make_train_step(
         # (block_until_ready can return early on tunneled platforms)
         jax.device_get(loss)
         dt = _time.perf_counter() - t0
+        if box.get("profiled_last"):
+            # a profiler-window step ran the decomposed path: its dt is
+            # not the knob vector's step time, keep it out of the GP
+            # (the window flag is env/step-counter driven, so every
+            # process skips — and skips the sync below — in lockstep)
+            return state, loss
         if core.process_size() > 1:
             # Synchronize the measurement instead of the decision: every
             # process scores the same averaged step time, and the
@@ -530,6 +748,7 @@ def make_train_step(
 
     step_autotuned.parameter_manager = pm
     step_autotuned.profile_guided_tuner = tuner
+    step_autotuned.compute_profiler = profiler
     return step_autotuned
 
 

@@ -109,6 +109,16 @@ HVD_LINT_DISABLE = "HVD_LINT_DISABLE"                  # comma list of rule IDs 
 # schedule model checker (analysis/schedule/, scripts/hvd_verify.py)
 HVD_VERIFY_MAX_PATHS = "HVD_VERIFY_MAX_PATHS"          # per-entry path budget (default 64)
 HVD_VERIFY_LOOP_BOUND = "HVD_VERIFY_LOOP_BOUND"        # loop unroll bound (default 2)
+# compute-anatomy profiler (timeline/profiler.py, docs/profiling.md):
+# per-block device-time attribution + roofline/MFU accounting + host-gap
+# detection over a BYTEPS_TRACE-style step window
+HVD_PROFILE = "HVD_PROFILE"                            # 1 enables the profiled step window
+HVD_PROFILE_START_STEP = "HVD_PROFILE_START_STEP"      # window start (default HVD_TRACE_START_STEP or 1)
+HVD_PROFILE_END_STEP = "HVD_PROFILE_END_STEP"          # window end (default start + 2: a 3-step window)
+HVD_PROFILE_XLA = "HVD_PROFILE_XLA"                    # 1 also runs jax.profiler trace capture into <rank>/xla_trace
+HVD_PROFILE_GAP_THRESHOLD_US = "HVD_PROFILE_GAP_THRESHOLD_US"  # inter-dispatch gap flagged as a host-gap span past this (default 25)
+HVD_PROFILE_HBM_GBPS = "HVD_PROFILE_HBM_GBPS"          # roofline HBM bandwidth, GB/s (default 819, v5e)
+HVD_PEAK_FLOPS = "HVD_PEAK_FLOPS"                      # per-chip peak FLOP/s for every MFU number (default 197e12, v5e bf16)
 # dPRO-style replay engine (horovod_tpu/timeline/replay/)
 HVD_REPLAY_CLOCK_SYNC = "HVD_REPLAY_CLOCK_SYNC"        # 0 skips the init-time clock handshake
 HVD_REPLAY_CLOCK_SAMPLES = "HVD_REPLAY_CLOCK_SAMPLES"  # handshake round trips (default 8)
@@ -152,6 +162,9 @@ DEFAULT_COMPRESSION_GUARD_STEPS = 25               # error-feedback residual-nor
 DEFAULT_COMPRESSION_GUARD_FACTOR = 10.0            # residual divergence threshold (x baseline)
 DEFAULT_DCN_GBPS = 25.0                            # modeled cross-host (DCN) bandwidth per host
 DEFAULT_DCN_HOP_US = 10.0                          # modeled cross-host per-hop latency
+DEFAULT_PROFILE_STEPS = 3                          # profiler window length when no end step is configured
+DEFAULT_PROFILE_GAP_THRESHOLD_US = 25.0            # host-gap span flagging threshold
+DEFAULT_PROFILE_HOST_BOUND_FRACTION = 0.2          # step verdict flips to host-bound past this gap share
 
 
 def get_int(name: str, default: int) -> int:

@@ -8,19 +8,65 @@ accounting (PaLM appendix B): 6·N FLOPs per token of parameter math
 (fwd + bwd) plus the attention score/value matmuls, 12·L·s·d per token
 — halved for causal models whose flash kernels skip fully-future
 blocks.
+
+This module is also the single source of the hardware peak numbers
+every MFU/roofline consumer divides by: bench.py, the compute-anatomy
+profiler (timeline/profiler.py), and the comm report's flops/peak
+fallback (timeline/comm_report.py) all route through
+:func:`peak_flops` / :func:`hbm_bytes_per_sec`, so a hardware change
+(or an ``HVD_PEAK_FLOPS`` override) moves every published MFU number
+at once instead of desyncing them.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import numpy as np
 
-V5E_PEAK_FLOPS = 197e12  # bf16 nameplate, per chip
+V5E_PEAK_FLOPS = 197e12       # bf16 nameplate, per chip
+V5E_HBM_BYTES_PER_SEC = 819e9  # HBM bandwidth, per chip
+
+#: ResNet-50 training ≈ 3 × 4.09 GFLOPs forward of model math per image
+#: (the usual analytic count bench.py's headline MFU is built on; XLA's
+#: own cost_analysis reports ~23.9 GF/img because strided-conv gradients
+#: lower to dilated convs that multiply zeros)
+RESNET50_TRAIN_FLOPS_PER_IMG = 12.27e9
+
+
+def peak_flops(default: float = V5E_PEAK_FLOPS) -> float:
+    """Per-chip peak FLOP/s for MFU math.  ``HVD_PEAK_FLOPS`` overrides
+    (set it when the job runs on different hardware than the v5e
+    default) — every consumer reads THIS function, never the raw
+    constant, so the override cannot miss one report."""
+    from .env import HVD_PEAK_FLOPS, get_float
+
+    return get_float(HVD_PEAK_FLOPS, default)
+
+
+def hbm_bytes_per_sec(default: float = V5E_HBM_BYTES_PER_SEC) -> float:
+    """Per-chip HBM bandwidth for roofline math (the ridge point is
+    ``peak_flops / hbm_bytes_per_sec`` flops/byte).
+    ``HVD_PROFILE_HBM_GBPS`` overrides, in GB/s."""
+    from .env import HVD_PROFILE_HBM_GBPS, get_float
+
+    return get_float(HVD_PROFILE_HBM_GBPS, default / 1e9) * 1e9
 
 
 def param_count(params) -> int:
     return int(sum(np.prod(x.shape)
                    for x in jax.tree_util.tree_leaves(params)))
+
+
+def image_model_mfu(img_per_sec_per_chip: float,
+                    flops_per_image: float = RESNET50_TRAIN_FLOPS_PER_IMG,
+                    *, peak: Optional[float] = None) -> float:
+    """MFU of an image model from measured per-chip throughput — the
+    bench.py headline math, single-sourced so the bench JSON and the
+    ``hvd_mfu`` gauge agree by construction."""
+    peak = peak if peak is not None else peak_flops()
+    return float(img_per_sec_per_chip) * float(flops_per_image) / peak
 
 
 def transformer_train_flops_per_seq(n_params: int, num_layers: int,
@@ -35,8 +81,10 @@ def transformer_train_flops_per_seq(n_params: int, num_layers: int,
 def transformer_mfu(seq_per_sec_per_chip: float, n_params: int,
                     num_layers: int, hidden_dim: int, seq_len: int, *,
                     causal: bool = False,
-                    peak_flops: float = V5E_PEAK_FLOPS) -> float:
+                    peak_flops: Optional[float] = None) -> float:
     fps = transformer_train_flops_per_seq(
         n_params, num_layers, hidden_dim, seq_len, causal=causal,
     )
+    if peak_flops is None:
+        peak_flops = globals()["peak_flops"]()
     return seq_per_sec_per_chip * fps / peak_flops

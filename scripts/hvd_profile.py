@@ -1,0 +1,234 @@
+"""Render the compute-anatomy report: per-block device time, roofline
+verdicts, and host-gap summary from a profiled trace dir.
+
+The compute half of the trace plane (docs/profiling.md): a
+``make_train_step`` run with ``HVD_PROFILE=1`` writes a per-rank
+``compute.json`` (segment device µs, occurrence counts, cost_analysis
+flops/bytes, host-gap spans) next to ``comm.json``; this CLI aggregates
+them across ranks — top segments by device time, a
+compute-bound/memory-bound/host-bound verdict per block, MFU, and the
+per-segment slowest rank — the numbers that turn "16.7% MFU" into a
+ranked list of targets.
+
+Run::
+
+    python scripts/hvd_profile.py <trace_dir> \
+        [--top N] [--json] [--out report.json] \
+        [--push host:port [--secret HEX]]    # serve via GET /profile
+    python scripts/hvd_profile.py --check    # fixture self-test (tier-1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.timeline.profiler import (  # noqa: E402
+    PROFILE_EXPECTED, profile_fixture_events, reduce_trace_events,
+    report_from_dir, write_profile_fixture,
+)
+
+
+def _approx(a, b, tol=1e-3) -> bool:
+    if a is None or b is None:
+        return a is b
+    return math.isclose(float(a), float(b), rel_tol=0, abs_tol=tol)
+
+
+def run_check() -> int:
+    """Self-test on the hand-computed fixture: the parser must recover
+    every rank's anatomy exactly (segment totals, roofline verdicts,
+    host-gap spans, MFU) and the cross-rank aggregate must name the
+    slowest rank per segment — the same bar the tier-1 tests pin."""
+    errors = []
+    exp = PROFILE_EXPECTED
+    with tempfile.TemporaryDirectory(prefix="hvd_profile_check_") as d:
+        write_profile_fixture(d)
+        # 1. parser: every rank's anatomy from the raw event corpus
+        for rank, want in exp["ranks"].items():
+            an = reduce_trace_events(
+                profile_fixture_events(int(rank)),
+                peak_flops=exp["peak_flops"],
+                hbm_bytes_per_sec=exp["hbm_bytes_per_sec"],
+                gap_threshold_us=exp["gap_threshold_us"])
+            for field in ("steps", "wall_us", "mfu", "top_segment",
+                          "verdict"):
+                got = an[field]
+                if isinstance(want[field], float):
+                    ok = _approx(got, want[field])
+                else:
+                    ok = got == want[field]
+                if not ok:
+                    errors.append(f"rank {rank} {field}: {got!r} != "
+                                  f"{want[field]!r}")
+            hg = an["host_gap"]
+            for got, w, name in (
+                    (hg["total_us"], want["host_gap_total_us"], "total"),
+                    (hg["per_step_us"], want["host_gap_per_step_us"],
+                     "per_step"),
+                    (hg["fraction"], want["host_gap_fraction"], "frac")):
+                if not _approx(got, w):
+                    errors.append(f"rank {rank} host_gap {name}: "
+                                  f"{got} != {w}")
+            if hg["flagged"] != want["flagged_gaps"]:
+                errors.append(f"rank {rank} flagged gaps {hg['flagged']} "
+                              f"!= {want['flagged_gaps']}")
+            for name, ws in want["segments"].items():
+                gs = an["segments"].get(name)
+                if gs is None:
+                    errors.append(f"rank {rank} segment {name} missing")
+                    continue
+                if not _approx(gs["device_us"], ws["device_us"]) \
+                        or gs["count"] != ws["count"] \
+                        or gs["verdict"] != ws["verdict"] \
+                        or not _approx(gs["fraction"], ws["fraction"],
+                                       1e-4):
+                    errors.append(f"rank {rank} segment {name}: {gs} "
+                                  f"!= {ws}")
+                if "intensity" in ws and not _approx(
+                        gs.get("intensity_flops_per_byte"),
+                        ws["intensity"]):
+                    errors.append(f"rank {rank} {name} intensity "
+                                  f"{gs.get('intensity_flops_per_byte')} "
+                                  f"!= {ws['intensity']}")
+                if "mfu" in ws and not _approx(gs.get("mfu"), ws["mfu"],
+                                               1e-6):
+                    errors.append(f"rank {rank} {name} mfu "
+                                  f"{gs.get('mfu')} != {ws['mfu']}")
+        # 2. the dir-level report + aggregate (what GET /profile serves)
+        report = report_from_dir(d)
+        agg = report["aggregate"]
+        for seg, rank in exp["slowest"].items():
+            got = agg["segments"][seg]["slowest_rank"]
+            if got != rank:
+                errors.append(f"slowest rank for {seg}: {got} != {rank}")
+        if not _approx(agg["segments"]["backward"]["spread_us"],
+                       exp["backward_spread_us"]):
+            errors.append(f"backward spread "
+                          f"{agg['segments']['backward']['spread_us']} "
+                          f"!= {exp['backward_spread_us']}")
+        if not _approx(agg["mfu"]["mean"], exp["aggregate_mfu"], 1e-4):
+            errors.append(f"aggregate mfu {agg['mfu']['mean']} != "
+                          f"{exp['aggregate_mfu']}")
+        if agg["host_gap_per_step_us"]["max_rank"] != \
+                exp["host_gap_max_rank"]:
+            errors.append("host-gap max rank "
+                          f"{agg['host_gap_per_step_us']['max_rank']} != "
+                          f"{exp['host_gap_max_rank']}")
+    if errors:
+        print("hvd_profile --check FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print("hvd_profile --check OK: fixture anatomy exact on both ranks "
+          "(segment totals, roofline verdicts, host-gap spans, "
+          f"mfu {exp['aggregate_mfu']:.2f}), aggregate names backward's "
+          f"slowest rank {exp['slowest']['backward']}")
+    return 0
+
+
+def _print_text(report: dict, top: int) -> None:
+    agg = report["aggregate"]
+    ranks = report["ranks"]
+    any_rank = next(iter(ranks.values()), {})
+    print(f"compute anatomy: {report['trace_dir']}  "
+          f"ranks={agg['ranks']}  steps={any_rank.get('steps')}")
+    mfu = agg["mfu"]["mean"]
+    peak = any_rank.get("peak_flops")
+    print(f"MFU (rank mean): "
+          f"{'%.2f%%' % (mfu * 100.0) if mfu is not None else 'n/a'}"
+          f"{'  (peak %.0fe12 FLOP/s)' % (peak / 1e12) if peak else ''}")
+    print(f"\n{'segment':<24} {'us/step':>10} {'share':>7} "
+          f"{'verdict':<14} {'slowest':>8} {'spread_us':>10}")
+    shown = 0
+    for name in agg["top_segments"]:
+        if shown >= top:
+            print(f"  ... {len(agg['top_segments']) - shown} more "
+                  "segment(s) (use --top)")
+            break
+        shown += 1
+        s = agg["segments"][name]
+        # rank-mean per-step time and wall share
+        steps = any_rank.get("steps") or 0
+        wall = any_rank.get("wall_us") or 0.0
+        per_step = s["mean_device_us"] / steps if steps else None
+        frac = s["mean_device_us"] / wall if wall else None
+        print(f"{name:<24} "
+              f"{'%.1f' % per_step if per_step is not None else '-':>10} "
+              f"{'%.1f%%' % (frac * 100) if frac is not None else '-':>7} "
+              f"{s['verdict']:<14} "
+              f"rank {s['slowest_rank']:>3} {s['spread_us']:>10.1f}")
+    print("\nhost gap (device idle waiting on host):")
+    for rank, an in sorted(ranks.items(), key=lambda kv: int(kv[0])):
+        hg = an.get("host_gap", {})
+        print(f"  rank {rank}: {hg.get('per_step_us', 0.0):.1f} us/step "
+              f"({hg.get('fraction', 0.0) * 100:.1f}%), "
+              f"{hg.get('flagged', 0)} flagged span(s) >= "
+              f"{an.get('gap_threshold_us')} us")
+    worst = agg["host_gap_per_step_us"]["max_rank"]
+    if worst is not None:
+        print(f"  worst: rank {worst}")
+    verdicts = {an.get("verdict") for an in ranks.values()}
+    print(f"\nstep verdict: {', '.join(sorted(v for v in verdicts if v))}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="step-anatomy report: per-block device time + "
+                    "roofline verdicts + host gap from compute.json")
+    p.add_argument("trace_dir", nargs="?",
+                   help="timeline dir (HVD_TIMELINE target)")
+    p.add_argument("--top", type=int, default=10,
+                   help="show the N biggest segments by device time")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--out", default=None,
+                   help="also write the report JSON here")
+    p.add_argument("--push", default=None, metavar="HOST:PORT",
+                   help="publish each rank's anatomy to the rendezvous "
+                        "server so GET /profile serves the aggregate")
+    p.add_argument("--secret", default=None,
+                   help="hex HMAC secret for --push")
+    p.add_argument("--check", action="store_true",
+                   help="self-test on the built-in hand-computed fixture")
+    args = p.parse_args(argv)
+
+    if args.check:
+        sys.exit(run_check())
+    if not args.trace_dir:
+        p.error("trace_dir is required (or use --check)")
+    push_host = push_port = None
+    if args.push:
+        push_host, _, port_s = args.push.partition(":")
+        if not push_host or not port_s.isdigit():
+            p.error(f"--push wants HOST:PORT, got {args.push!r}")
+        push_port = int(port_s)
+
+    report = report_from_dir(args.trace_dir)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.push:
+        from horovod_tpu.run.http_client import put_profile_summary
+
+        secret = bytes.fromhex(args.secret) if args.secret else None
+        for rank, anatomy in report["ranks"].items():
+            put_profile_summary(push_host, push_port, rank, anatomy,
+                                secret=secret)
+        print(f"pushed {len(report['ranks'])} rank anatomies -> "
+              f"GET http://{args.push}/profile", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        _print_text(report, args.top)
+    return report
+
+
+if __name__ == "__main__":
+    main()

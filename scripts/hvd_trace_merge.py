@@ -57,16 +57,27 @@ def main(argv=None) -> dict:
     print(f"merged {n_ranks} rank(s), {n_ev} events -> {out}")
     if not report["tensors"]:
         print("no tensor negotiated on >= 2 ranks; no straggler analysis")
-        return report
-    print(f"{'tensor':<32} {'op':<12} {'spread_us':>10}  straggler")
-    for row in report["tensors"]:
-        print(f"{row['tensor']:<32} {row['op']:<12} "
-              f"{row['spread_us']:>10.1f}  rank {row['straggler_rank']}")
-    print("per-rank blame (straggler = arrived last, waited least):")
-    for rank, d in sorted(report["ranks"].items(), key=lambda kv: int(kv[0])):
-        print(f"  rank {rank}: straggler for {d['times_straggler']} "
-              f"tensor(s), total negotiate wait "
-              f"{d['total_negotiate_wait_us']:.1f} us")
+    else:
+        print(f"{'tensor':<32} {'op':<12} {'spread_us':>10}  straggler")
+        for row in report["tensors"]:
+            print(f"{row['tensor']:<32} {row['op']:<12} "
+                  f"{row['spread_us']:>10.1f}  rank {row['straggler_rank']}")
+        print("per-rank blame (straggler = arrived last, waited least):")
+        for rank, d in sorted(report["ranks"].items(),
+                              key=lambda kv: int(kv[0])):
+            print(f"  rank {rank}: straggler for {d['times_straggler']} "
+                  f"tensor(s), total negotiate wait "
+                  f"{d['total_negotiate_wait_us']:.1f} us")
+    # the compute side of the straggler question rides compute.json and
+    # exists even when negotiation spans don't (the compiled plane)
+    if report.get("segments"):
+        print("compute segments (from compute.json; slowest rank by "
+              "device time):")
+        print(f"  {'segment':<28} {'spread_us':>10}  slowest")
+        for name, s in sorted(report["segments"].items(),
+                              key=lambda kv: -kv[1]["spread_us"]):
+            print(f"  {name:<28} {s['spread_us']:>10.1f}  "
+                  f"rank {s['slowest_rank']}")
     return report
 
 
