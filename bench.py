@@ -62,6 +62,7 @@ PROBE_TIMEOUT_S = 90       # jax.devices() normally returns in seconds
 RUN_TIMEOUT_S = 560        # compile (~40 s) + 3 measured iters, generous
 AUTOTUNE_TIMEOUT_S = 420   # autotuned comparison run (re-jits a few times)
 COMPRESSION_TIMEOUT_S = 420  # compressed comparison run (one compile)
+SERVE_TIMEOUT_S = 180      # serving fixture: a few MLP compiles + ~1.5 s trace
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -154,6 +155,55 @@ def _measure_compressed() -> None:
     print("RESULT " + json.dumps(
         {"img_sec_per_chip": round(result["img_sec_per_chip"], 2),
          "mfu": _mfu(result["img_sec_per_chip"])}))
+
+
+def _measure_serving() -> None:
+    """Child-process entry for the serving leg: the seeded bursty
+    open-loop load-generator fixture against a small jitted MLP
+    replica set (docs/inference.md) — p50/p99 request latency and
+    goodput-under-burst are the serving plane's headline numbers.
+    Latency of a tiny MLP is host-dominated, so this leg runs on
+    whatever platform the child gets (CPU included): it benchmarks the
+    batching/queueing plane, not the chip."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from horovod_tpu.serving.plane import run_bench_fixture
+
+    out = run_bench_fixture()
+    print("RESULT " + json.dumps({
+        "serve_p50_ms": out["serve_p50_ms"],
+        "serve_p99_ms": out["serve_p99_ms"],
+        "goodput_under_burst": out["goodput_under_burst"],
+        "serve_offered": out["offered"],
+        "serve_completed": out["completed"],
+    }))
+
+
+def _serving_leg() -> dict:
+    """The serving tail fields, from a separately-timed child so a hung
+    or failed serving fixture can never cost the training number
+    (HVD_BENCH_SERVE=0 skips).  Null-on-failure, same contract as the
+    autotune/compression legs."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_SERVE, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-serve", SERVE_TIMEOUT_S)
+        if payload is not None:
+            return {
+                "serve_p50_ms": payload.get("serve_p50_ms"),
+                "serve_p99_ms": payload.get("serve_p99_ms"),
+                "goodput_under_burst": payload.get("goodput_under_burst"),
+            }
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"serve_p50_ms": None, "serve_p99_ms": None,
+            "goodput_under_burst": None, "serve_error": reason}
 
 
 def _compression_delta(default_per_chip: float) -> dict:
@@ -281,6 +331,9 @@ def main() -> None:
             # compressed-vs-default tail (HVD_BENCH_COMPRESSION=0 skips):
             # what does error-feedback int8 cost/buy on this chip?
             out.update(_compression_delta(float(out.get("value", 0.0))))
+            # serving tail (HVD_BENCH_SERVE=0 skips): p50/p99 request
+            # latency + goodput-under-burst of the serving plane fixture
+            out.update(_serving_leg())
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -304,6 +357,8 @@ if __name__ == "__main__":
         _measure_autotuned()
     elif "--child-compression" in sys.argv:
         _measure_compressed()
+    elif "--child-serve" in sys.argv:
+        _measure_serving()
     elif "--child" in sys.argv:
         _measure()
     else:

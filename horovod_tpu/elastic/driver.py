@@ -37,6 +37,8 @@ from ..run.http_server import (
     ABORT_SCOPE,
     ANNOUNCE_PREFIX,
     BLOCKLIST_KEY,
+    DRAIN_ACK_PREFIX,
+    DRAIN_PREFIX,
     EPOCH_KEY,
     HEALTH_SCOPE,
     MEMBERSHIP_SCOPE,
@@ -64,7 +66,8 @@ class ElasticDriver:
     def __init__(self, rdv_server, worker_ids: Sequence[str], *,
                  min_np: int = 1, controller: str = "xla",
                  controller_host: str = "127.0.0.1",
-                 max_flaps: Optional[int] = None):
+                 max_flaps: Optional[int] = None,
+                 drain_timeout: Optional[float] = None):
         self.server = rdv_server
         self.min_np = max(int(min_np), 1)
         self.controller = controller
@@ -90,6 +93,20 @@ class ElasticDriver:
         self._timeout = env_util.get_float(
             env_util.HVD_ELASTIC_TIMEOUT_SECONDS,
             env_util.DEFAULT_ELASTIC_TIMEOUT_SECONDS)
+        self._drain_timeout = float(
+            drain_timeout if drain_timeout is not None
+            else env_util.get_float(
+                env_util.HVD_SERVE_DRAIN_TIMEOUT_SECONDS, self._timeout))
+        # serving-plane hooks (serving/autoscaler.py): an attached
+        # autoscaler ticks from poll() on stable epochs, and announced
+        # workers are HELD as spares for it instead of auto-admitted
+        self.autoscaler = None
+        self.hold_admissions = False
+        self.spares: List[str] = []
+        # called as on_remove(worker, drained) after every removal
+        # commit: the serving plane hooks it to requeue a lossily-
+        # removed replica's in-flight requests (broker.requeue)
+        self.on_remove = None
         self.commit(list(worker_ids), reason="initial world")
 
     # -- epoch commits -------------------------------------------------------
@@ -144,18 +161,32 @@ class ElasticDriver:
         return rec
 
     # -- membership changes --------------------------------------------------
-    def remove(self, worker: str, reason: str) -> bool:
+    def remove(self, worker: str, reason: str, *,
+               drain: bool = False) -> bool:
         """Shrink the world past ``worker``.  Workers that already
         finished cleanly are drained from the roster in the same commit
         (they will never ack or heartbeat again — leaving them in would
         hang the stability barrier and hand rank 0 to an exited
         process).  Returns False (and records ``failed_reason``) when
         the LIVE remainder would violate ``min_np`` — the caller must
-        then fail the job the fail-stop way."""
+        then fail the job the fail-stop way.
+
+        ``drain=True`` is the **lossless** scale-down path (serving
+        autoscaler, planned maintenance): before anything is revoked or
+        committed, the departing worker is asked to stop pulling new
+        work, finish what it has in flight, and ack — the drain
+        handshake (``drain.<worker>`` → ``drain_ack.<worker>`` under
+        the membership scope).  Only after the ack (or the
+        ``HVD_SERVE_DRAIN_TIMEOUT_SECONDS`` budget, in which case the
+        removal degrades to the lossy path with a warning) is the
+        shrink epoch committed, so a drained shrink loses zero
+        requests/steps.  Voluntary drains do not count toward the
+        flapping blocklist — a worker scaled down N times is not a
+        flaky host."""
         if worker not in self.world:
             return True
-        drained = [w for w in self.world
-                   if w != worker and w in self.finished]
+        finished = [w for w in self.world
+                    if w != worker and w in self.finished]
         survivors = [w for w in self.world
                      if w != worker and w not in self.finished]
         if len(survivors) < self.min_np:
@@ -163,18 +194,69 @@ class ElasticDriver:
                 f"{reason}; world would shrink to {len(survivors)} < "
                 f"min_np {self.min_np}")
             return False
-        self.flaps[worker] = self.flaps.get(worker, 0) + 1
-        if self.flaps[worker] >= self.max_flaps:
-            self.blocklist.add(worker)
-            log.warning("worker %s blocklisted after %d removals",
-                        worker, self.flaps[worker])
+        drained_ok = False
+        if drain:
+            drained_ok = self._drain(worker)
+            if not drained_ok:
+                log.warning(
+                    "drain handshake with worker %s timed out after "
+                    "%.1fs; removing it the lossy way", worker,
+                    self._drain_timeout)
+        if not drain:
+            self.flaps[worker] = self.flaps.get(worker, 0) + 1
+            if self.flaps[worker] >= self.max_flaps:
+                self.blocklist.add(worker)
+                log.warning("worker %s blocklisted after %d removals",
+                            worker, self.flaps[worker])
         old_rank = self.world.index(worker)
         # the lease itself is revoked by commit()'s HEALTH-scope reset
         self._publish_abort(reason, rank=old_rank)
-        if drained:
-            reason = f"{reason} (drained finished worker(s) {drained})"
+        if finished:
+            reason = f"{reason} (drained finished worker(s) {finished})"
+        if drained_ok:
+            reason = f"{reason} (drained: in-flight work completed)"
         self.commit(survivors, removed=[worker], reason=reason)
+        if self.on_remove is not None:
+            try:
+                self.on_remove(worker, drained_ok)
+            except Exception:  # noqa: BLE001 — a hook bug must not
+                log.exception("on_remove hook failed for worker %s",
+                              worker)  # fail the membership change
         return True
+
+    def _drain(self, worker: str) -> bool:
+        """Run the drain handshake with ``worker``: publish the request
+        key, wait for the ack, clean both keys up.  True iff the worker
+        acked inside the budget.
+
+        The wait is synchronous — supervision (lease expiry, child-exit
+        reaping) pauses for up to ``HVD_SERVE_DRAIN_TIMEOUT_SECONDS``
+        while a drain is in flight.  Drains are rare, operator/
+        autoscaler-paced events; tune the budget down if concurrent
+        failure reaction matters more than drain patience."""
+        req_key = f"{DRAIN_PREFIX}{worker}"
+        ack_key = f"{DRAIN_ACK_PREFIX}{worker}"
+        # a stale ack from a previous timed-out handshake (acked just
+        # past the deadline) must not read as an instant lossless drain
+        self.server.delete(MEMBERSHIP_SCOPE, ack_key)
+        self.server.put(MEMBERSHIP_SCOPE, req_key, json.dumps({
+            "worker": worker, "epoch": self.epoch, "time": time.time(),
+        }).encode())
+        deadline = time.monotonic() + self._drain_timeout
+        acked = False
+        while time.monotonic() < deadline:
+            if self.server.get(MEMBERSHIP_SCOPE, ack_key) is not None:
+                acked = True
+                break
+            time.sleep(0.02)
+        self.server.delete(MEMBERSHIP_SCOPE, req_key)
+        self.server.delete(MEMBERSHIP_SCOPE, ack_key)
+        if acked:
+            from .. import metrics
+
+            if metrics.on():
+                metrics.SERVE_DRAINS.inc()
+        return acked
 
     def admit(self, workers: Sequence[str],
               reason: str = "rejoin") -> Optional[dict]:
@@ -190,6 +272,36 @@ class ElasticDriver:
             rank=None)
         return self.commit(self.world + list(workers), admitted=workers,
                            reason=reason)
+
+    # -- serving-plane hooks (serving/autoscaler.py) -------------------------
+    def attach_autoscaler(self, autoscaler, *,
+                          hold_admissions: bool = True) -> None:
+        """Give load, not failures, control of the world: ``autoscaler
+        .tick()`` runs from every stable-epoch poll, and (by default)
+        announced workers are held in ``self.spares`` for it to admit
+        instead of being auto-admitted at the next boundary."""
+        self.autoscaler = autoscaler
+        self.hold_admissions = hold_admissions
+
+    def admit_spare(self, reason: str = "autoscale grow"
+                    ) -> Optional[str]:
+        """Admit the longest-held spare (FIFO) into the next epoch;
+        returns its worker id, or None when no spare is available.
+
+        Known limitation: held spares carry no liveness signal (they
+        are outside the world, so no heartbeat lease covers them) — a
+        spare that died while held is admitted, stalls the stability
+        barrier for one elastic timeout, and is then removed by lease
+        expiry.  The damage is bounded and one-shot (a dead process
+        cannot re-announce), but giving spares lease renewal is the
+        proper fix when spare pools grow large."""
+        while self.spares:
+            w = self.spares.pop(0)
+            if w in self.blocklist or w in self.world:
+                continue
+            if self.admit([w], reason=reason) is not None:
+                return w
+        return None
 
     def _publish_abort(self, reason: str, rank: Optional[int]) -> None:
         """Stamp the flag with the epoch being aborted so survivors that
@@ -275,7 +387,21 @@ class ElasticDriver:
                 for w in pending:
                     self.server.delete(MEMBERSHIP_SCOPE,
                                        f"{ANNOUNCE_PREFIX}{w}")
-                self.admit(pending)
+                if self.hold_admissions:
+                    # serving mode: spares are capacity-in-reserve for
+                    # the autoscaler, not immediate members
+                    self.spares.extend(w for w in pending
+                                       if w not in self.spares)
+                    log.info("holding announced worker(s) %s as spares "
+                             "(%d held)", pending, len(self.spares))
+                else:
+                    self.admit(pending)
+            if self.autoscaler is not None:
+                try:
+                    self.autoscaler.tick()
+                except Exception:  # noqa: BLE001 — a policy bug must
+                    log.exception(   # not take down supervision
+                        "serving autoscaler tick failed")
 
     # -- supervision ---------------------------------------------------------
     def supervise(self, job, poll_interval: float = 0.2) -> int:
@@ -295,7 +421,17 @@ class ElasticDriver:
                     continue
                 handled.add(w)
                 if code == 0:
-                    self.finished.add(w)
+                    if w in self.world:
+                        # a MEMBER exiting 0 means end of training: the
+                        # job is winding down (admissions pause)
+                        self.finished.add(w)
+                    else:
+                        # a worker the autoscaler drained out of the
+                        # world exits 0 as the normal end of its
+                        # removal — that must NOT read as the job
+                        # winding down, or the first serving scale-
+                        # down would freeze autoscaling forever
+                        log.info("removed worker %s exited cleanly", w)
                     continue
                 if w in self.world:
                     if not self.remove(

@@ -176,7 +176,8 @@ def ack(epoch: int) -> None:
 
 def announce() -> None:
     """Publish this worker's rejoin candidacy; the driver admits it at
-    the next epoch boundary (unless blocklisted)."""
+    the next epoch boundary (unless blocklisted) — or holds it as a
+    spare when a serving autoscaler owns admissions."""
     from ..run.http_client import put_kv
     from ..run.http_server import ANNOUNCE_PREFIX, MEMBERSHIP_SCOPE
 
@@ -184,6 +185,46 @@ def announce() -> None:
     put_kv(addr, port, MEMBERSHIP_SCOPE, f"{ANNOUNCE_PREFIX}{worker_id()}",
            json.dumps({"worker": worker_id(), "host": socket.gethostname(),
                        "pid": os.getpid(), "time": time.time()}).encode(),
+           secret=secret, retry=True)
+
+
+def drain_requested() -> Optional[dict]:
+    """The driver's pending drain request for THIS worker (None when
+    there is none): the first half of the lossless scale-down
+    handshake — on a request, stop taking new work, finish in flight,
+    then :func:`ack_drain` (docs/inference.md, docs/fault_tolerance.md
+    "Drain handshake").  Never raises: a rendezvous blip reads as "no
+    request" and the driver's timeout covers the lossy fallback."""
+    from ..run.http_client import get_kv
+    from ..run.http_server import DRAIN_PREFIX, MEMBERSHIP_SCOPE
+
+    try:
+        addr, port, secret = _wiring()
+        raw = get_kv(addr, port, MEMBERSHIP_SCOPE,
+                     f"{DRAIN_PREFIX}{worker_id()}", secret=secret)
+    except (RuntimeError, urllib.error.URLError, OSError) as e:
+        log.debug("drain poll failed: %s", e)
+        return None
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        return {"worker": worker_id()}
+
+
+def ack_drain() -> None:
+    """The second half of the drain handshake: this worker has stopped
+    pulling and completed everything in flight — the driver may now
+    commit the shrink epoch."""
+    from ..run.http_client import put_kv
+    from ..run.http_server import DRAIN_ACK_PREFIX, MEMBERSHIP_SCOPE
+
+    addr, port, secret = _wiring()
+    put_kv(addr, port, MEMBERSHIP_SCOPE,
+           f"{DRAIN_ACK_PREFIX}{worker_id()}",
+           json.dumps({"worker": worker_id(), "pid": os.getpid(),
+                       "time": time.time()}).encode(),
            secret=secret, retry=True)
 
 

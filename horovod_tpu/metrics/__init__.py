@@ -58,6 +58,17 @@ hvd_step_phase_fraction         gauge      share of profiled step wall time
                                            per phase (by ``phase`` label)
 hvd_host_gap_us                 gauge      per-step device-idle-on-host time
                                            from inter-dispatch gaps
+hvd_serve_requests_total        counter    inference requests, by ``outcome``
+hvd_serve_latency_seconds       histogram  request submit→complete latency
+hvd_serve_queue_wait_seconds    histogram  request submit→pull queue wait
+hvd_serve_batch_fill            histogram  real (pre-padding) batch sizes
+hvd_serve_queue_depth           gauge      pending requests in the broker
+hvd_serve_replicas              gauge      live inference replicas
+hvd_serve_p99_ms                gauge      windowed p99 request latency
+hvd_serve_autoscale_events_total counter   autoscale actions, by ``direction``
+hvd_serve_drains_total          counter    lossless drain handshakes done
+hvd_serve_requeues_total        counter    in-flight requests requeued after
+                                           a replica died uncleanly
 ==============================  =========  ==================================
 """
 
@@ -65,6 +76,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils import env as env_util
 from .registry import (  # noqa: F401
     BYTES_BUCKETS,
     Counter,
@@ -73,9 +85,18 @@ from .registry import (  # noqa: F401
     LATENCY_BUCKETS,
     MetricsRegistry,
     exponential_buckets,
+    latency_buckets_from_env,
     registry,
     render_prometheus,
 )
+
+#: serving-request latency scheme: the default floor (100 µs) is tuned
+#: for dispatch spans; request latencies live in the 0.25 ms..30 s range
+#: (HVD_SERVE_LATENCY_BUCKET_FLOOR moves the floor; factor/count shared
+#: with the job-wide HVD_METRICS_BUCKET_{FACTOR,COUNT})
+SERVE_LATENCY_BUCKETS = latency_buckets_from_env(
+    env_util.HVD_SERVE_LATENCY_BUCKET_FLOOR,
+    env_util.DEFAULT_SERVE_LATENCY_BUCKET_FLOOR)
 
 # -- instrument inventory ----------------------------------------------------
 EAGER_CALLS = registry.counter(
@@ -221,6 +242,47 @@ HOST_GAP_US = registry.gauge(
     "hvd_host_gap_us",
     "Per-step device-idle-waiting-on-host time detected from "
     "inter-dispatch gaps inside the profiled window.")
+
+SERVE_REQUESTS = registry.counter(
+    "hvd_serve_requests_total",
+    "Inference requests by outcome (ok/error/timeout/rejected) — "
+    "serving plane, horovod_tpu/serving/.", ("outcome",))
+SERVE_LATENCY = registry.histogram(
+    "hvd_serve_latency_seconds",
+    "Inference request latency, submit to complete (the number the SLO "
+    "is written against).", buckets=SERVE_LATENCY_BUCKETS)
+SERVE_QUEUE_WAIT = registry.histogram(
+    "hvd_serve_queue_wait_seconds",
+    "Time a request waited in the broker queue before a replica pulled "
+    "it (queueing delay component of hvd_serve_latency_seconds).",
+    buckets=SERVE_LATENCY_BUCKETS)
+SERVE_BATCH_FILL = registry.histogram(
+    "hvd_serve_batch_fill",
+    "Real (pre-padding) batch sizes formed by the continuous batcher.",
+    buckets=exponential_buckets(1.0, 2.0, 9))
+SERVE_QUEUE_DEPTH = registry.gauge(
+    "hvd_serve_queue_depth",
+    "Requests pending in the serving broker queue (the autoscaler's "
+    "primary load signal).")
+SERVE_REPLICAS = registry.gauge(
+    "hvd_serve_replicas",
+    "Live inference replicas pulling from the broker.")
+SERVE_P99_MS = registry.gauge(
+    "hvd_serve_p99_ms",
+    "Windowed p99 request latency in milliseconds (compared against "
+    "HVD_SERVE_SLO_MS by the autoscaler).")
+SERVE_AUTOSCALE_EVENTS = registry.counter(
+    "hvd_serve_autoscale_events_total",
+    "Membership epochs committed by the serving autoscaler, by "
+    "direction (grow/shrink).", ("direction",))
+SERVE_DRAINS = registry.counter(
+    "hvd_serve_drains_total",
+    "Lossless drain handshakes completed before a scale-down removal "
+    "(elastic/driver.py).")
+SERVE_REQUEUES = registry.counter(
+    "hvd_serve_requeues_total",
+    "In-flight requests returned to the queue after a replica died "
+    "without completing them.")
 
 COMPRESSION_RESIDUAL_NORM = registry.gauge(
     "hvd_compression_residual_norm",

@@ -45,9 +45,34 @@ def exponential_buckets(start: float, factor: float, count: int) -> Tuple[float,
     return tuple(start * factor ** i for i in range(count))
 
 
+def latency_buckets_from_env(
+        floor_var: Optional[str] = None,
+        floor_default: Optional[float] = None) -> Tuple[float, ...]:
+    """The configurable latency bucket scheme: exponential from a floor.
+
+    The defaults (100 µs floor, ×2, 18 buckets) are tuned for µs-scale
+    dispatch spans; workloads on a different latency scale — the serving
+    plane's sub-ms..seconds request latencies — pass their own
+    ``floor_var`` (e.g. ``HVD_SERVE_LATENCY_BUCKET_FLOOR``) and
+    ``floor_default`` so their histograms don't collapse into one
+    bucket.  ``HVD_METRICS_BUCKET_{FLOOR,FACTOR,COUNT}`` reshape the
+    default scheme job-wide (factor/count are shared by every scheme)."""
+    floor = env_util.get_float(
+        floor_var or env_util.HVD_METRICS_BUCKET_FLOOR,
+        floor_default if floor_default is not None
+        else env_util.DEFAULT_METRICS_BUCKET_FLOOR)
+    factor = env_util.get_float(env_util.HVD_METRICS_BUCKET_FACTOR,
+                                env_util.DEFAULT_METRICS_BUCKET_FACTOR)
+    count = env_util.get_int(env_util.HVD_METRICS_BUCKET_COUNT,
+                             env_util.DEFAULT_METRICS_BUCKET_COUNT)
+    return exponential_buckets(floor, factor, count)
+
+
 #: default latency buckets: 100 µs .. ~26 s in x2 steps — wide enough to
-#: cover eager dispatch (sub-ms) through big-model step times in one scheme
-LATENCY_BUCKETS = exponential_buckets(1e-4, 2.0, 18)
+#: cover eager dispatch (sub-ms) through big-model step times in one
+#: scheme; reshaped by HVD_METRICS_BUCKET_{FLOOR,FACTOR,COUNT} (read at
+#: import — set them before the first ``import horovod_tpu``)
+LATENCY_BUCKETS = latency_buckets_from_env()
 
 #: payload-size buckets: 64 B .. 4 GB in x8 steps
 BYTES_BUCKETS = exponential_buckets(64.0, 8.0, 10)

@@ -48,6 +48,13 @@ _CONFIG_SCHEMA = {
     "library_options": {
         "num_streams": "num_streams",
     },
+    "serving": {
+        "enabled": "serve",
+        "max_batch": "serve_max_batch",
+        "max_wait_ms": "serve_max_wait_ms",
+        "slo_ms": "serve_slo_ms",
+        "autoscale": "serve_autoscale",
+    },
     "logging": {
         "level": "log_level",
         "hide_timestamp": "log_hide_timestamp",
@@ -142,6 +149,16 @@ def env_from_args(args) -> Dict[str, str]:
         env[env_util.HVD_STALL_SHUTDOWN_TIME_SECONDS] = str(
             args.stall_check_shutdown_time_seconds
         )
+
+    setb(env_util.HVD_SERVE, getattr(args, "serve", False))
+    if getattr(args, "serve_max_batch", None) is not None:
+        env[env_util.HVD_SERVE_MAX_BATCH] = str(args.serve_max_batch)
+    if getattr(args, "serve_max_wait_ms", None) is not None:
+        env[env_util.HVD_SERVE_MAX_WAIT_MS] = str(args.serve_max_wait_ms)
+    if getattr(args, "serve_slo_ms", None) is not None:
+        env[env_util.HVD_SERVE_SLO_MS] = str(args.serve_slo_ms)
+    setb(env_util.HVD_SERVE_AUTOSCALE,
+         getattr(args, "serve_autoscale", False))
 
     if getattr(args, "log_level", None):
         env[env_util.HVD_LOG_LEVEL] = str(args.log_level)

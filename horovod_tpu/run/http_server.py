@@ -98,6 +98,17 @@ BLOCKLIST_KEY = "blocklist"
 ANNOUNCE_PREFIX = "announce."
 READY_PREFIX = "ready."
 STATE_PREFIX = "state."
+# lossless scale-down handshake (elastic/driver.py remove(drain=True) ↔
+# the departing worker): the driver requests under drain.<worker>, the
+# worker stops pulling, finishes in flight, and acks under
+# drain_ack.<worker>; only then is the shrink epoch committed.
+DRAIN_PREFIX = "drain."
+DRAIN_ACK_PREFIX = "drain_ack."
+
+# serving plane (horovod_tpu/serving/, docs/inference.md): tpurun
+# --serve attaches a ServingFrontend to this server — signed POST
+# /infer (one inference request), POST /serving/pull + /serving/result
+# (the remote-replica protocol), GET /serving (status page).
 
 #: lease-age verdict thresholds, in units of the lease's own renewal
 #: interval: a rank is ``stale`` past STALE_FACTOR missed intervals and
@@ -177,11 +188,20 @@ def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
             ready.setdefault(epoch, []).append(worker)
     for workers in ready.values():
         workers.sort()
+    # "drain_ack." keys never match the "drain." prefix (they diverge
+    # at the underscore), so one startswith per family suffices
+    drains = {k[len(DRAIN_PREFIX):]: _load(v) for k, v in keys.items()
+              if k.startswith(DRAIN_PREFIX)}
+    drain_acks = {k[len(DRAIN_ACK_PREFIX):]: _load(v)
+                  for k, v in keys.items()
+                  if k.startswith(DRAIN_ACK_PREFIX)}
     return {
         "epoch": _load(keys.get(EPOCH_KEY)),
         "announces": announces,
         "ready": ready,
         "blocklist": _load(keys.get(BLOCKLIST_KEY)) or [],
+        "drains": drains,
+        "drain_acks": drain_acks,
     }
 
 
@@ -340,6 +360,18 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             self._reply(200, json.dumps(build_membership_report(store))
                         .encode(), content_type="application/json")
             return
+        if path == "/serving":
+            frontend = getattr(self.server, "serving_frontend", None)
+            if frontend is None:
+                self._reply(404)
+                return
+            try:
+                body = json.dumps(frontend.report()).encode()
+            except Exception as e:  # noqa: BLE001 — status page must
+                body = json.dumps(  # not 500 the whole server
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+            self._reply(200, body, content_type="application/json")
+            return
         # Aggregated metrics routes.  No key collision with the KV store:
         # stored keys are always two-part /scope/key paths.
         if path == "/metrics":
@@ -397,6 +429,49 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         else:
             self._reply(200, val)
 
+    def do_POST(self) -> None:  # noqa: N802
+        """Serving-plane routes (horovod_tpu/serving/frontend.py): the
+        KV store itself has no POST surface, so every POST belongs to
+        the attached ServingFrontend — 503 when none is attached (the
+        job was not launched with ``tpurun --serve``)."""
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._verify(body):
+            self._reply(401)
+            return
+        frontend = getattr(self.server, "serving_frontend", None)
+        path = self.path.rstrip("/")
+        routes = {} if frontend is None else {
+            "/infer": frontend.handle_infer,
+            "/serving/pull": frontend.handle_pull,
+            "/serving/result": frontend.handle_result,
+        }
+        handler = routes.get(path)
+        if handler is None:
+            if path in ("/infer", "/serving/pull", "/serving/result"):
+                self._reply(503, json.dumps(
+                    {"error": "no serving plane attached (launch with "
+                              "tpurun --serve)"}).encode(),
+                    content_type="application/json")
+            else:
+                self._reply(404)
+            return
+        try:
+            payload = json.loads(body) if body else {}
+        except ValueError as e:
+            self._reply(400, json.dumps(
+                {"error": f"undecodable JSON body: {e}"}).encode(),
+                content_type="application/json")
+            return
+        try:
+            code, reply = handler(payload)
+        except Exception as e:  # noqa: BLE001 — a handler bug must not
+            code, reply = 500, {  # tear down the rendezvous server
+                "error": f"{type(e).__name__}: {e}"}
+            log.exception("serving route %s failed", path)
+        self._reply(code, json.dumps(reply).encode(),
+                    content_type="application/json")
+
     def do_PUT(self) -> None:  # noqa: N802
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
@@ -445,6 +520,7 @@ class RendezvousServer:
         self._httpd.secret = secret  # type: ignore[attr-defined]
         self._httpd.finalized = set()  # type: ignore[attr-defined]
         self._httpd.lease_times = {}  # type: ignore[attr-defined]
+        self._httpd.serving_frontend = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -517,6 +593,18 @@ class RendezvousServer:
         with self._httpd.lock:  # type: ignore[attr-defined]
             return build_profile_report(
                 dict(self._httpd.store))  # type: ignore[attr-defined]
+
+    def attach_serving(self, frontend) -> None:
+        """Attach a serving front-end (serving/frontend.py): POST
+        /infer, POST /serving/pull|result, and GET /serving route to it
+        from then on.  ``None`` detaches."""
+        self._httpd.serving_frontend = frontend  # type: ignore
+
+    def serving_report(self) -> Optional[Dict[str, object]]:
+        """In-process equivalent of GET /serving (None when no serving
+        plane is attached)."""
+        frontend = getattr(self._httpd, "serving_frontend", None)
+        return None if frontend is None else frontend.report()
 
     def clear_scope(self, scope: str) -> None:
         """Drop every key under ``scope`` (the supervisor resets the

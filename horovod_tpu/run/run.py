@@ -92,6 +92,35 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="elastic floor: give the job up (fail-stop) "
                              "when the world would shrink below this many "
                              "workers (default 1; HVD_ELASTIC_MIN_NP)")
+    parser.add_argument("--serve", action="store_true", dest="serve",
+                        help="serving plane: attach the inference "
+                             "request router to the launcher rendezvous "
+                             "server (signed POST /infer, GET /serving) "
+                             "and export the HVD_SERVE_* knobs to "
+                             "workers, which pull request batches as "
+                             "continuous-batching replicas "
+                             "(docs/inference.md).  With --elastic "
+                             "+ --serve-autoscale, queue depth and "
+                             "p99-vs-SLO headroom grow/shrink the "
+                             "replica fleet through membership epochs")
+    parser.add_argument("--serve-max-batch", type=int,
+                        dest="serve_max_batch",
+                        help="continuous batcher admission cap "
+                             "(HVD_SERVE_MAX_BATCH)")
+    parser.add_argument("--serve-max-wait-ms", type=float,
+                        dest="serve_max_wait_ms",
+                        help="batch flush deadline from first admit "
+                             "(HVD_SERVE_MAX_WAIT_MS)")
+    parser.add_argument("--serve-slo-ms", type=float,
+                        dest="serve_slo_ms",
+                        help="p99 latency objective the autoscaler "
+                             "defends (HVD_SERVE_SLO_MS)")
+    parser.add_argument("--serve-autoscale", action="store_true",
+                        dest="serve_autoscale",
+                        help="let the serving autoscaler commit "
+                             "grow/shrink membership epochs from load "
+                             "(needs --elastic; spares announced via "
+                             "join_world are held for it)")
     parser.add_argument("--controller", dest="controller",
                         choices=["auto", "xla", "native"], default="auto",
                         help="eager control plane: 'native' runs the C++ "
@@ -570,6 +599,31 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
             f"{env_util.HVD_METRICS} or heartbeats, and unset any external "
             f"{env_util.HVD_METRICS_KV_ADDR} sink"
         )
+    serve = bool(getattr(args, "serve", False)) \
+        or env_util.parse_bool(env.get(env_util.HVD_SERVE), False)
+    serve_broker = None
+    if serve:
+        if rdv_server is None:
+            raise RuntimeError(
+                "--serve needs the launcher rendezvous plane: re-enable "
+                f"{env_util.HVD_METRICS} or heartbeats, and unset any "
+                f"external {env_util.HVD_METRICS_KV_ADDR} sink"
+            )
+        from ..serving.broker import RequestBroker
+        from ..serving.frontend import ServingFrontend
+
+        env = dict(env)
+        env[env_util.HVD_SERVE] = "1"
+        serve_broker = RequestBroker()
+        serve_frontend = ServingFrontend(serve_broker)
+        rdv_server.attach_serving(serve_frontend)
+        log.info(
+            "serving: signed POST http://%s:%d/infer routes requests to "
+            "the replica fleet; GET http://%s:%d/serving is the status "
+            "page (docs/inference.md)",
+            env[env_util.HVD_METRICS_KV_ADDR], rdv_server.port,
+            env[env_util.HVD_METRICS_KV_ADDR], rdv_server.port,
+        )
     restarts = getattr(args, "restarts", 0) or 0
     backoff_base = env_util.get_float(env_util.HVD_RESTART_BACKOFF_SECONDS,
                                       env_util.DEFAULT_RESTART_BACKOFF_SECONDS)
@@ -597,6 +651,24 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                     controller=controller, controller_host=ctrl_host,
                 )
                 controller_addr = driver.controller_addr
+                if serve_broker is not None:
+                    # a lossily-removed replica's in-flight requests go
+                    # back to the queue for a survivor (zero-drop-on-
+                    # crash; drained removals already completed theirs)
+                    driver.on_remove = (
+                        lambda w, drained, _b=serve_broker:
+                        None if drained else _b.requeue(w))
+                autoscale = bool(getattr(args, "serve_autoscale", False)) \
+                    or env_util.parse_bool(
+                        env.get(env_util.HVD_SERVE_AUTOSCALE), False)
+                if serve_broker is not None and autoscale:
+                    from ..serving.autoscaler import ServingAutoscaler
+
+                    autoscaler = ServingAutoscaler(driver, serve_broker)
+                    driver.attach_autoscaler(autoscaler)
+                    serve_frontend.autoscaler = autoscaler
+                    log.info("serving: autoscaler attached — announced "
+                             "spares are held and admitted under load")
             elif controller == "native":
                 from ..runtime.controller import ControllerServer
 

@@ -1,0 +1,240 @@
+"""Traffic-driven autoscaling policy + the elastic-driver binding.
+
+The serving plane reuses PR 5's versioned-epoch membership machinery to
+scale with *load* instead of failures (docs/inference.md "Autoscaling"):
+
+* :class:`AutoscalePolicy` is the pure decision function the tests pin:
+  **grow** when queue depth per replica stays above
+  ``HVD_SERVE_QUEUE_HIGH`` — or windowed p99 stays above
+  ``HVD_SERVE_SLO_MS`` — for ``HVD_SERVE_HYSTERESIS_TICKS``
+  consecutive ticks; **shrink** when depth per replica stays at or
+  below ``HVD_SERVE_QUEUE_LOW`` with p99 inside the SLO for the same
+  run of ticks.  A ``HVD_SERVE_COOLDOWN_SECONDS`` refractory period
+  after every action plus the two independent tick counters is the
+  hysteresis that keeps the world from flapping.
+* :class:`ServingAutoscaler` binds the policy to a live
+  :class:`~horovod_tpu.elastic.driver.ElasticDriver` and
+  :class:`~horovod_tpu.serving.broker.RequestBroker`: the driver calls
+  :meth:`tick` from its supervision poll (stable epochs only), and a
+  decision becomes a membership epoch — grow admits a held spare
+  (``driver.admit_spare``), shrink runs the lossless drain handshake
+  (``driver.remove(..., drain=True)``) so no in-flight request is
+  dropped across the transition.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class AutoscalePolicy:
+    """Hysteresis-damped threshold policy; pure and clock-injectable."""
+
+    def __init__(self, *, queue_high: Optional[float] = None,
+                 queue_low: Optional[float] = None,
+                 slo_ms: Optional[float] = None,
+                 hysteresis_ticks: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.queue_high = float(
+            queue_high if queue_high is not None
+            else env_util.get_float(env_util.HVD_SERVE_QUEUE_HIGH,
+                                    env_util.DEFAULT_SERVE_QUEUE_HIGH))
+        self.queue_low = float(
+            queue_low if queue_low is not None
+            else env_util.get_float(env_util.HVD_SERVE_QUEUE_LOW,
+                                    env_util.DEFAULT_SERVE_QUEUE_LOW))
+        self.slo_ms = float(
+            slo_ms if slo_ms is not None
+            else env_util.get_float(env_util.HVD_SERVE_SLO_MS,
+                                    env_util.DEFAULT_SERVE_SLO_MS))
+        self.hysteresis_ticks = int(
+            hysteresis_ticks if hysteresis_ticks is not None
+            else env_util.get_int(env_util.HVD_SERVE_HYSTERESIS_TICKS,
+                                  env_util.DEFAULT_SERVE_HYSTERESIS_TICKS))
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else env_util.get_float(env_util.HVD_SERVE_COOLDOWN_SECONDS,
+                                    env_util.DEFAULT_SERVE_COOLDOWN_SECONDS))
+        self.min_replicas = int(
+            min_replicas if min_replicas is not None
+            else env_util.get_int(env_util.HVD_SERVE_MIN_REPLICAS,
+                                  env_util.DEFAULT_SERVE_MIN_REPLICAS))
+        self.max_replicas = int(
+            max_replicas if max_replicas is not None
+            else env_util.get_int(env_util.HVD_SERVE_MAX_REPLICAS, 0))
+        self.clock = clock
+        self._over_ticks = 0
+        self._idle_ticks = 0
+        self._last_action_t: Optional[float] = None
+
+    def reset(self) -> None:
+        self._over_ticks = 0
+        self._idle_ticks = 0
+        self._last_action_t = None
+
+    def cancel_last_action(self) -> None:
+        """A decision this policy issued could not actually be executed
+        (e.g. every held spare turned out blocklisted): lift the
+        cooldown it started, so real capacity changes aren't delayed by
+        a no-op."""
+        self._last_action_t = None
+
+    def in_cooldown(self) -> bool:
+        return (self._last_action_t is not None
+                and self.clock() - self._last_action_t < self.cooldown_s)
+
+    def decide(self, *, queue_depth: int, p99_ms: Optional[float],
+               replicas: int, spares: int = 0) -> str:
+        """One tick: returns ``"grow"``, ``"shrink"``, or ``"hold"``.
+
+        Tick counters advance even inside the cooldown (so a breach
+        that SPANS the cooldown acts immediately after it), but no
+        action fires until the cooldown elapses."""
+        replicas = max(int(replicas), 1)
+        per_replica = queue_depth / replicas
+        slo_breach = p99_ms is not None and p99_ms > self.slo_ms
+        overloaded = per_replica > self.queue_high or slo_breach
+        idle = (per_replica <= self.queue_low
+                and (p99_ms is None or p99_ms <= self.slo_ms))
+        # the two counters are exclusive: a tick feeds one and zeroes
+        # the other, so one noisy sample restarts the opposing run
+        if overloaded:
+            self._over_ticks += 1
+            self._idle_ticks = 0
+        elif idle:
+            self._idle_ticks += 1
+            self._over_ticks = 0
+        else:
+            self._over_ticks = 0
+            self._idle_ticks = 0
+        if self.in_cooldown():
+            return "hold"
+        if self._over_ticks >= self.hysteresis_ticks:
+            can_grow = spares > 0 and (
+                self.max_replicas <= 0 or replicas < self.max_replicas)
+            if can_grow:
+                self._last_action_t = self.clock()
+                self._over_ticks = 0
+                return "grow"
+            return "hold"
+        if self._idle_ticks >= self.hysteresis_ticks \
+                and replicas > self.min_replicas:
+            self._last_action_t = self.clock()
+            self._idle_ticks = 0
+            return "shrink"
+        return "hold"
+
+
+class ServingAutoscaler:
+    """Driver-attached autoscaler: ticks read the broker, decisions
+    commit membership epochs.
+
+    ``pick_victim(driver) -> worker_id`` chooses the scale-down target;
+    the default drains the most recently admitted non-initial worker
+    (LIFO — scale back down to the core fleet first), falling back to
+    the highest-ranked worker, and never rank 0."""
+
+    def __init__(self, driver, broker, policy: Optional[AutoscalePolicy]
+                 = None, *, pick_victim: Optional[Callable] = None) -> None:
+        self.driver = driver
+        self.broker = broker
+        self.policy = policy or AutoscalePolicy()
+        self.pick_victim = pick_victim or self._default_victim
+        self.events = []  # (direction, worker, epoch) history
+
+    @staticmethod
+    def _default_victim(driver) -> Optional[str]:
+        candidates = [w for w in driver.world[1:]
+                      if w not in driver.finished]
+        if not candidates:
+            return None
+        external = [w for w in candidates if w not in driver.initial]
+        return (external or candidates)[-1]
+
+    def tick(self) -> str:
+        """One autoscale evaluation (called by ``ElasticDriver.poll``
+        on stable epochs).  Returns the decision taken."""
+        stats = self.broker.window_stats()
+        self._export_gauges(stats)
+        decision = self.policy.decide(
+            queue_depth=stats["queue_depth"], p99_ms=stats["p99_ms"],
+            replicas=len(self.driver.world), spares=len(self.driver.spares))
+        if decision == "grow":
+            worker = self.driver.admit_spare(
+                reason=f"autoscale grow: queue_depth="
+                       f"{stats['queue_depth']} p99_ms={stats['p99_ms']}")
+            if worker is None:
+                # every held spare was unusable (blocklisted/already in
+                # world): nothing changed, so no cooldown either
+                self.policy.cancel_last_action()
+                return "hold"
+            self._record_event("grow", worker)
+        elif decision == "shrink":
+            worker = self.pick_victim(self.driver)
+            if worker is None:
+                self.policy.cancel_last_action()
+                return "hold"
+            ok = self.driver.remove(
+                worker,
+                f"autoscale shrink: queue_depth={stats['queue_depth']} "
+                f"p99_ms={stats['p99_ms']}", drain=True)
+            if not ok:
+                # min_np would be violated — not an error, just a floor
+                self.driver.failed_reason = None
+                self.policy.cancel_last_action()
+                return "hold"
+            self._record_event("shrink", worker)
+        return decision
+
+    def _record_event(self, direction: str, worker: str) -> None:
+        self.events.append((direction, worker, self.driver.epoch))
+        log.warning("autoscale %s: worker %s (epoch %d)", direction,
+                    worker, self.driver.epoch)
+        try:
+            from .. import metrics
+
+            if metrics.on():
+                metrics.SERVE_AUTOSCALE_EVENTS.labels(direction).inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _export_gauges(self, stats: dict) -> None:
+        try:
+            from .. import metrics
+
+            if metrics.on():
+                if stats.get("p99_ms") is not None:
+                    metrics.SERVE_P99_MS.set(stats["p99_ms"])
+                metrics.SERVE_REPLICAS.set(len(self.driver.world))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def snapshot(self) -> dict:
+        """State for ``GET /serving``."""
+        p = self.policy
+        return {
+            "replicas": len(self.driver.world),
+            "world": list(self.driver.world),
+            "spares": list(self.driver.spares),
+            "epoch": self.driver.epoch,
+            "events": [{"direction": d, "worker": w, "epoch": e}
+                       for d, w, e in self.events[-20:]],
+            "policy": {
+                "queue_high": p.queue_high, "queue_low": p.queue_low,
+                "slo_ms": p.slo_ms,
+                "hysteresis_ticks": p.hysteresis_ticks,
+                "cooldown_s": p.cooldown_s,
+                "min_replicas": p.min_replicas,
+                "max_replicas": p.max_replicas,
+            },
+            "in_cooldown": p.in_cooldown(),
+        }

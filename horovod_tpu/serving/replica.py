@@ -1,0 +1,394 @@
+"""Inference replica worker: checkpoint → jitted batched forward → pull
+loop.
+
+One replica = one worker in the serving world (docs/inference.md).  It
+loads trained parameters (``utils/checkpoint`` layout, optionally
+compressed at rest with PR 7's int8/fp8 quantizers for serving
+density), jits the batched forward once per padded bucket size
+(serving/batching.py bounds the bucket ladder, so compiles are
+bounded), and pulls work from the shared request broker — in process,
+or over the rendezvous server's ``POST /serving/pull`` route when the
+replica runs on another host (:class:`RemoteSource`).
+
+Draining (the lossless scale-down handshake): :meth:`drain` stops the
+pull loop from receiving new work, finishes everything in flight, and
+returns — the elastic driver commits the shrink epoch only after the
+ack (elastic/driver.py ``remove(drain=True)``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+from .batching import BatchBucketer, ContinuousBatcher, bucket_sizes_from_env
+
+log = get_logger(__name__)
+
+
+# -- weight compression at rest ----------------------------------------------
+def compress_params(params: Any, wire: str = "int8") -> Tuple[Any, dict]:
+    """Quantize every float leaf of ``params`` with the wire-format
+    quantizers from ops/compression.py (per-tensor scale, group size 1
+    — no summation headroom needed: weights are stored, not reduced).
+    Returns ``(compressed_tree, info)`` where each compressed leaf is a
+    ``(q, dequant_factor)`` pair; ``info`` carries the byte ratio the
+    serving-density story is about."""
+    import jax
+
+    from ..ops.compression import numpy_quantize
+
+    orig_bytes = 0
+    comp_bytes = 0
+
+    def _one(leaf):
+        nonlocal orig_bytes, comp_bytes
+        arr = np.asarray(leaf)
+        orig_bytes += arr.nbytes
+        if not np.issubdtype(arr.dtype, np.floating):
+            comp_bytes += arr.nbytes
+            return leaf
+        q, factor = numpy_quantize(arr, group_size=1, wire=wire)
+        comp_bytes += q.nbytes
+        return (q, float(factor))
+
+    tree = jax.tree_util.tree_map(_one, params)
+    info = {"wire": wire, "orig_bytes": orig_bytes,
+            "compressed_bytes": comp_bytes,
+            "ratio": round(orig_bytes / comp_bytes, 3) if comp_bytes
+            else None}
+    return tree, info
+
+
+def decompress_params(tree: Any, dtype=np.float32) -> Any:
+    """Materialize a :func:`compress_params` tree back to float arrays
+    (done once at replica start — weights are compressed at rest, not
+    per batch)."""
+    import jax
+
+    from ..ops.compression import numpy_dequantize
+
+    def _one(leaf):
+        if isinstance(leaf, tuple) and len(leaf) == 2 \
+                and isinstance(leaf[1], float):
+            return numpy_dequantize(np.asarray(leaf[0]),
+                                    leaf[1]).astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(_one, tree, is_leaf=lambda x:
+                                  isinstance(x, tuple))
+
+
+def load_params(checkpoint_path: str, like: Any,
+                step: Optional[int] = None) -> Any:
+    """Restore a trained parameter pytree for serving — the
+    ``utils/checkpoint`` layout (``step_N`` dirs + COMMITTED sentinels)
+    without the training-time broadcast: a serving replica is a
+    standalone process, not a rank in a training world."""
+    from ..utils.checkpoint import restore_checkpoint
+
+    return restore_checkpoint(checkpoint_path, like, step=step,
+                              broadcast=False)
+
+
+class InferenceReplica:
+    """One pull→batch→forward→complete worker.
+
+    ``apply_fn(params, batch) -> outputs`` is the model's batched
+    forward (a flax ``model.apply``-shaped callable).  ``source`` is
+    anything broker-shaped (``pull``/``complete``/``fail`` keyed by
+    this replica's id) — the in-process broker or a
+    :class:`RemoteSource`.  ``jit=False`` runs the forward as plain
+    python (tests use it to script service times)."""
+
+    def __init__(self, source, apply_fn: Callable, params: Any, *,
+                 replica_id: str, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 weight_compression: Optional[str] = None,
+                 jit: bool = True) -> None:
+        self.source = source
+        self.apply_fn = apply_fn
+        self.replica_id = str(replica_id)
+        self.jit = jit
+        self.compression_info: Optional[dict] = None
+        wc = weight_compression if weight_compression is not None \
+            else env_util.get_str(env_util.HVD_SERVE_WEIGHT_COMPRESSION)
+        if wc and wc != "none":
+            # compressed at rest for density; materialized once here
+            compressed, self.compression_info = compress_params(params, wc)
+            params = decompress_params(compressed)
+        self.params = params
+        max_batch = int(
+            max_batch if max_batch is not None
+            else env_util.get_int(env_util.HVD_SERVE_MAX_BATCH,
+                                  env_util.DEFAULT_SERVE_MAX_BATCH))
+        self.bucketer = BatchBucketer(
+            bucket_sizes if bucket_sizes is not None
+            else bucket_sizes_from_env(max_batch))
+        top = self.bucketer.sizes[-1]
+        if max_batch > top:
+            # a batch larger than the top rung has no padded shape to
+            # land in — admitting one would fail wholesale
+            log.warning("HVD_SERVE_MAX_BATCH %d exceeds the bucket "
+                        "ladder top %d; capping the batcher", max_batch,
+                        top)
+            max_batch = top
+        self.batcher = ContinuousBatcher(
+            lambda n, wait_s: source.pull(self.replica_id, n, wait_s),
+            max_batch=max_batch, max_wait_ms=max_wait_ms)
+        self._jitted: Optional[Callable] = None
+        self._buckets_seen: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_flag = threading.Event()
+        self.requests = 0
+        self.batches = 0
+
+    # -- forward -------------------------------------------------------------
+    def _forward(self, bucket: int) -> Callable:
+        """One jitted callable for every bucket (jax.jit specializes
+        per input shape under the hood); ``bucket`` is recorded so
+        :attr:`recompiles` reports how many distinct padded shapes —
+        i.e. XLA programs — this replica has hit."""
+        self._buckets_seen.add(int(bucket))
+        fn = self._jitted
+        if fn is None:
+            if self.jit:
+                import jax
+
+                fn = jax.jit(self.apply_fn)
+            else:
+                fn = self.apply_fn
+            self._jitted = fn
+        return fn
+
+    @property
+    def recompiles(self) -> int:
+        """Distinct padded batch shapes executed (one XLA program
+        each) — bounded by the bucket ladder."""
+        return len(self._buckets_seen)
+
+    def warmup(self, sample) -> None:
+        """Compile every bucket size up front with ``sample`` (one
+        request's input) so the first real request on each padded shape
+        doesn't pay an XLA compile."""
+        import numpy as np
+
+        sample = np.asarray(sample)
+        for b in self.bucketer.sizes:
+            np.asarray(self._forward(b)(self.params,
+                                        np.stack([sample] * b)))
+
+    def process(self, batch) -> None:
+        """Run one pulled batch: stack, pad to the bucket, forward,
+        complete each request with its row.  Per-request failures fail
+        that request, not the replica."""
+        try:
+            stacked = np.stack([np.asarray(r.inputs) for r in batch])
+            padded, n = self.bucketer.pad(stacked)
+            out = self._forward(padded.shape[0])(self.params, padded)
+            out = np.asarray(out)
+        except Exception as e:  # noqa: BLE001 — a poison batch must
+            for req in batch:   # not kill the replica loop
+                try:
+                    self.source.fail(req, f"{type(e).__name__}: {e}",
+                                     self.replica_id)
+                except Exception:  # noqa: BLE001
+                    log.warning("could not deliver failure for "
+                                "request %s", req.id)
+            return
+        for i, req in enumerate(batch):
+            # per-request delivery: one failed result post (past its
+            # retry budget) must not strand the REST of a computed
+            # batch in the broker's in-flight table
+            try:
+                self.source.complete(req, out[i], self.replica_id)
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self.source.fail(
+                        req, f"result delivery failed: {e}",
+                        self.replica_id)
+                except Exception:  # noqa: BLE001
+                    log.warning("stranded request %s: result "
+                                "delivery failed twice (%s)", req.id, e)
+        self.requests += len(batch)
+        self.batches += 1
+
+    # -- the loop ------------------------------------------------------------
+    def start(self) -> "InferenceReplica":
+        self._stop_flag.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"hvd-serve-replica-{self.replica_id}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_flag.is_set():
+            try:
+                batch = self.batcher.next_batch(idle_wait_s=0.05)
+                if batch:
+                    self.process(batch)
+            except Exception:  # noqa: BLE001 — a transient source
+                # error (e.g. one refused RemoteSource HTTP pull) must
+                # not kill the replica thread while its worker is still
+                # in the committed world
+                log.exception("replica %s pull loop error; retrying",
+                              self.replica_id)
+                self._stop_flag.wait(0.2)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Lossless stop: no new pulls, finish in flight, join the
+        loop.  Returns True when everything completed in time."""
+        if timeout is None:
+            timeout = env_util.get_float(
+                env_util.HVD_SERVE_DRAIN_TIMEOUT_SECONDS,
+                env_util.get_float(env_util.HVD_ELASTIC_TIMEOUT_SECONDS,
+                                   env_util.DEFAULT_ELASTIC_TIMEOUT_SECONDS))
+        drain_begin = getattr(self.source, "drain_begin", None)
+        if drain_begin is not None:
+            drain_begin(self.replica_id)
+        drained = True
+        wait_drained = getattr(self.source, "wait_drained", None)
+        if wait_drained is not None:
+            drained = wait_drained(self.replica_id, timeout)
+        # the loop thread joining means the current batch ran to
+        # completion — for sources with no wait_drained (RemoteSource:
+        # the in-flight table lives launcher-side) this is the only
+        # local evidence the drain actually finished; a slow batch
+        # outliving the timeout must NOT read as drained
+        joined = self.stop(join_timeout=timeout)
+        return drained and joined
+
+    def stop(self, join_timeout: float = 5.0) -> bool:
+        """Stop the loop; True iff it joined inside ``join_timeout``
+        (False means a batch is still executing)."""
+        self._stop_flag.set()
+        joined = True
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+            joined = not self._thread.is_alive()
+            if not joined:
+                log.warning("replica %s loop did not stop within %.1fs",
+                            self.replica_id, join_timeout)
+            self._thread = None
+        return joined
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+class RemoteSource:
+    """Broker-shaped adapter for replicas on other hosts: ``pull`` and
+    ``complete``/``fail`` ride the rendezvous server's signed
+    ``POST /serving/pull`` / ``POST /serving/result`` routes
+    (run/http_client.py), so a remote replica worker runs the exact
+    same :class:`InferenceReplica` loop as an in-process one."""
+
+    class _Req:
+        __slots__ = ("id", "inputs")
+
+        def __init__(self, req_id: int, inputs) -> None:
+            self.id = req_id
+            self.inputs = inputs
+
+    def __init__(self, addr: str, port: int,
+                 secret: Optional[bytes] = None) -> None:
+        self.addr = addr
+        self.port = port
+        self.secret = secret
+
+    @classmethod
+    def from_env(cls) -> "RemoteSource":
+        """Wire from the launcher-exported rendezvous env
+        (HVD_METRICS_KV_ADDR/PORT/SECRET) — what ``hvd_serve --worker``
+        under ``tpurun --serve`` uses."""
+        addr = env_util.get_str(env_util.HVD_METRICS_KV_ADDR)
+        port = env_util.get_int(env_util.HVD_METRICS_KV_PORT, 0)
+        if not addr or not port:
+            raise RuntimeError(
+                "RemoteSource needs the rendezvous wiring "
+                "(HVD_METRICS_KV_ADDR/PORT); run under tpurun --serve "
+                "or pass addr/port explicitly")
+        secret_hex = env_util.get_str(env_util.HVD_METRICS_SECRET)
+        return cls(addr, port,
+                   bytes.fromhex(secret_hex) if secret_hex else None)
+
+    def pull(self, replica_id: str, max_n: int, wait_s: float):
+        from ..run.http_client import serve_pull
+
+        out = serve_pull(self.addr, self.port, replica_id, max_n,
+                         wait_ms=wait_s * 1000.0, secret=self.secret,
+                         timeout=wait_s + 10.0)
+        return [self._Req(r["id"], np.asarray(r["inputs"],
+                                              dtype=np.float32))
+                for r in out.get("requests", ())]
+
+    def complete(self, req, output, replica_id: str) -> bool:
+        from ..run.http_client import serve_result
+
+        out = serve_result(self.addr, self.port, replica_id,
+                           [{"id": req.id,
+                             "output": np.asarray(output).tolist()}],
+                           secret=self.secret)
+        return bool(out.get("accepted"))
+
+    def fail(self, req, error: str, replica_id: str) -> bool:
+        from ..run.http_client import serve_result
+
+        out = serve_result(self.addr, self.port, replica_id,
+                           [{"id": req.id, "error": str(error)}],
+                           secret=self.secret)
+        return bool(out.get("accepted"))
+
+    # drain for a remote replica is driven by the membership drain key
+    # (elastic/membership.py drain_requested/ack_drain); the broker-side
+    # drain_begin is issued by the driver's handshake, so the remote
+    # source needs no local drain state.
+
+
+def serve_worker_loop(apply_fn: Callable, params: Any, *,
+                      replica_id: Optional[str] = None,
+                      source=None, poll_s: float = 0.5,
+                      stop_event: Optional[threading.Event] = None) -> None:
+    """The ``hvd_serve --worker`` body: run an :class:`InferenceReplica`
+    against the launcher's broker and honor the elastic drain
+    handshake — on a ``drain.<worker>`` key, finish in flight, ack,
+    and exit; on eviction from the committed world, exit."""
+    from ..elastic import membership
+
+    wid = replica_id if replica_id is not None else membership.worker_id()
+    source = source if source is not None else RemoteSource.from_env()
+    replica = InferenceReplica(source, apply_fn, params,
+                               replica_id=str(wid)).start()
+    try:
+        while stop_event is None or not stop_event.is_set():
+            time.sleep(poll_s)
+            if membership.drain_requested() is not None:
+                if replica.drain():
+                    membership.ack_drain()
+                else:
+                    # work still in flight: an ack would record this as
+                    # a lossless drain and skip the launcher-side
+                    # requeue — let the driver's timeout take the
+                    # lossy path instead
+                    log.warning("drain timed out with work in flight; "
+                                "exiting without ack")
+                return
+            rec = membership.current_record()
+            try:
+                rec = membership.get_epoch_record() or rec
+            except Exception:  # noqa: BLE001 — keep serving through a
+                pass            # rendezvous blip
+            if rec is not None and str(wid) not in rec.get("world", ()):
+                log.info("worker %s no longer in the committed world; "
+                         "stopping replica", wid)
+                return
+    finally:
+        replica.stop()

@@ -143,6 +143,33 @@ HVD_ELASTIC_WORKER_ID = "HVD_ELASTIC_WORKER_ID"        # stable worker identity 
 HVD_ELASTIC_MIN_NP = "HVD_ELASTIC_MIN_NP"              # floor world size before giving up (default 1)
 HVD_ELASTIC_TIMEOUT_SECONDS = "HVD_ELASTIC_TIMEOUT_SECONDS"  # epoch wait/rebuild budget (default 60)
 HVD_ELASTIC_MAX_FLAPS = "HVD_ELASTIC_MAX_FLAPS"        # removals before a worker is blocklisted (default 3)
+# metrics-plane histogram shape (metrics/registry.py): the default
+# latency bucket scheme is exponential from FLOOR seconds; serving-scale
+# request latencies get their own floor below
+HVD_METRICS_BUCKET_FLOOR = "HVD_METRICS_BUCKET_FLOOR"  # first latency bucket edge, seconds (default 1e-4)
+HVD_METRICS_BUCKET_FACTOR = "HVD_METRICS_BUCKET_FACTOR"  # geometric growth per bucket (default 2)
+HVD_METRICS_BUCKET_COUNT = "HVD_METRICS_BUCKET_COUNT"  # finite bucket count (default 18)
+# serving plane (horovod_tpu/serving/, docs/inference.md): continuous-
+# batching inference replicas + traffic-driven autoscaling on the
+# elastic epoch machinery
+HVD_SERVE = "HVD_SERVE"                                # 1 = serving plane on (tpurun --serve)
+HVD_SERVE_MAX_BATCH = "HVD_SERVE_MAX_BATCH"            # batcher admits up to this many requests (default 8)
+HVD_SERVE_MAX_WAIT_MS = "HVD_SERVE_MAX_WAIT_MS"        # flush deadline from first admitted request (default 5)
+HVD_SERVE_BUCKET_SIZES = "HVD_SERVE_BUCKET_SIZES"      # comma list of padded batch sizes (default pow2 <= max batch)
+HVD_SERVE_SLO_MS = "HVD_SERVE_SLO_MS"                  # p99 latency objective (default 100)
+HVD_SERVE_TIMEOUT_SECONDS = "HVD_SERVE_TIMEOUT_SECONDS"  # per-request wait budget (default 30)
+HVD_SERVE_QUEUE_LIMIT = "HVD_SERVE_QUEUE_LIMIT"        # admission cap; excess rejected (default 4096)
+HVD_SERVE_LATENCY_BUCKET_FLOOR = "HVD_SERVE_LATENCY_BUCKET_FLOOR"  # serving histogram floor, seconds (default 2.5e-4)
+HVD_SERVE_AUTOSCALE = "HVD_SERVE_AUTOSCALE"            # 1 = autoscaler drives the elastic driver
+HVD_SERVE_QUEUE_HIGH = "HVD_SERVE_QUEUE_HIGH"          # per-replica queue depth read as overload (default 4)
+HVD_SERVE_QUEUE_LOW = "HVD_SERVE_QUEUE_LOW"            # per-replica queue depth read as idle (default 0.5)
+HVD_SERVE_HYSTERESIS_TICKS = "HVD_SERVE_HYSTERESIS_TICKS"  # sustained ticks before grow/shrink (default 3)
+HVD_SERVE_COOLDOWN_SECONDS = "HVD_SERVE_COOLDOWN_SECONDS"  # min spacing between autoscale actions (default 10)
+HVD_SERVE_MIN_REPLICAS = "HVD_SERVE_MIN_REPLICAS"      # shrink floor (default 1)
+HVD_SERVE_MAX_REPLICAS = "HVD_SERVE_MAX_REPLICAS"      # grow ceiling (default 0 = bounded by spares)
+HVD_SERVE_DRAIN_TIMEOUT_SECONDS = "HVD_SERVE_DRAIN_TIMEOUT_SECONDS"  # drain handshake budget (default elastic timeout)
+HVD_SERVE_WEIGHT_COMPRESSION = "HVD_SERVE_WEIGHT_COMPRESSION"  # none|bf16|int8|fp8 at-rest weight format
+HVD_BENCH_SERVE = "HVD_BENCH_SERVE"                    # 0 skips bench.py's serving leg
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -165,6 +192,20 @@ DEFAULT_DCN_HOP_US = 10.0                          # modeled cross-host per-hop 
 DEFAULT_PROFILE_STEPS = 3                          # profiler window length when no end step is configured
 DEFAULT_PROFILE_GAP_THRESHOLD_US = 25.0            # host-gap span flagging threshold
 DEFAULT_PROFILE_HOST_BOUND_FRACTION = 0.2          # step verdict flips to host-bound past this gap share
+DEFAULT_METRICS_BUCKET_FLOOR = 1e-4                # first latency bucket edge, seconds
+DEFAULT_METRICS_BUCKET_FACTOR = 2.0                # geometric bucket growth
+DEFAULT_METRICS_BUCKET_COUNT = 18                  # finite bucket count
+DEFAULT_SERVE_MAX_BATCH = 8                        # serving/batching.py admission cap
+DEFAULT_SERVE_MAX_WAIT_MS = 5.0                    # serving flush deadline from first admit
+DEFAULT_SERVE_SLO_MS = 100.0                       # serving p99 latency objective
+DEFAULT_SERVE_TIMEOUT_SECONDS = 30.0               # per-request wait budget
+DEFAULT_SERVE_QUEUE_LIMIT = 4096                   # broker admission cap
+DEFAULT_SERVE_LATENCY_BUCKET_FLOOR = 2.5e-4        # serving histogram floor, seconds
+DEFAULT_SERVE_QUEUE_HIGH = 4.0                     # overload threshold, per replica
+DEFAULT_SERVE_QUEUE_LOW = 0.5                      # idle threshold, per replica
+DEFAULT_SERVE_HYSTERESIS_TICKS = 3                 # sustained ticks before an autoscale action
+DEFAULT_SERVE_COOLDOWN_SECONDS = 10.0              # spacing between autoscale actions
+DEFAULT_SERVE_MIN_REPLICAS = 1                     # autoscaler shrink floor
 
 
 def get_int(name: str, default: int) -> int:

@@ -1,0 +1,270 @@
+"""Serve a trained checkpoint behind the continuous-batching serving
+plane — or self-test / bench the plane itself.
+
+Modes (docs/inference.md):
+
+    python scripts/hvd_serve.py --check
+        Fixture self-test (tier-1): deterministic batcher flush pins,
+        autoscale-policy hysteresis pins, and a live in-process replica
+        fleet under a seeded bursty open-loop trace with zero-drop
+        accounting.  Exit 0/1.
+
+    python scripts/hvd_serve.py --bench [--json]
+        The bench fixture on its own: seeded bursty trace against a
+        small jitted MLP fleet; prints serve_p50_ms / serve_p99_ms /
+        goodput_under_burst (what bench.py --child-serve reports).
+
+    python scripts/hvd_serve.py --checkpoint DIR --model mlp \
+            [--replicas N] [--port P] [--secret HEX]
+        Stand up a local serving stack: rendezvous server with the
+        signed POST /infer + GET /serving routes, N in-process replica
+        threads over the restored weights.  Ctrl-C stops it.
+
+    python scripts/hvd_serve.py --worker --checkpoint DIR --model mlp
+        Remote replica under ``tpurun --serve``: pulls request batches
+        from the launcher's broker over HTTP, honors the drain
+        handshake, exits when evicted from the committed world.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_model(name: str, in_dim: int):
+    """(apply_fn, like_variables, sample_input) for a named model."""
+    import jax
+    import numpy as np
+
+    if name == "mlp":
+        from horovod_tpu.models.mlp import MLP
+
+        model = MLP()
+        sample = np.zeros((1, in_dim), dtype=np.float32)
+    elif name == "convnet":
+        from horovod_tpu.models.mlp import ConvNet
+
+        model = ConvNet()
+        side = int(round(in_dim ** 0.5)) or 28
+        sample = np.zeros((1, side, side, 1), dtype=np.float32)
+    else:
+        raise ValueError(f"unknown --model {name!r} (mlp|convnet)")
+    like = model.init(jax.random.PRNGKey(0), sample)
+    return model.apply, like, sample[0]
+
+
+# -- --check -----------------------------------------------------------------
+def _check_batcher() -> list:
+    """Deterministic flush pins against a scripted clock/source."""
+    from horovod_tpu.serving.batching import BatchBucketer, ContinuousBatcher
+
+    errors = []
+    clock = [0.0]
+    ready = [list(range(10))]  # ten instantly available requests
+
+    def pull(n, wait_s):
+        out, ready[0] = ready[0][:n], ready[0][n:]
+        return out
+
+    b = ContinuousBatcher(pull, max_batch=4, max_wait_ms=50.0,
+                          clock=lambda: clock[0])
+    if b.next_batch() != [0, 1, 2, 3]:
+        errors.append("flush-on-size: expected the first 4 requests")
+    # deadline flush: one request now, the next arrives too late
+    trickle = [[10], [], [11]]
+
+    def pull_slow(n, wait_s):
+        clock[0] += 0.03  # each poll costs 30 ms of scripted time
+        return trickle.pop(0) if trickle else []
+
+    b2 = ContinuousBatcher(pull_slow, max_batch=4, max_wait_ms=50.0,
+                           clock=lambda: clock[0])
+    got = b2.next_batch()
+    if got != [10]:
+        errors.append(f"flush-on-deadline: expected [10], got {got}")
+    bk = BatchBucketer((1, 2, 4, 8))
+    pins = [(1, 1), (2, 2), (3, 4), (5, 8), (8, 8)]
+    for n, want in pins:
+        if bk.bucket(n) != want:
+            errors.append(f"bucket({n}) != {want}")
+    try:
+        bk.bucket(9)
+        errors.append("bucket(9) above the ladder top did not raise")
+    except ValueError:
+        pass
+    import numpy as np
+
+    padded, n = bk.pad(np.ones((3, 5), dtype=np.float32))
+    if padded.shape != (4, 5) or n != 3 or padded[3].any():
+        errors.append("pad(3->4) wrong shape or nonzero padding rows")
+    return errors
+
+
+def _check_policy() -> list:
+    """Hysteresis/cooldown pins on a scripted clock."""
+    from horovod_tpu.serving.autoscaler import AutoscalePolicy
+
+    errors = []
+    clock = [0.0]
+    p = AutoscalePolicy(queue_high=4, queue_low=0.5, slo_ms=100,
+                        hysteresis_ticks=3, cooldown_s=10,
+                        min_replicas=1, max_replicas=0,
+                        clock=lambda: clock[0])
+    seq = []
+    for depth in (10, 10, 3, 10, 10, 10):  # a dip restarts the run
+        seq.append(p.decide(queue_depth=depth, p99_ms=None, replicas=1,
+                            spares=1))
+        clock[0] += 1.0
+    if seq != ["hold"] * 5 + ["grow"]:
+        errors.append(f"grow hysteresis broke: {seq}")
+    # cooldown: immediately idle, but no shrink until 10 s elapsed
+    seq2 = []
+    for _ in range(4):
+        seq2.append(p.decide(queue_depth=0, p99_ms=20.0, replicas=2,
+                             spares=0))
+        clock[0] += 1.0
+    if any(d != "hold" for d in seq2):
+        errors.append(f"cooldown violated: {seq2}")
+    clock[0] += 10.0
+    # the idle run kept counting through the cooldown, so the first
+    # post-cooldown tick acts immediately
+    d = p.decide(queue_depth=0, p99_ms=20.0, replicas=2, spares=0)
+    if d != "shrink":
+        errors.append(f"expected shrink after cooldown, got {d}")
+    return errors
+
+
+def run_check() -> int:
+    from horovod_tpu.serving.plane import run_serving_fixture
+
+    errors = _check_batcher() + _check_policy()
+    out = run_serving_fixture(jit=False, service_ms=2.0, seed=7)
+    b = out["broker"]
+    if out["offered"] != out["completed"]:
+        errors.append(f"dropped requests: offered {out['offered']} != "
+                      f"completed {out['completed']}")
+    if b["submitted"] != b["completed"] or b["failed"] or b["rejected"]:
+        errors.append(f"broker accounting off: {b}")
+    if b["duplicates"] or b["requeued"]:
+        errors.append(f"duplicate/requeued work in a clean run: {b}")
+    if out["serve_p50_ms"] is None or out["serve_p99_ms"] is None:
+        errors.append("no latency percentiles computed")
+    if out.get("goodput_under_burst") is None:
+        errors.append("no burst-window goodput computed")
+    if errors:
+        print("hvd_serve --check FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"hvd_serve --check OK: batcher flush pins exact, policy "
+          f"hysteresis/cooldown exact, live fixture served "
+          f"{out['completed']}/{out['offered']} requests with zero "
+          f"drops/duplicates (p50 {out['serve_p50_ms']} ms, p99 "
+          f"{out['serve_p99_ms']} ms, goodput_under_burst "
+          f"{out['goodput_under_burst']})")
+    return 0
+
+
+def run_bench(as_json: bool) -> dict:
+    from horovod_tpu.serving.plane import run_bench_fixture
+
+    out = run_bench_fixture()
+    if as_json:
+        print(json.dumps(out, indent=1))
+    else:
+        print(f"serving bench: {out['completed']}/{out['offered']} "
+              f"requests on {out['replicas']} replicas")
+        print(f"  p50 {out['serve_p50_ms']} ms   p99 "
+              f"{out['serve_p99_ms']} ms   (SLO {out['slo_ms']} ms)")
+        print(f"  goodput {out['goodput']}   under burst "
+              f"{out['goodput_under_burst']}")
+    return out
+
+
+# -- serve / worker modes ----------------------------------------------------
+def run_serve(args) -> int:
+    from horovod_tpu.run.http_server import RendezvousServer
+    from horovod_tpu.serving.plane import LocalServingPlane
+    from horovod_tpu.serving.replica import load_params
+
+    apply_fn, like, sample = _build_model(args.model, args.in_dim)
+    params = load_params(args.checkpoint, like) if args.checkpoint \
+        else like
+    secret = bytes.fromhex(args.secret) if args.secret else None
+    server = RendezvousServer(secret=secret, port=args.port)
+    port = server.start()
+    plane = LocalServingPlane(apply_fn, params, replicas=args.replicas,
+                              rdv_server=server)
+    # warm every bucket so the first real request doesn't pay a compile
+    for rep in plane.replicas.values():
+        rep.warmup(sample)
+    print(f"serving {args.model} on http://0.0.0.0:{port} — signed "
+          f"POST /infer, GET /serving ({args.replicas} replica(s); "
+          "Ctrl-C stops)")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        plane.shutdown()
+        server.stop()
+    return 0
+
+
+def run_worker(args) -> int:
+    from horovod_tpu.serving.replica import load_params, serve_worker_loop
+
+    apply_fn, like, _sample = _build_model(args.model, args.in_dim)
+    params = load_params(args.checkpoint, like) if args.checkpoint \
+        else like
+    serve_worker_loop(apply_fn, params)
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="continuous-batching inference serving on the "
+                    "horovod_tpu control plane (docs/inference.md)")
+    p.add_argument("--check", action="store_true",
+                   help="fixture self-test (tier-1)")
+    p.add_argument("--bench", action="store_true",
+                   help="run the seeded bursty bench fixture")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable --bench output")
+    p.add_argument("--checkpoint", default=None,
+                   help="utils/checkpoint layout dir (step_N + "
+                        "COMMITTED sentinels); fresh-init weights "
+                        "when omitted")
+    p.add_argument("--model", default="mlp", choices=["mlp", "convnet"])
+    p.add_argument("--in-dim", type=int, default=32, dest="in_dim",
+                   help="flat input feature count (mlp) or image "
+                        "pixels (convnet)")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--port", type=int, default=0,
+                   help="request-plane port (0 = ephemeral)")
+    p.add_argument("--secret", default=None,
+                   help="hex HMAC secret for the signed routes")
+    p.add_argument("--worker", action="store_true",
+                   help="remote replica mode under tpurun --serve")
+    args = p.parse_args(argv)
+
+    if args.check:
+        sys.exit(run_check())
+    if args.bench:
+        run_bench(args.json)
+        return 0
+    if args.worker:
+        return run_worker(args)
+    return run_serve(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -73,6 +73,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_probe", lambda: "ok")
     monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
     monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
+    monkeypatch.setattr(bench, "_serving_leg", lambda: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
@@ -237,6 +238,53 @@ def test_compression_leg_skippable(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert "compression_delta_pct" not in out
     assert not any("--child-compression" in c for c in calls)
+
+
+def test_serving_leg_merged_and_skippable(monkeypatch, capsys):
+    """The serving leg (docs/inference.md) lands serve_p50_ms /
+    serve_p99_ms / goodput_under_burst in the JSON tail, and
+    HVD_BENCH_SERVE=0 skips it entirely — same contract as the
+    autotune/compression legs."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        def __init__(self, line):
+            self.returncode = 0
+            self.stdout = "RESULT " + line + "\n"
+            self.stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        if "--child-serve" in cmd:
+            return FakeProc(json.dumps(
+                {"serve_p50_ms": 3.2, "serve_p99_ms": 11.5,
+                 "goodput_under_burst": 0.98}))
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
+    monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_SERVE", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["serve_p50_ms"] == 3.2 and out["serve_p99_ms"] == 11.5
+    assert out["goodput_under_burst"] == 0.98
+    assert any("--child-serve" in c for c in calls)
+
+    # HVD_BENCH_SERVE=0: no child run, no tail fields
+    calls.clear()
+    monkeypatch.setenv("HVD_BENCH_SERVE", "0")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "serve_p50_ms" not in out
+    assert not any("--child-serve" in c for c in calls)
 
 
 def test_run_timeout_retries_then_skips(monkeypatch, capsys):

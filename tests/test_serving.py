@@ -1,0 +1,939 @@
+"""Serving plane (docs/inference.md): the request broker's
+zero-drop/zero-dup contract, continuous batching (flush-on-size vs
+flush-on-deadline, padded-shape bucketing), autoscale policy
+hysteresis, the elastic driver's lossless drain handshake, the signed
+POST /infer / GET /serving routes, the seeded open-loop load
+generator, and the tier-1 smoke: a bursty trace drives queue depth up
+→ a spare replica is admitted via a membership epoch → traffic falls →
+the world shrinks back, with zero dropped or duplicated requests
+across both transitions."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import metrics as metrics_mod
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.metrics.registry import latency_buckets_from_env
+from horovod_tpu.run.http_client import get_serving, post_infer
+from horovod_tpu.run.http_server import (
+    DRAIN_ACK_PREFIX,
+    DRAIN_PREFIX,
+    MEMBERSHIP_SCOPE,
+    RendezvousServer,
+)
+from horovod_tpu.serving import (
+    AutoscalePolicy,
+    BatchBucketer,
+    ContinuousBatcher,
+    InferenceReplica,
+    OpenLoopLoadGenerator,
+    QueueFullError,
+    RemoteSource,
+    RequestBroker,
+    ServingFrontend,
+    bucket_sizes_from_env,
+    bursty_arrivals,
+    compress_params,
+    decompress_params,
+    percentile,
+    poisson_arrivals,
+    summarize,
+)
+from horovod_tpu.serving.autoscaler import ServingAutoscaler
+from horovod_tpu.serving.plane import LocalServingPlane
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _double(params, x):
+    return x * 2.0
+
+
+# -- histogram bucket satellite ----------------------------------------------
+def test_default_latency_bucket_edges_pinned():
+    """The default scheme: 100 µs floor, ×2, 18 buckets — exact."""
+    from horovod_tpu.metrics.registry import LATENCY_BUCKETS
+
+    assert LATENCY_BUCKETS == tuple(1e-4 * 2.0 ** i for i in range(18))
+
+
+def test_latency_buckets_env_override(monkeypatch):
+    monkeypatch.setenv("HVD_METRICS_BUCKET_FLOOR", "0.001")
+    monkeypatch.setenv("HVD_METRICS_BUCKET_FACTOR", "4")
+    monkeypatch.setenv("HVD_METRICS_BUCKET_COUNT", "5")
+    assert latency_buckets_from_env() == tuple(
+        1e-3 * 4.0 ** i for i in range(5))
+
+
+def test_serve_bucket_floor_pinned_and_overridable(monkeypatch):
+    """Serving latencies are sub-ms..seconds: their scheme starts at
+    0.25 ms (not the dispatch plane's 100 µs) and the floor moves
+    independently via HVD_SERVE_LATENCY_BUCKET_FLOOR."""
+    assert metrics_mod.SERVE_LATENCY_BUCKETS == tuple(
+        2.5e-4 * 2.0 ** i for i in range(18))
+    assert metrics_mod.SERVE_LATENCY.buckets == \
+        metrics_mod.SERVE_LATENCY_BUCKETS
+    monkeypatch.setenv("HVD_SERVE_LATENCY_BUCKET_FLOOR", "0.002")
+    got = latency_buckets_from_env("HVD_SERVE_LATENCY_BUCKET_FLOOR",
+                                   2.5e-4)
+    assert got[0] == pytest.approx(0.002) and len(got) == 18
+
+
+# -- broker ------------------------------------------------------------------
+def test_broker_submit_pull_complete_roundtrip():
+    b = RequestBroker()
+    req = b.submit(np.arange(3.0))
+    assert b.queue_depth() == 1
+    (pulled,) = b.pull("r0", max_n=4, wait_s=0.5)
+    assert pulled is req and b.queue_depth() == 0
+    assert b.inflight_count("r0") == 1
+    assert b.complete(pulled, np.arange(3.0) * 2, "r0")
+    out = b.wait(req, timeout=1.0)
+    assert np.allclose(out, [0, 2, 4])
+    assert req.completed_by == "r0" and req.latency_s() > 0
+    assert b.submitted == b.completed == 1 and b.duplicates == 0
+
+
+def test_broker_duplicate_completion_counted_and_ignored():
+    b = RequestBroker()
+    req = b.submit(np.zeros(1))
+    b.pull("r0", 1, 0.1)
+    assert b.complete(req, np.ones(1), "r0")
+    assert not b.complete(req, np.full(1, 9.0), "r1")  # late duplicate
+    assert np.allclose(b.wait(req, 1.0), 1.0)  # first answer wins
+    assert b.duplicates == 1 and b.completed == 1
+
+
+def test_broker_queue_limit_rejects():
+    b = RequestBroker(queue_limit=2)
+    b.submit(np.zeros(1))
+    b.submit(np.zeros(1))
+    with pytest.raises(QueueFullError):
+        b.submit(np.zeros(1))
+    assert b.rejected == 1 and b.submitted == 2
+
+
+def test_broker_fail_surfaces_to_waiter():
+    b = RequestBroker()
+    req = b.submit(np.zeros(1))
+    b.pull("r0", 1, 0.1)
+    b.fail(req, "poison batch", "r0")
+    with pytest.raises(RuntimeError, match="poison batch"):
+        b.wait(req, 1.0)
+    assert b.failed == 1
+
+
+def test_broker_wait_timeout():
+    b = RequestBroker()
+    req = b.submit(np.zeros(1))
+    with pytest.raises(TimeoutError):
+        b.wait(req, timeout=0.05)
+
+
+def test_broker_drain_stops_pulls_but_finishes_inflight():
+    b = RequestBroker()
+    r1 = b.submit(np.zeros(1))
+    b.pull("r0", 1, 0.1)
+    b.submit(np.ones(1))  # arrives after the drain begins
+    b.drain_begin("r0")
+    assert b.pull("r0", 4, 0.05) == []  # no new work for a drainer
+    assert not b.wait_drained("r0", timeout=0.05)  # r1 still in flight
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(b.wait_drained("r0", timeout=2.0)))
+    t.start()
+    b.complete(r1, np.zeros(1), "r0")
+    t.join(timeout=3.0)
+    assert done == [True]
+    # the undrained request is still there for a successor
+    (r2,) = b.pull("r1", 1, 0.5)
+    assert np.allclose(r2.inputs, 1.0)
+
+
+def test_broker_requeue_preserves_order_and_counts():
+    b = RequestBroker()
+    reqs = [b.submit(np.full(1, float(i))) for i in range(3)]
+    pulled = b.pull("dead", 2, 0.1)
+    assert [r.id for r in pulled] == [0, 1]
+    assert b.requeue("dead") == 2
+    assert b.requeued == 2 and b.queue_depth() == 3
+    again = b.pull("alive", 3, 0.1)
+    assert [r.id for r in again] == [0, 1, 2]  # front, original order
+    for r in again:
+        b.complete(r, r.inputs, "alive")
+    for r in reqs:
+        b.wait(r, 1.0)
+    assert b.completed == 3 and b.duplicates == 0
+
+
+def test_broker_late_completion_of_requeued_pending_request():
+    """Review fix: replica A's late last-gasp completion of a request
+    that was requeued (sitting in _pending, pulled_by still A) must
+    remove it from the queue — a successor must never pull an
+    already-completed request (which would leak its in-flight entry
+    forever)."""
+    b = RequestBroker()
+    req = b.submit(np.full(1, 1.0))
+    extra = b.submit(np.full(1, 2.0))
+    b.pull("A", 1, 0.1)
+    b.requeue("A")  # req back at the queue front, pulled_by still "A"
+    assert b.complete(req, np.full(1, 10.0), "A")  # late answer lands
+    assert np.allclose(b.wait(req, 1.0), 10.0)
+    # the completed request left the queue: the next pull sees only
+    # the other request, and no replica's in-flight table leaks
+    pulled = b.pull("B", 2, 0.1)
+    assert [r.id for r in pulled] == [extra.id]
+    b.complete(extra, extra.inputs, "B")
+    assert b.inflight_count() == 0 and b.queue_depth() == 0
+    assert b.wait_drained("B", timeout=0.2)
+
+
+def test_broker_fail_returns_true_on_first_resolution():
+    """Review fix: fail() resolves the request — it must report True
+    (the /serving/result accepted count treats errors as delivered)."""
+    b = RequestBroker()
+    req = b.submit(np.zeros(1))
+    b.pull("r0", 1, 0.1)
+    assert b.fail(req, "boom", "r0") is True
+    assert b.fail(req, "boom again", "r1") is False  # duplicate
+    with pytest.raises(RuntimeError):
+        b.wait(req, 1.0)
+
+
+def test_driver_on_remove_hook_requeues_lossy_removals(rdv):
+    """Review fix: the serving wiring hooks driver.on_remove so a
+    lossily-removed replica's in-flight work goes back to the queue;
+    drained removals (which completed theirs) don't requeue."""
+    broker = RequestBroker()
+    drv = ElasticDriver(rdv, ["0", "1", "2"], min_np=1,
+                        controller="xla", drain_timeout=0.2)
+    drv.on_remove = (lambda w, drained:
+                     None if drained else broker.requeue(w))
+    broker.submit(np.zeros(1))
+    broker.pull("1", 1, 0.1)
+    assert drv.remove("1", "worker 1 exited with code 9")  # lossy
+    assert broker.requeued == 1 and broker.queue_depth() == 1
+    # a worker whose in-flight work is already complete has nothing to
+    # requeue even when the hook runs (timed-out drain → lossy path)
+    (req2,) = broker.pull("2", 1, 0.1)
+    broker.complete(req2, req2.inputs, "2")
+    assert drv.remove("2", "scale down", drain=True)
+    assert broker.requeued == 1  # still only the crash requeue
+    drv.shutdown()
+
+
+def test_broker_abandons_timed_out_requests():
+    """Review fix: a request whose waiter timed out is withdrawn — a
+    replica never burns capacity answering it, and a late answer lands
+    as a counted duplicate, not a second 'ok'."""
+    b = RequestBroker()
+    req = b.submit(np.zeros(1))
+    with pytest.raises(TimeoutError):
+        b.wait(req, timeout=0.05)
+    assert b.queue_depth() == 0 and b.abandoned == 1  # withdrawn
+    assert b.pull("r0", 1, 0.05) == []  # nothing left to serve
+    # an in-flight request abandoned mid-compute: late answer = dup
+    req2 = b.submit(np.ones(1))
+    b.pull("r0", 1, 0.1)
+    with pytest.raises(TimeoutError):
+        b.wait(req2, timeout=0.05)
+    assert b.inflight_count("r0") == 0 and b.abandoned == 2
+    assert not b.complete(req2, np.ones(1), "r0")
+    assert b.duplicates == 1 and b.completed == 0
+
+
+def test_supervise_removed_worker_clean_exit_is_not_job_winddown(rdv):
+    """Review fix: a worker the autoscaler removed from the world
+    exiting 0 must not read as end-of-training — that would freeze
+    admissions/autoscaling after the first serving scale-down."""
+
+    class _Proc:
+        def __init__(self, codes):
+            self._codes = list(codes)
+
+        def poll(self):
+            return self._codes.pop(0) if len(self._codes) > 1 \
+                else self._codes[0]
+
+    class _Job:
+        def __init__(self, procs):
+            self.procs = procs
+
+        def kill_all(self):
+            pass
+
+    drv = ElasticDriver(rdv, ["0", "1"], min_np=1, controller="xla")
+    assert drv.remove("1", "autoscale shrink", drain=False)
+    job = _Job([_Proc([None, None, 0]), _Proc([0])])  # "1" exits first
+    assert drv.supervise(job, poll_interval=0.01) == 0
+    assert "1" not in drv.finished  # removed-then-exited: not winddown
+    assert "0" in drv.finished      # a member exiting 0 still is
+    drv.shutdown()
+
+
+def test_percentile_nearest_rank_pins():
+    vals = [float(i) for i in range(1, 101)]
+    assert percentile(vals, 50.0) == 50.0
+    assert percentile(vals, 99.0) == 99.0
+    assert percentile(vals, 100.0) == 100.0
+    assert percentile([7.0], 99.0) == 7.0
+    assert percentile([], 50.0) is None
+
+
+# -- continuous batching -----------------------------------------------------
+def test_batcher_flush_on_size():
+    ready = [list(range(10))]
+
+    def pull(n, wait_s):
+        out, ready[0] = ready[0][:n], ready[0][n:]
+        return out
+
+    b = ContinuousBatcher(pull, max_batch=4, max_wait_ms=1000.0)
+    assert b.next_batch() == [0, 1, 2, 3]
+    assert b.next_batch() == [4, 5, 6, 7]
+    assert b.batches == 2
+
+
+def test_batcher_flush_on_deadline_with_scripted_clock():
+    clock = [0.0]
+    feeds = [[0], [], [1]]  # the third item arrives past the deadline
+
+    def pull(n, wait_s):
+        clock[0] += 0.03
+        return feeds.pop(0) if feeds else []
+
+    b = ContinuousBatcher(pull, max_batch=4, max_wait_ms=50.0,
+                          clock=lambda: clock[0])
+    assert b.next_batch() == [0]  # deadline flushed a partial batch
+    assert b.next_batch() == [1]
+
+
+def test_batcher_deterministic_under_seeded_trace():
+    """Same scripted arrival tape → identical batch partition."""
+
+    def run_once():
+        rng = np.random.RandomState(5)
+        tape = list(rng.poisson(2.0, size=20))  # arrivals per poll
+        pending = []
+        i = [0]
+
+        def pull(n, wait_s):
+            if not pending and tape:
+                for _ in range(tape.pop(0)):
+                    pending.append(i[0])
+                    i[0] += 1
+            out, pending[:] = pending[:n], pending[n:]
+            return out
+
+        clock = [0.0]
+
+        def tick():
+            clock[0] += 0.001
+            return clock[0]
+
+        b = ContinuousBatcher(pull, max_batch=4, max_wait_ms=2.0,
+                              clock=tick)
+        batches = []
+        for _ in range(40):
+            batch = b.next_batch(idle_wait_s=0.0)
+            if batch:
+                batches.append(batch)
+        return batches
+
+    assert run_once() == run_once()
+
+
+def test_bucketer_pins_and_padding():
+    bk = BatchBucketer((1, 2, 4, 8))
+    assert [bk.bucket(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError, match="exceeds the bucket ladder"):
+        bk.bucket(9)  # no rung to land in — never silently mis-pad
+    padded, n = bk.pad(np.ones((3, 5), dtype=np.float32))
+    assert padded.shape == (4, 5) and n == 3
+    assert not padded[3].any()
+    same, n = bk.pad(np.ones((4, 5), dtype=np.float32))
+    assert same.shape == (4, 5) and n == 4
+
+
+def test_bucket_sizes_from_env(monkeypatch):
+    assert bucket_sizes_from_env(8) == (1, 2, 4, 8)
+    assert bucket_sizes_from_env(6) == (1, 2, 4, 6)
+    monkeypatch.setenv("HVD_SERVE_BUCKET_SIZES", "2, 8,4")
+    assert bucket_sizes_from_env(8) == (2, 4, 8)
+
+
+def test_replica_caps_batcher_at_ladder_top(monkeypatch):
+    """Review fix: a ladder whose top rung is below HVD_SERVE_MAX_BATCH
+    must cap the batcher — an oversize batch has no padded shape and
+    would fail wholesale."""
+    b = RequestBroker()
+    rep = InferenceReplica(b, _double, None, replica_id="0",
+                           max_batch=8, bucket_sizes=(1, 2, 4),
+                           jit=False)
+    assert rep.batcher.max_batch == 4
+    rep.start()
+    try:
+        outs = [b.submit_and_wait(np.full((2,), float(i)), timeout=5.0)
+                for i in range(6)]
+        for i, o in enumerate(outs):
+            assert np.allclose(o, 2.0 * i)
+    finally:
+        rep.stop()
+
+
+# -- replica -----------------------------------------------------------------
+def test_replica_serves_and_bounds_recompiles():
+    b = RequestBroker()
+    rep = InferenceReplica(b, _double, None, replica_id="0",
+                           max_batch=4, max_wait_ms=2.0,
+                           bucket_sizes=(1, 2, 4), jit=False).start()
+    try:
+        outs = [b.submit_and_wait(np.full((3,), float(i)), timeout=5.0)
+                for i in range(10)]
+        for i, o in enumerate(outs):
+            assert np.allclose(o, 2.0 * i) and o.shape == (3,)
+        assert rep.recompiles <= 3  # bounded by the bucket ladder
+    finally:
+        rep.stop()
+
+
+def test_replica_jitted_mlp_checkpoint_roundtrip(tmp_path):
+    """Checkpoint → load_params → jitted replica: served logits match
+    a direct forward."""
+    from horovod_tpu.serving.plane import make_mlp_serving_fn
+    from horovod_tpu.serving.replica import load_params
+    from horovod_tpu.utils.checkpoint import save_checkpoint
+
+    apply_fn, variables, sample = make_mlp_serving_fn(in_dim=16, seed=3)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, variables, step=5)
+    restored = load_params(ckpt, variables)
+    b = RequestBroker()
+    rep = InferenceReplica(b, apply_fn, restored, replica_id="0",
+                           max_batch=4, bucket_sizes=(1, 2, 4)).start()
+    try:
+        x = np.random.RandomState(0).randn(16).astype(np.float32)
+        got = b.submit_and_wait(x, timeout=30.0)
+        want = np.asarray(apply_fn(variables, x[None]))[0]
+        assert np.allclose(got, want, atol=1e-5)
+    finally:
+        rep.stop()
+
+
+def test_replica_poison_batch_fails_requests_not_replica():
+    def sometimes(params, x):
+        if float(x[0, 0]) < 0:
+            raise ValueError("negative marker")
+        return x
+
+    b = RequestBroker()
+    rep = InferenceReplica(b, sometimes, None, replica_id="0",
+                           max_batch=1, jit=False).start()
+    try:
+        with pytest.raises(RuntimeError, match="negative marker"):
+            b.submit_and_wait(np.full((2,), -1.0), timeout=5.0)
+        out = b.submit_and_wait(np.full((2,), 3.0), timeout=5.0)
+        assert np.allclose(out, 3.0)  # the loop survived the poison
+    finally:
+        rep.stop()
+
+
+def test_weight_compression_roundtrip_and_density():
+    from horovod_tpu.serving.plane import make_mlp_serving_fn
+
+    apply_fn, variables, sample = make_mlp_serving_fn(in_dim=16, seed=1)
+    comp, info = compress_params(variables, "int8")
+    assert info["ratio"] > 3.5  # float32 → int8 ≈ 4x at-rest density
+    restored = decompress_params(comp)
+    x = np.random.RandomState(1).randn(1, 16).astype(np.float32)
+    want = np.asarray(apply_fn(variables, x))
+    got = np.asarray(apply_fn(restored, x))
+    # int8 per-tensor quantization: small relative error on a small net
+    assert np.max(np.abs(got - want)) < 0.15 * max(np.max(np.abs(want)),
+                                                   1.0)
+    b = RequestBroker()
+    rep = InferenceReplica(b, apply_fn, variables, replica_id="0",
+                           weight_compression="int8", jit=False,
+                           max_batch=1)
+    assert rep.compression_info["ratio"] > 3.5
+    rep.start()
+    try:
+        served = b.submit_and_wait(x[0], timeout=5.0)
+        assert np.allclose(served, got[0], atol=1e-5)
+    finally:
+        rep.stop()
+
+
+# -- load generator ----------------------------------------------------------
+def test_poisson_arrivals_seeded_and_rate():
+    a1 = poisson_arrivals(100.0, 2.0, seed=42)
+    a2 = poisson_arrivals(100.0, 2.0, seed=42)
+    assert a1 == a2 and a1 == sorted(a1)
+    assert 120 < len(a1) < 280  # ~200 expected, loose bounds
+    assert all(0.0 <= t < 2.0 for t in a1)
+    assert poisson_arrivals(100.0, 2.0, seed=7) != a1
+
+
+def test_bursty_arrivals_phases():
+    arrivals, windows = bursty_arrivals(
+        10.0, 200.0, pre_s=1.0, burst_s=1.0, post_s=1.0, seed=0)
+    assert windows == [(1.0, 2.0)]
+    assert arrivals == sorted(arrivals)
+    in_burst = [t for t in arrivals if 1.0 <= t < 2.0]
+    outside = [t for t in arrivals if not 1.0 <= t < 2.0]
+    assert len(in_burst) > 5 * max(len(outside), 1)
+
+
+def test_summarize_hand_computed():
+    records = (
+        [{"t": 0.1 * i, "latency_ms": 10.0, "ok": True}
+         for i in range(8)]                                  # pre
+        + [{"t": 1.0 + 0.01 * i, "latency_ms": 100.0 + i, "ok": True}
+           for i in range(10)]                               # burst
+        + [{"t": 2.5, "latency_ms": None, "ok": False}]      # timeout
+    )
+    out = summarize(records, slo_ms=105.0, burst_windows=[(1.0, 2.0)])
+    assert out["offered"] == 19 and out["completed"] == 18
+    assert out["p50_ms"] == 100.0  # 18 values: rank 9 → first burst+0
+    assert out["p99_ms"] == 109.0
+    # within SLO: 8 pre + burst 100..105 (6 of 10) = 14 of 19 offered
+    assert out["goodput"] == pytest.approx(14 / 19, abs=1e-4)
+    assert out["goodput_under_burst"] == pytest.approx(6 / 10, abs=1e-4)
+    assert out["burst_offered"] == 10
+
+
+def test_open_loop_records_every_arrival():
+    b = RequestBroker()
+    rep = InferenceReplica(b, _double, None, replica_id="0",
+                           max_batch=4, max_wait_ms=2.0,
+                           jit=False).start()
+    try:
+        arrivals = poisson_arrivals(200.0, 0.3, seed=9)
+        gen = OpenLoopLoadGenerator(
+            b.submit_and_wait, arrivals, lambda i: np.full((2,), i,
+                                                           np.float32),
+            slo_ms=1000.0, timeout_s=10.0)
+        out = gen.run()
+        assert out["offered"] == len(arrivals)
+        assert out["completed"] == len(arrivals)
+        assert out["goodput"] == 1.0
+        assert out["p50_ms"] is not None and out["p99_ms"] is not None
+    finally:
+        rep.stop()
+
+
+# -- autoscale policy --------------------------------------------------------
+def _policy(**kw):
+    kw.setdefault("queue_high", 4)
+    kw.setdefault("queue_low", 0.5)
+    kw.setdefault("slo_ms", 100.0)
+    kw.setdefault("hysteresis_ticks", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 0)
+    return AutoscalePolicy(**kw)
+
+
+def test_policy_grows_on_sustained_queue_depth_only():
+    clock = [0.0]
+    p = _policy(clock=lambda: clock[0])
+    decisions = []
+    for depth in (10, 10, 3, 10, 10, 10):  # the dip resets the run
+        decisions.append(p.decide(queue_depth=depth, p99_ms=None,
+                                  replicas=1, spares=1))
+        clock[0] += 1.0
+    assert decisions == ["hold"] * 5 + ["grow"]
+
+
+def test_policy_grows_on_p99_breach():
+    clock = [0.0]
+    p = _policy(clock=lambda: clock[0])
+    out = None
+    for _ in range(3):
+        out = p.decide(queue_depth=0, p99_ms=250.0, replicas=2,
+                       spares=1)
+        clock[0] += 1.0
+    assert out == "grow"
+
+
+def test_policy_needs_spares_and_respects_max():
+    clock = [0.0]
+    p = _policy(clock=lambda: clock[0])
+    for _ in range(5):
+        assert p.decide(queue_depth=50, p99_ms=None, replicas=1,
+                        spares=0) == "hold"
+        clock[0] += 1.0
+    p2 = _policy(max_replicas=2, clock=lambda: clock[0])
+    for _ in range(5):
+        assert p2.decide(queue_depth=50, p99_ms=None, replicas=2,
+                         spares=3) == "hold"
+        clock[0] += 1.0
+
+
+def test_policy_shrinks_on_idle_but_not_below_floor():
+    clock = [0.0]
+    p = _policy(clock=lambda: clock[0])
+    out = None
+    for _ in range(3):
+        out = p.decide(queue_depth=0, p99_ms=10.0, replicas=3, spares=0)
+        clock[0] += 1.0
+    assert out == "shrink"
+    p.reset()
+    for _ in range(6):
+        assert p.decide(queue_depth=0, p99_ms=10.0, replicas=1,
+                        spares=0) == "hold"
+        clock[0] += 1.0
+
+
+def test_policy_cooldown_damps_flapping():
+    clock = [0.0]
+    p = _policy(hysteresis_ticks=1, cooldown_s=10.0,
+                clock=lambda: clock[0])
+    assert p.decide(queue_depth=50, p99_ms=None, replicas=1,
+                    spares=1) == "grow"
+    # instantly idle — but inside the cooldown nothing moves
+    for _ in range(5):
+        clock[0] += 1.0
+        assert p.decide(queue_depth=0, p99_ms=10.0, replicas=2,
+                        spares=0) == "hold"
+    clock[0] += 10.0
+    assert p.decide(queue_depth=0, p99_ms=10.0, replicas=2,
+                    spares=0) == "shrink"
+
+
+# -- elastic driver: drain handshake + spare hold ----------------------------
+@pytest.fixture()
+def rdv():
+    server = RendezvousServer(secret=None)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_remove_drain_waits_for_ack_then_commits(rdv):
+    drv = ElasticDriver(rdv, ["0", "1"], min_np=1, controller="xla",
+                        drain_timeout=5.0)
+    drains_before = metrics_mod.SERVE_DRAINS.get()
+    seen = {}
+
+    def worker_side():
+        assert _wait_for(lambda: rdv.get(
+            MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}1") is not None)
+        seen["epoch_at_drain"] = drv.epoch  # commit must not have run
+        time.sleep(0.1)  # "finish in flight"
+        rdv.put(MEMBERSHIP_SCOPE, f"{DRAIN_ACK_PREFIX}1",
+                json.dumps({"worker": "1"}).encode())
+
+    t = threading.Thread(target=worker_side)
+    t.start()
+    assert drv.remove("1", "scale down", drain=True)
+    t.join(timeout=5.0)
+    assert seen["epoch_at_drain"] == 0  # ack preceded the shrink commit
+    assert drv.epoch == 1 and drv.world == ["0"]
+    rec = json.loads(rdv.get(MEMBERSHIP_SCOPE, "epoch"))
+    assert "drained: in-flight work completed" in rec["reason"]
+    # handshake keys are cleaned up; the drain is not a flap
+    assert rdv.get(MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}1") is None
+    assert rdv.get(MEMBERSHIP_SCOPE, f"{DRAIN_ACK_PREFIX}1") is None
+    assert drv.flaps.get("1", 0) == 0 and "1" not in drv.blocklist
+    assert metrics_mod.SERVE_DRAINS.get() == drains_before + 1
+    drv.shutdown()
+
+
+def test_remove_drain_timeout_degrades_to_lossy(rdv):
+    drv = ElasticDriver(rdv, ["0", "1"], min_np=1, controller="xla",
+                        drain_timeout=0.2)
+    assert drv.remove("1", "scale down", drain=True)  # nobody acks
+    rec = json.loads(rdv.get(MEMBERSHIP_SCOPE, "epoch"))
+    assert rec["world"] == ["0"]
+    assert "drained: in-flight work completed" not in rec["reason"]
+    assert drv.flaps.get("1", 0) == 0  # a timed-out drain still isn't a flap
+    drv.shutdown()
+
+
+def test_crash_removal_still_counts_flaps(rdv):
+    drv = ElasticDriver(rdv, ["0", "1"], min_np=1, controller="xla")
+    assert drv.remove("1", "worker 1 exited with code 1")
+    assert drv.flaps["1"] == 1
+    drv.shutdown()
+
+
+def test_hold_admissions_collects_spares_for_autoscaler(rdv):
+    drv = ElasticDriver(rdv, ["0"], min_np=1, controller="xla")
+    broker = RequestBroker()
+    scaler = ServingAutoscaler(drv, broker,
+                               AutoscalePolicy(hysteresis_ticks=1,
+                                               cooldown_s=0.0))
+    drv.attach_autoscaler(scaler)
+    # ack the initial epoch so the driver reaches the stable state
+    # where announces are processed (the worker side's job)
+    rdv.put(MEMBERSHIP_SCOPE, "ready.0.0", b"{}")
+    rdv.put(MEMBERSHIP_SCOPE, "announce.9",
+            json.dumps({"worker": "9"}).encode())
+    assert _wait_for(lambda: (drv.poll(), drv.spares == ["9"])[1])
+    assert drv.world == ["0"]  # held, not auto-admitted
+    assert rdv.get(MEMBERSHIP_SCOPE, "announce.9") is None
+    w = drv.admit_spare("test grow")
+    assert w == "9" and drv.world == ["0", "9"] and drv.spares == []
+    drv.shutdown()
+
+
+def test_membership_drain_helpers_over_http(monkeypatch):
+    from horovod_tpu.elastic import membership
+
+    secret = b"serve-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HVD_METRICS_KV_PORT", str(port))
+    monkeypatch.setenv("HVD_METRICS_SECRET", secret.hex())
+    monkeypatch.setenv("HVD_ELASTIC_WORKER_ID", "3")
+    membership._reset_for_tests()
+    try:
+        assert membership.drain_requested() is None
+        server.put(MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}3",
+                   json.dumps({"worker": "3"}).encode())
+        req = membership.drain_requested()
+        assert req is not None and req["worker"] == "3"
+        membership.ack_drain()
+        ack = server.get(MEMBERSHIP_SCOPE, f"{DRAIN_ACK_PREFIX}3")
+        assert ack is not None and json.loads(ack)["worker"] == "3"
+    finally:
+        membership._reset_for_tests()
+        server.stop()
+
+
+# -- HTTP request plane ------------------------------------------------------
+def test_post_infer_and_get_serving_roundtrip():
+    secret = b"infer-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    broker = RequestBroker()
+    server.attach_serving(ServingFrontend(broker, timeout_s=10.0))
+    rep = InferenceReplica(broker, _double, None, replica_id="0",
+                           max_batch=4, max_wait_ms=2.0,
+                           jit=False).start()
+    try:
+        out = post_infer("127.0.0.1", port, [1.0, 2.0], secret=secret)
+        assert out["outputs"] == [2.0, 4.0]
+        assert out["replica"] == "0" and out["latency_ms"] > 0
+        rep2 = get_serving("127.0.0.1", port, secret=secret)
+        assert rep2["broker"]["completed"] == 1
+        assert rep2["broker"]["p50_ms"] is not None
+        assert rep2["slo_ms"] == 100.0 and rep2["autoscaler"] is None
+        # in-process view agrees
+        assert server.serving_report()["broker"]["completed"] == 1
+    finally:
+        rep.stop()
+        server.stop()
+
+
+def test_post_infer_unauthorized_and_unattached():
+    import urllib.error
+
+    secret = b"infer-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    try:
+        # no frontend attached → 503 with a JSON error
+        with pytest.raises(RuntimeError, match="503"):
+            post_infer("127.0.0.1", port, [1.0], secret=secret)
+        server.attach_serving(ServingFrontend(RequestBroker()))
+        with pytest.raises((RuntimeError, urllib.error.HTTPError)):
+            post_infer("127.0.0.1", port, [1.0], secret=b"wrong")
+        # GET /serving without a frontend 404s once detached
+        server.attach_serving(None)
+        assert server.serving_report() is None
+    finally:
+        server.stop()
+
+
+def test_remote_source_replica_over_http():
+    """A replica on 'another host': same loop, HTTP pull/result."""
+    secret = b"remote-secret"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    broker = RequestBroker()
+    server.attach_serving(ServingFrontend(broker))
+    src = RemoteSource("127.0.0.1", port, secret=secret)
+    rep = InferenceReplica(src, _double, None, replica_id="w7",
+                           max_batch=4, max_wait_ms=2.0,
+                           jit=False).start()
+    try:
+        out = broker.submit_and_wait(np.full((2,), 5.0, np.float32),
+                                     timeout=10.0)
+        assert np.allclose(out, 10.0)
+        assert broker.window_stats()["completed"] == 1
+    finally:
+        rep.stop()
+        server.stop()
+
+
+# -- CLI + bench leg ---------------------------------------------------------
+def test_hvd_serve_check_cli():
+    """Tier-1 acceptance: the CLI fixture self-test is green."""
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "hvd_serve.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "zero drops/duplicates" in result.stdout
+
+
+def test_bench_serving_leg_child():
+    """bench.py --child-serve prints the serving RESULT line with the
+    JSON-tail fields (serve_p50_ms / serve_p99_ms /
+    goodput_under_burst)."""
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--child-serve"],
+        capture_output=True, text=True, timeout=170,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    lines = [ln for ln in result.stdout.splitlines()
+             if ln.startswith("RESULT ")]
+    assert lines, result.stdout
+    payload = json.loads(lines[-1][len("RESULT "):])
+    assert payload["serve_p50_ms"] is not None
+    assert payload["serve_p99_ms"] is not None
+    assert payload["serve_p99_ms"] >= payload["serve_p50_ms"]
+    assert 0.0 <= payload["goodput_under_burst"] <= 1.0
+    assert payload["serve_offered"] == payload["serve_completed"]
+
+
+def test_tpurun_serve_flags_map_to_env():
+    from horovod_tpu.run.config_parser import env_from_args
+    from horovod_tpu.run.run import parse_args
+
+    args = parse_args(["--serve", "--serve-max-batch", "16",
+                       "--serve-max-wait-ms", "7.5", "--serve-slo-ms",
+                       "50", "--serve-autoscale", "--elastic",
+                       "python", "x.py"])
+    env = env_from_args(args)
+    assert env["HVD_SERVE"] == "1"
+    assert env["HVD_SERVE_MAX_BATCH"] == "16"
+    assert env["HVD_SERVE_MAX_WAIT_MS"] == "7.5"
+    assert env["HVD_SERVE_SLO_MS"] == "50.0"
+    assert env["HVD_SERVE_AUTOSCALE"] == "1"
+
+
+# -- the tier-1 smoke --------------------------------------------------------
+def test_smoke_burst_grows_then_shrinks_with_zero_loss():
+    """ISSUE 12 acceptance: a seeded bursty open-loop trace drives
+    queue depth up → the autoscaler admits the held spare via a
+    membership epoch → traffic falls → the world shrinks back through
+    the lossless drain handshake — zero dropped or duplicated requests
+    across both epoch transitions, p50/p99 reported from the run."""
+
+    def slow_forward(params, x):
+        time.sleep(0.02 * x.shape[0])  # 20 ms per item: ~50 items/s
+        return x * 2.0
+
+    policy = AutoscalePolicy(queue_high=4, queue_low=0.5, slo_ms=5000.0,
+                             hysteresis_ticks=2, cooldown_s=1.5,
+                             min_replicas=1, max_replicas=0)
+    plane = LocalServingPlane(slow_forward, None, replicas=1,
+                              spare_workers=("1",), elastic=True,
+                              policy=policy, max_batch=4,
+                              max_wait_ms=4.0, jit=False,
+                              drain_timeout_s=15.0,
+                              pump_interval=0.05).start()
+    try:
+        arrivals, windows = bursty_arrivals(
+            10.0, 90.0, pre_s=0.8, burst_s=1.2, post_s=1.5, seed=3)
+        gen = OpenLoopLoadGenerator(
+            plane.submit_and_wait, arrivals,
+            lambda i: np.full((2,), float(i), np.float32),
+            slo_ms=5000.0, timeout_s=60.0)
+        summary = gen.run(windows)
+
+        # traffic fell → the world must shrink back to the core fleet
+        assert _wait_for(lambda: plane.driver.world == ["0"]
+                         and plane.driver.epoch >= 2, timeout=20.0), (
+            plane.driver.world, plane.driver.epoch,
+            plane.autoscaler.events)
+
+        # both transitions happened, in order, via membership epochs
+        directions = [d for d, _w, _e in plane.autoscaler.events]
+        assert directions[0] == "grow" and "shrink" in directions
+        grew = [w for e, w in sorted(
+            (e, w) for d, w, e in plane.autoscaler.events
+            if d == "grow")]
+        assert grew[0] == "1"
+        assert any(w == ["0", "1"] for w in plane.epochs_seen.values())
+        assert plane.epochs_seen[max(plane.epochs_seen)] == ["0"]
+
+        # zero dropped, zero duplicated — the whole point
+        assert summary["offered"] == len(arrivals)
+        assert summary["completed"] == summary["offered"], summary
+        stats = plane.broker.window_stats()
+        assert stats["submitted"] == stats["completed"] == len(arrivals)
+        assert stats["duplicates"] == 0 and stats["requeued"] == 0
+        assert stats["failed"] == 0 and stats["rejected"] == 0
+
+        # every answer is the right answer (no cross-request mixups)
+        for rec in gen.records:
+            assert rec["ok"], rec
+
+        # p50/p99 reported from the run
+        assert summary["p50_ms"] is not None
+        assert summary["p99_ms"] >= summary["p50_ms"]
+        assert summary["goodput_under_burst"] is not None
+
+        # the shrink was a drained (lossless) removal
+        shrink_epochs = [e for d, _w, e in plane.autoscaler.events
+                         if d == "shrink"]
+        assert shrink_epochs, plane.autoscaler.events
+    finally:
+        plane.shutdown()
+
+
+def test_plane_replica_death_requeues_and_recovers():
+    """Unclean replica death mid-flight: the broker requeues, a
+    survivor answers, nothing is lost (the crash-vs-drain contrast)."""
+    b = RequestBroker()
+    blocker = threading.Event()
+
+    def stall(params, x):
+        blocker.wait(5.0)
+        return x * 2.0
+
+    dead = InferenceReplica(b, stall, None, replica_id="dead",
+                            max_batch=1, jit=False).start()
+    req = b.submit(np.full((2,), 4.0, np.float32))
+    assert _wait_for(lambda: b.inflight_count("dead") == 1)
+    # kill it uncleanly: stop the loop, requeue its in-flight work
+    dead._stop_flag.set()  # noqa: SLF001
+    b.requeue("dead")
+    alive = InferenceReplica(b, _double, None, replica_id="alive",
+                             max_batch=1, jit=False).start()
+    try:
+        out = b.wait(req, timeout=10.0)
+        assert np.allclose(out, 8.0)
+        assert b.requeued == 1 and b.completed == 1
+        blocker.set()
+        time.sleep(0.05)  # let the dead replica's late answer land
+        assert b.completed == 1  # exactly-once held
+    finally:
+        blocker.set()
+        dead.stop()
+        alive.stop()
