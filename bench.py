@@ -63,6 +63,7 @@ RUN_TIMEOUT_S = 560        # compile (~40 s) + 3 measured iters, generous
 AUTOTUNE_TIMEOUT_S = 420   # autotuned comparison run (re-jits a few times)
 COMPRESSION_TIMEOUT_S = 420  # compressed comparison run (one compile)
 SERVE_TIMEOUT_S = 180      # serving fixture: a few MLP compiles + ~1.5 s trace
+PROJECTION_TIMEOUT_S = 240  # digital-twin leg: two traced MLP drives (1 + 8 dev)
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -176,6 +177,53 @@ def _measure_serving() -> None:
         "serve_offered": out["offered"],
         "serve_completed": out["completed"],
     }))
+
+
+def _measure_projection() -> None:
+    """Child-process entry for the digital-twin accuracy leg: drive the
+    1-device → 8-device CPU-mesh validation (timeline/replay/projection
+    live_validation, docs/projection.md) and report the twin's
+    projected-vs-measured step-time error.  Like the serving leg this
+    benchmarks a host-side plane, not the chip, so it runs on the CPU
+    mesh regardless of TPU availability — the twin's ACCURACY is the
+    tracked number, the same way autotune_delta_pct tracks the tuner."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from horovod_tpu.timeline.replay.projection import live_validation
+
+    out = live_validation()
+    print("RESULT " + json.dumps({
+        "projection_err_pct": out["err_pct"],
+        "projected_step_us": out["projected_step_us"],
+        "measured_step_us": out["measured_step_us"],
+    }))
+
+
+def _projection_leg() -> dict:
+    """The projection-accuracy tail field, from a separately-timed child
+    so a hung or failed twin drive can never cost the main number
+    (HVD_BENCH_PROJECTION=0 skips).  ``projection_err_pct`` is null on
+    any failure — same contract as the autotune/compression legs."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_PROJECTION, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-projection",
+                                     PROJECTION_TIMEOUT_S)
+        if payload is not None:
+            return {"projection_err_pct": payload.get("projection_err_pct")}
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"projection_err_pct": None, "projection_error": reason}
 
 
 def _serving_leg() -> dict:
@@ -334,6 +382,9 @@ def main() -> None:
             # serving tail (HVD_BENCH_SERVE=0 skips): p50/p99 request
             # latency + goodput-under-burst of the serving plane fixture
             out.update(_serving_leg())
+            # digital-twin tail (HVD_BENCH_PROJECTION=0 skips): the
+            # projection engine's accuracy on the world being benched
+            out.update(_projection_leg())
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -359,6 +410,8 @@ if __name__ == "__main__":
         _measure_compressed()
     elif "--child-serve" in sys.argv:
         _measure_serving()
+    elif "--child-projection" in sys.argv:
+        _measure_projection()
     elif "--child" in sys.argv:
         _measure()
     else:

@@ -69,6 +69,12 @@ hvd_serve_autoscale_events_total counter   autoscale actions, by ``direction``
 hvd_serve_drains_total          counter    lossless drain handshakes done
 hvd_serve_requeues_total        counter    in-flight requests requeued after
                                            a replica died uncleanly
+hvd_projection_step_us          gauge      digital-twin projected step time,
+                                           by target ``world``
+hvd_projection_efficiency       gauge      projected scaling efficiency vs
+                                           the source replay baseline
+hvd_projection_err_pct          gauge      tracked projected-vs-measured
+                                           step-time error of the twin
 ==============================  =========  ==================================
 """
 
@@ -283,6 +289,21 @@ SERVE_REQUEUES = registry.counter(
     "hvd_serve_requeues_total",
     "In-flight requests returned to the queue after a replica died "
     "without completing them.")
+
+PROJECTION_STEP_US = registry.gauge(
+    "hvd_projection_step_us",
+    "Digital-twin projected step time in µs for one target topology "
+    "(timeline/replay/projection.py; labeled by target world size).",
+    ("world",))
+PROJECTION_EFFICIENCY = registry.gauge(
+    "hvd_projection_efficiency",
+    "Projected scaling efficiency (source replay baseline over projected "
+    "step) for one target topology, by target world size.", ("world",))
+PROJECTION_ERR_PCT = registry.gauge(
+    "hvd_projection_err_pct",
+    "Projected-vs-measured step-time error of the digital twin on a "
+    "world that was actually run (the twin's tracked accuracy — "
+    "docs/projection.md validation contract).")
 
 COMPRESSION_RESIDUAL_NORM = registry.gauge(
     "hvd_compression_residual_norm",

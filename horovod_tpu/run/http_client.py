@@ -195,6 +195,37 @@ def put_replay_summary(addr: str, port: int, summary: dict,
            json.dumps(summary).encode(), secret=secret)
 
 
+def put_projection_summary(addr: str, port: int, summary: dict,
+                           secret: Optional[bytes] = None) -> None:
+    """Publish a digital-twin projection summary (``hvd_replay
+    --project`` output, docs/projection.md) so ``GET /projection`` on
+    the rendezvous server serves it.  Single writer, last-writer-wins →
+    safe to retry."""
+    import json
+
+    put_kv(addr, port, "projection", "summary",
+           json.dumps(summary).encode(), secret=secret, retry=True)
+
+
+def get_projection(addr: str, port: int,
+                   secret: Optional[bytes] = None,
+                   timeout: float = 10.0) -> Optional[dict]:
+    """The latest topology-projected summary from ``GET /projection``
+    (None if nothing has been published yet): per-target projected step
+    time / efficiency / wire formats plus the tracked
+    projected-vs-measured accuracy record."""
+    import json
+
+    try:
+        with _request("GET", addr, port, "/projection", secret=secret,
+                      timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
 def put_autotune_plan(addr: str, port: int, seq: int, record: dict,
                       secret: Optional[bytes] = None) -> None:
     """Publish one profile-guided plan record (applied / verified /

@@ -62,6 +62,14 @@ _SANITIZER_PREFIX = f"/{SANITIZER_SCOPE}/"
 REPLAY_SCOPE = "replay"
 REPLAY_SUMMARY_KEY = "summary"
 
+# digital-twin projection (timeline/replay/projection.py,
+# docs/projection.md): `hvd_replay --project --push` publishes the
+# topology-projected summary (per-target step time / efficiency / wire
+# formats + the tracked projected-vs-measured accuracy record) here;
+# GET /projection serves the latest one.
+PROJECTION_SCOPE = "projection"
+PROJECTION_SUMMARY_KEY = "summary"
+
 # compute-anatomy profiler (timeline/profiler.py): each rank pushes its
 # window anatomy under profile/<rank> at finalize; GET /profile renders
 # the per-rank anatomies plus the cross-rank aggregate (per-segment
@@ -409,6 +417,15 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             else:
                 self._reply(200, val, content_type="application/json")
             return
+        if path == "/projection":
+            with self.server.lock:  # type: ignore
+                val = self.server.store.get(  # type: ignore
+                    f"/{PROJECTION_SCOPE}/{PROJECTION_SUMMARY_KEY}")
+            if val is None:
+                self._reply(404)
+            else:
+                self._reply(200, val, content_type="application/json")
+            return
         if path == "/autotune":
             with self.server.lock:  # type: ignore
                 store = dict(self.server.store)  # type: ignore
@@ -593,6 +610,17 @@ class RendezvousServer:
         with self._httpd.lock:  # type: ignore[attr-defined]
             return build_profile_report(
                 dict(self._httpd.store))  # type: ignore[attr-defined]
+
+    def projection_report(self) -> Optional[Dict[str, object]]:
+        """In-process equivalent of GET /projection (None when no
+        projection summary has been pushed)."""
+        raw = self.get(PROJECTION_SCOPE, PROJECTION_SUMMARY_KEY)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (ValueError, TypeError):
+            return {"error": "<undecodable projection summary>"}
 
     def attach_serving(self, frontend) -> None:
         """Attach a serving front-end (serving/frontend.py): POST

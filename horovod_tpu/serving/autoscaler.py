@@ -20,6 +20,13 @@ scale with *load* instead of failures (docs/inference.md "Autoscaling"):
   (``driver.admit_spare``), shrink runs the lossless drain handshake
   (``driver.remove(..., drain=True)``) so no in-flight request is
   dropped across the transition.
+* The digital twin's serving hook
+  (:func:`~horovod_tpu.timeline.replay.projection.serving_slo_headroom`,
+  docs/projection.md) prices a capacity change BEFORE it is taken: a
+  shrink whose projected p99 at one fewer replica would breach the SLO
+  is held (the predictive guard, ``HVD_PROJECT_SLO_GUARD=0`` disables),
+  and the per-direction projected headroom is surfaced on
+  ``GET /serving``.
 """
 
 from __future__ import annotations
@@ -144,11 +151,24 @@ class ServingAutoscaler:
     the highest-ranked worker, and never rank 0."""
 
     def __init__(self, driver, broker, policy: Optional[AutoscalePolicy]
-                 = None, *, pick_victim: Optional[Callable] = None) -> None:
+                 = None, *, pick_victim: Optional[Callable] = None,
+                 headroom_fn: Optional[Callable] = None) -> None:
         self.driver = driver
         self.broker = broker
         self.policy = policy or AutoscalePolicy()
         self.pick_victim = pick_victim or self._default_victim
+        # SLO-headroom hook (the digital twin's serving projection,
+        # utils/slo.py — dependency-free math, no replay-stack import
+        # on the serving path): projected slo − p99 after a replica
+        # delta; injectable for tests
+        if headroom_fn is None:
+            from ..utils.slo import serving_slo_headroom
+
+            headroom_fn = serving_slo_headroom
+        self.headroom_fn = headroom_fn
+        self.slo_guard = env_util.get_bool(
+            env_util.HVD_PROJECT_SLO_GUARD, True)
+        self._last_headroom: dict = {}
         self.events = []  # (direction, worker, epoch) history
 
     @staticmethod
@@ -165,9 +185,24 @@ class ServingAutoscaler:
         on stable epochs).  Returns the decision taken."""
         stats = self.broker.window_stats()
         self._export_gauges(stats)
+        replicas = len(self.driver.world)
+        self._last_headroom = self._headroom(stats, replicas)
         decision = self.policy.decide(
             queue_depth=stats["queue_depth"], p99_ms=stats["p99_ms"],
-            replicas=len(self.driver.world), spares=len(self.driver.spares))
+            replicas=replicas, spares=len(self.driver.spares))
+        if decision == "shrink" and self.slo_guard:
+            # predictive guard: don't take a shrink the twin already
+            # prices as an SLO breach — the hysteresis counters would
+            # only discover it after real requests paid for it
+            headroom = self._last_headroom.get("shrink_ms")
+            if headroom is not None and headroom < 0:
+                log.warning(
+                    "autoscale shrink held: projected p99 at %d replicas "
+                    "breaches the %.1f ms SLO by %.1f ms "
+                    "(HVD_PROJECT_SLO_GUARD=0 disables)",
+                    replicas - 1, self.policy.slo_ms, -headroom)
+                self.policy.cancel_last_action()
+                return "hold"
         if decision == "grow":
             worker = self.driver.admit_spare(
                 reason=f"autoscale grow: queue_depth="
@@ -194,6 +229,19 @@ class ServingAutoscaler:
                 return "hold"
             self._record_event("shrink", worker)
         return decision
+
+    def _headroom(self, stats: dict, replicas: int) -> dict:
+        """Projected SLO headroom (ms) per replica delta — None entries
+        when the window carries no latency data or the hook fails (the
+        twin must never take down the autoscaler)."""
+        out = {}
+        for key, delta in (("grow_ms", 1), ("shrink_ms", -1)):
+            try:
+                out[key] = self.headroom_fn(stats, replicas,
+                                            self.policy.slo_ms, delta)
+            except Exception:  # noqa: BLE001
+                out[key] = None
+        return out
 
     def _record_event(self, direction: str, worker: str) -> None:
         self.events.append((direction, worker, self.driver.epoch))
@@ -237,4 +285,8 @@ class ServingAutoscaler:
                 "max_replicas": p.max_replicas,
             },
             "in_cooldown": p.in_cooldown(),
+            # projected slo − p99 per replica delta (docs/projection.md):
+            # what the last tick's window said a grow/shrink would buy
+            "slo_headroom_ms": dict(self._last_headroom),
+            "slo_guard": self.slo_guard,
         }

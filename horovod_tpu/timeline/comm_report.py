@@ -20,8 +20,9 @@ Usage::
 
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -176,6 +177,146 @@ COMPRESSION_MODEL = {
 DEFAULT_DCN_BYTES_PER_SEC = 25e9
 DEFAULT_DCN_HOP_LATENCY = 10e-6
 
+#: modeled ICI link (v5e: ~186 GB/s per direction, ~1 µs per neighbor
+#: hop) — the ONE place these constants live: the replay CostModel, the
+#: SCALING.md tables, and the projection engine all read them from here
+DEFAULT_ICI_BYTES_PER_SEC = 186e9
+DEFAULT_ICI_HOP_LATENCY = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpec:
+    """One communication topology — real or hypothetical — as the cost
+    model sees it: world size, the ICI/DCN tier split (``local_size``
+    ranks share an ICI domain; ``cross_size`` domains meet over DCN),
+    per-tier α–β parameters, and the wire-format policy (compression /
+    two-level) the runtime would run with.
+
+    This is the single source of topology assumptions: the SCALING.md
+    efficiency tables (:func:`model_scaling` / :func:`collective_report`),
+    the replay what-ifs (timeline/replay/simulator.py ``CostModel``), and
+    the digital-twin projection engine (timeline/replay/projection.py,
+    ``hvd_replay --project``) all price collectives through a spec, so a
+    docs table and a projection can never disagree on α–β/tier numbers.
+
+    ``two_level`` policy: ``"off"`` always prices the flat ring,
+    ``"on"`` prices the hierarchical shape whenever the topology
+    decomposes (degrading to flat exactly like the runtime), ``"auto"``
+    picks whichever the model says is cheaper — the choice a planner
+    would make.  ``flat_fabric`` picks the link the FLAT ring runs at:
+    ``"auto"`` uses DCN whenever the spec spans hosts (a flat ring runs
+    at its slowest link), ``"ici"`` pins the legacy single-torus
+    assumption the SCALING.md base tables are built on."""
+
+    world: int
+    local_size: int = 1
+    ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC
+    ici_hop_latency_us: float = DEFAULT_ICI_HOP_LATENCY * 1e6
+    dcn_bytes_per_sec: float = DEFAULT_DCN_BYTES_PER_SEC
+    dcn_hop_latency_us: float = DEFAULT_DCN_HOP_LATENCY * 1e6
+    compression: Optional[str] = None
+    two_level: str = "off"              # "off" | "on" | "auto"
+    flat_fabric: str = "auto"           # "auto" | "ici"
+
+    @property
+    def cross_size(self) -> int:
+        """ICI domains meeting over DCN (1 when the spec doesn't
+        decompose — the whole world is one domain)."""
+        if self.local_size > 1 and self.world % self.local_size == 0:
+            return self.world // self.local_size
+        return 1
+
+    def two_level_possible(self) -> bool:
+        """Same decomposability rule the runtime's degrade uses
+        (parallel/hierarchical.py): >1 rank per ICI domain AND >1
+        domain."""
+        return (self.local_size > 1 and self.world % self.local_size == 0
+                and self.world // self.local_size > 1)
+
+    def spans_dcn(self) -> bool:
+        """True when the spec declares more than one host group — the
+        flat ring would cross DCN links."""
+        return self.cross_size > 1
+
+    def with_world(self, world: int) -> "TopologySpec":
+        return dataclasses.replace(self, world=int(world))
+
+    def _flat_params(self) -> Tuple[float, float]:
+        """(bytes_per_sec, hop_latency_seconds) the FLAT ring runs at."""
+        if self.flat_fabric != "ici" and self.spans_dcn():
+            return self.dcn_bytes_per_sec, self.dcn_hop_latency_us * 1e-6
+        return self.ici_bytes_per_sec, self.ici_hop_latency_us * 1e-6
+
+    def _flat_us(self, op: str, nbytes: int, *, calls: int = 1,
+                 compression: Optional[str] = None,
+                 orig_itemsize: int = 4) -> float:
+        bw, hop = self._flat_params()
+        return predict_collective_us(
+            op, nbytes, self.world, calls=calls,
+            ici_bytes_per_sec=bw, ici_hop_latency=hop,
+            compression=compression, orig_itemsize=orig_itemsize)
+
+    def _two_level_us(self, op: str, nbytes: int, *, calls: int = 1,
+                      compression: Optional[str] = None,
+                      orig_itemsize: int = 4) -> float:
+        return predict_collective_us(
+            op, nbytes, self.world, calls=calls,
+            ici_bytes_per_sec=self.ici_bytes_per_sec,
+            ici_hop_latency=self.ici_hop_latency_us * 1e-6,
+            compression=compression, orig_itemsize=orig_itemsize,
+            two_level=True, local_size=self.local_size,
+            dcn_bytes_per_sec=self.dcn_bytes_per_sec,
+            dcn_hop_latency=self.dcn_hop_latency_us * 1e-6)
+
+    def wire_choice(self, op: str, nbytes: int, *, calls: int = 1,
+                    compression: Optional[str] = None,
+                    orig_itemsize: int = 4) -> Tuple[str, float]:
+        """``(wire_format, predicted_us)`` under this spec's policy —
+        the decision the projection engine reports per collective.
+        ``wire_format`` is ``"flat"`` or ``"two_level"``, suffixed with
+        ``+<compression>`` when a wire format compresses."""
+        flat = self._flat_us(op, nbytes, calls=calls,
+                             compression=compression,
+                             orig_itemsize=orig_itemsize)
+        can_two = (op == "all-reduce" and self.two_level != "off"
+                   and self.two_level_possible())
+        if can_two:
+            two = self._two_level_us(op, nbytes, calls=calls,
+                                     compression=compression,
+                                     orig_itemsize=orig_itemsize)
+            if self.two_level == "on" or two < flat:
+                return self._tag("two_level", compression), two
+        return self._tag("flat", compression), flat
+
+    @staticmethod
+    def _tag(base: str, compression: Optional[str]) -> str:
+        return f"{base}+{compression}" if compression else base
+
+    def predict_us(self, op: str, nbytes: int, *, calls: int = 1,
+                   compression: Optional[str] = "__spec__",
+                   orig_itemsize: int = 4) -> float:
+        """α–β cost of ``op`` under this spec's wire policy (the
+        ``wire_choice`` price; ``compression`` defaults to the spec's
+        own, pass ``None`` to force uncompressed)."""
+        comp = self.compression if compression == "__spec__" else compression
+        return self.wire_choice(op, nbytes, calls=calls, compression=comp,
+                                orig_itemsize=orig_itemsize)[1]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["cross_size"] = self.cross_size
+        return d
+
+    def describe(self) -> str:
+        s = f"world={self.world}"
+        if self.local_size > 1:
+            s += f" local={self.local_size}x{self.cross_size}"
+        if self.two_level != "off":
+            s += f" two_level={self.two_level}"
+        if self.compression:
+            s += f" compression={self.compression}"
+        return s
+
 
 def _compression_spec(compression):
     if not compression or str(compression).lower() in ("none", "ef_none"):
@@ -214,14 +355,35 @@ def compression_scale_exchange(compression) -> bool:
     return bool(spec and spec["scale_exchange"])
 
 
+def compression_terms_us(compression, nbytes: int, world: int,
+                         hop_latency_us: float,
+                         orig_itemsize: int = 4
+                         ) -> Tuple[float, float, float]:
+    """``(wire_ratio, qd_us, scale_alpha_us)`` — the three compression
+    cost terms every pricing site composes identically (the replay
+    CostModel's calibrated what-ifs and the projection engine; the
+    flat/two-level shapes inside :func:`predict_collective_us` inline
+    the same primitives).  One helper so a cost-curve change (a new
+    quantizer overhead term, a different scale-exchange shape) cannot
+    silently desync the pricing sites."""
+    spec = _compression_spec(compression)
+    if spec is None:
+        return 1.0, 0.0, 0.0
+    ratio = compression_wire_ratio(compression, orig_itemsize)
+    qd = compression_overhead_us(nbytes, compression)
+    scale = (_ring_hops("all-reduce", world) * hop_latency_us
+             if spec["scale_exchange"] else 0.0)
+    return ratio, qd, scale
+
+
 def predict_collective_us(
     op: str,
     nbytes: int,
     world: int,
     *,
     calls: int = 1,
-    ici_bytes_per_sec: float = 186e9,
-    ici_hop_latency: float = 1e-6,
+    ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC,
+    ici_hop_latency: float = DEFAULT_ICI_HOP_LATENCY,
     compression: Optional[str] = None,
     orig_itemsize: int = 4,
     two_level: bool = False,
@@ -290,8 +452,8 @@ def per_tensor_table(
     world: int,
     *,
     measured_us: Optional[Dict[str, float]] = None,
-    ici_bytes_per_sec: float = 186e9,
-    ici_hop_latency: float = 1e-6,
+    ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC,
+    ici_hop_latency: float = DEFAULT_ICI_HOP_LATENCY,
 ) -> Dict[str, Dict[str, Any]]:
     """Per-tensor cost table: ``tensors`` maps tensor name ->
     ``{"op", "bytes", "calls"}`` (``calls`` defaults to 1) and the result
@@ -330,8 +492,8 @@ def model_scaling(
     t_compute: Optional[float],
     *,
     sizes=(8, 16, 32, 64),
-    ici_bytes_per_sec: float = 186e9,
-    ici_hop_latency: float = 1e-6,
+    ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC,
+    ici_hop_latency: float = DEFAULT_ICI_HOP_LATENCY,
     compression: Optional[str] = None,
     orig_itemsize: int = 4,
     two_level: bool = False,
@@ -348,23 +510,35 @@ def model_scaling(
     (default f32 = 4): pass 2 for bf16-native gradients, or the wire
     ratio of bf16/int8 compression is overstated (``cols`` aggregates
     bytes only, so the dtype must come from the caller).  Routed
-    through :func:`predict_collective_us` so this curve and the replay
-    what-ifs share one arithmetic."""
+    through one :class:`TopologySpec` per world size (and through
+    :func:`predict_collective_us` underneath) so this curve, the replay
+    what-ifs, and the ``hvd_replay --project`` projections share one
+    arithmetic — a SCALING.md table and a projection can't disagree.
+    ``flat_fabric="ici"`` pins the legacy single-torus assumption: the
+    DCN link only enters through ``two_level=True``, exactly as these
+    tables have always been computed."""
+    base = TopologySpec(
+        world=0,
+        local_size=int(local_size) if local_size else 1,
+        ici_bytes_per_sec=ici_bytes_per_sec,
+        ici_hop_latency_us=ici_hop_latency * 1e6,
+        dcn_bytes_per_sec=dcn_bytes_per_sec
+        if dcn_bytes_per_sec is not None else DEFAULT_DCN_BYTES_PER_SEC,
+        dcn_hop_latency_us=(dcn_hop_latency if dcn_hop_latency is not None
+                            else DEFAULT_DCN_HOP_LATENCY) * 1e6,
+        two_level="on" if two_level else "off",
+        flat_fabric="ici",
+    )
     comm_seconds, scaling = {}, {}
     for n in sizes:
+        spec = base.with_world(n)
         t_comm = sum(
-            predict_collective_us(
-                op, d["bytes"], n, calls=d["count"],
-                ici_bytes_per_sec=ici_bytes_per_sec,
-                ici_hop_latency=ici_hop_latency,
+            spec.predict_us(
+                op, d["bytes"], calls=d["count"],
                 # only the gradient all-reduce path compresses; other
                 # collectives (batch-stat gathers, permutes) ride as-is
                 compression=compression if op == "all-reduce" else None,
                 orig_itemsize=orig_itemsize,
-                two_level=two_level,
-                local_size=local_size,
-                dcn_bytes_per_sec=dcn_bytes_per_sec,
-                dcn_hop_latency=dcn_hop_latency,
             ) * 1e-6
             for op, d in cols.items()
         )
@@ -384,8 +558,8 @@ def collective_report(
     # by — a hardware change can't desync this report from bench.py or
     # the compute-anatomy profiler
     peak_flops: Optional[float] = None,
-    ici_bytes_per_sec: float = 186e9,   # v5e: ~186 GB/s per ICI direction
-    ici_hop_latency: float = 1e-6,      # ~1 µs per ICI neighbor hop
+    ici_bytes_per_sec: float = DEFAULT_ICI_BYTES_PER_SEC,
+    ici_hop_latency: float = DEFAULT_ICI_HOP_LATENCY,
     sizes=(8, 16, 32, 64),
     measured_step_seconds: Optional[float] = None,
     compression: Optional[str] = None,

@@ -19,6 +19,10 @@ Modules:
 * :mod:`~horovod_tpu.timeline.replay.simulator` — what-if scenarios
   (bandwidth, straggler removal, overlap, fusion re-batching) priced
   with the comm_report α–β cost model;
+* :mod:`~horovod_tpu.timeline.replay.projection` — the fleet-scale
+  digital twin: re-materialize the stitched DAG onto a hypothetical
+  topology (``hvd_replay --project``) with tracked
+  projected-vs-measured accuracy;
 * :mod:`~horovod_tpu.timeline.replay.fixture` — the hand-computed
   2-rank ground-truth trace.
 
@@ -80,7 +84,8 @@ def _cost_model_from_env(world: int) -> CostModel:
 def analyze(trace_dir: str, *, step: Optional[int] = None,
             last_steps: Optional[int] = None,
             cost_model: Optional[CostModel] = None,
-            plan_search: bool = True) -> ReplayResult:
+            plan_search: bool = True,
+            topology=None) -> ReplayResult:
     """Stitch ``trace_dir``, replay every step (or just ``step``), and
     assemble the summary: per-step critical path + attribution +
     ranked what-ifs, a per-tensor cost-model table (predicted vs
@@ -113,7 +118,7 @@ def analyze(trace_dir: str, *, step: Optional[int] = None,
         scheds[dag.step] = sched
         path = critical_path(dag, sched)
         attr = attribute(dag, sched)
-        wi = what_if(dag, cm, plan_search=plan_search)
+        wi = what_if(dag, cm, plan_search=plan_search, topology=topology)
         measured = dag.measured_step_us
         # aggregate per tensor: a tensor collected k times in the step
         # (microbatch accumulation) contributes k calls and k measured
@@ -225,3 +230,12 @@ def annotated_trace(trace_dir: str, result: Optional[ReplayResult] = None,
         with open(out_path, "w") as f:
             json.dump(merged, f)
     return merged
+
+
+# the digital-twin projection plane (imported last: projection builds on
+# analyze/_cost_model_from_env above)
+from .projection import (  # noqa: E402,F401
+    base_spec_from_env, live_validation, parse_project_spec,
+    project_analysis, project_dag, validate as validate_projection,
+)
+from ..comm_report import TopologySpec  # noqa: E402,F401  (public API)

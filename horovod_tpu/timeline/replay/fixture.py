@@ -88,6 +88,39 @@ def _events_rank1() -> List[dict]:
     ]
 
 
+#: --- projection ground truth (hvd_replay --project --check) ---------------
+#:
+#: The digital twin projected from the SAME 2-rank trace, hand-computed
+#: (timeline/replay/projection.py, distribution mode, default α–β:
+#: hop 1 µs, ICI 186 GB/s, DCN 25 GB/s / 10 µs):
+#:
+#: * **identity (world 2)**: nothing changes — 450.0 µs, bit-equal to
+#:   the replay baseline (the regression anchor);
+#: * **2× (world 4)**: ranks 0/2 get rank 0's chain, ranks 1/3 get
+#:   rank 1's.  The collective re-prices with the calibrated split:
+#:   α₂ = 2·(2−1)·1 = 2 µs, β_cal = 50 − 2 = 48 µs; link volume scales
+#:   by [2·3/4] / [2·1/2] = 1.5 → β₄ = 72 µs; α₄ = 2·(4−1)·1 = 6 µs →
+#:   comm = **78 µs**.  Readiness still gates at 300 (ranks 1/3), so
+#:   the makespan = 300 + 78 + 100 = **478 µs** (efficiency 450/478 =
+#:   0.9414);
+#: * **world 6, local 2 × cross 3, two_level=on**: the flat measurement
+#:   carries no tier split, so the collective is pure model
+#:   (predict_collective_us two-level shape): local RS + AG on ICI =
+#:   2 × 2 MiB/186 GB/s = 22.550 µs + 2 hops = 2 µs; cross all-reduce
+#:   on the 2 MiB shard over DCN = (2·⅔·2 MiB)/25 GB/s = 111.848 µs +
+#:   4 hops × 10 µs = 40 µs → comm = **176.398 µs**; makespan =
+#:   300 + 176.398 + 100 = **576.398 µs**.
+PROJECTION_EXPECTED: Dict[str, object] = {
+    "identity_us": 450.0,
+    "world4_us": 478.0,
+    "world4_comm_us": 78.0,
+    "world4_efficiency": 0.9414,
+    "world6_local2_us": 576.398,
+    "world6_comm_us": 176.398,
+    "hop_latency_us": 1.0,
+}
+
+
 #: --- autotune ground truth (scripts/hvd_autotune.py --check) -------------
 #:
 #: A second hand-computed 2-rank trace, symmetric across ranks (no

@@ -45,16 +45,17 @@ import dataclasses
 from typing import Dict, List, Optional
 
 from ..comm_report import (
-    DEFAULT_DCN_BYTES_PER_SEC, DEFAULT_DCN_HOP_LATENCY, _link_volume,
-    _ring_hops, compression_overhead_us, compression_scale_exchange,
+    DEFAULT_DCN_BYTES_PER_SEC, DEFAULT_DCN_HOP_LATENCY,
+    DEFAULT_ICI_BYTES_PER_SEC, DEFAULT_ICI_HOP_LATENCY, TopologySpec,
+    _link_volume, _ring_hops, compression_overhead_us,
+    compression_scale_exchange, compression_terms_us,
     compression_wire_ratio, predict_collective_us,
 )
 from .critical_path import Schedule, attribute, schedule
 from .stitcher import Node, StepDAG, _dtype_bytes
 
-#: defaults shared with comm_report.collective_report (v5e-class ICI)
-DEFAULT_ICI_BYTES_PER_SEC = 186e9
-DEFAULT_HOP_LATENCY_US = 1.0
+#: single-sourced with comm_report's TopologySpec defaults (v5e ICI)
+DEFAULT_HOP_LATENCY_US = DEFAULT_ICI_HOP_LATENCY * 1e6
 
 #: wire formats the compression what-ifs and the per-bucket choice
 #: search rank (ops/compression.py registry names priced by
@@ -74,6 +75,27 @@ class CostModel:
     local_size: int = 1
     dcn_bytes_per_sec: float = DEFAULT_DCN_BYTES_PER_SEC
     dcn_hop_latency_us: float = DEFAULT_DCN_HOP_LATENCY * 1e6
+
+    @classmethod
+    def from_topology(cls, spec: TopologySpec) -> "CostModel":
+        """The calibrated-replay cost model for one topology spec —
+        the projection engine's constructor (every α–β/tier number
+        comes from the shared ``TopologySpec``, never re-declared)."""
+        return cls(world=spec.world,
+                   ici_bytes_per_sec=spec.ici_bytes_per_sec,
+                   hop_latency_us=spec.ici_hop_latency_us,
+                   local_size=spec.local_size,
+                   dcn_bytes_per_sec=spec.dcn_bytes_per_sec,
+                   dcn_hop_latency_us=spec.dcn_hop_latency_us)
+
+    @property
+    def topology(self) -> TopologySpec:
+        """This model's parameters as the shared spec object."""
+        return TopologySpec(world=self.world, local_size=self.local_size,
+                            ici_bytes_per_sec=self.ici_bytes_per_sec,
+                            ici_hop_latency_us=self.hop_latency_us,
+                            dcn_bytes_per_sec=self.dcn_bytes_per_sec,
+                            dcn_hop_latency_us=self.dcn_hop_latency_us)
 
     def alpha_us(self, node: Node) -> float:
         return _ring_hops(node.op or "all-reduce",
@@ -117,38 +139,42 @@ class CostModel:
         """Calibrated compressed cost: the measured β share shrinks by
         the wire ratio; quantize/dequantize and the quantizers' scalar
         scale exchange (one all-reduce α) are added — the same curve
-        predict_collective_us prices, anchored on the measured level."""
+        predict_collective_us prices, anchored on the measured level
+        (terms from the shared comm_report.compression_terms_us)."""
         if not self.compressible(node):
             return node.dur_us
-        beta = self.calibrated_beta_us(node) * \
-            self.compression_ratio(node, compression)
-        qd = compression_overhead_us(node.nbytes or 0, compression)
-        scale = (_ring_hops("all-reduce", self.world) * self.hop_latency_us
-                 if compression_scale_exchange(compression) else 0.0)
-        return self.alpha_us(node) + beta + qd + scale
+        ratio, qd, scale = compression_terms_us(
+            compression, node.nbytes or 0, self.world,
+            self.hop_latency_us, _dtype_bytes(node.dtype))
+        return self.alpha_us(node) + self.calibrated_beta_us(node) * ratio \
+            + qd + scale
 
     def two_level_dur_us(self, node: Node,
-                         compression: Optional[str] = None) -> float:
+                         compression: Optional[str] = None,
+                         spec: Optional[TopologySpec] = None) -> float:
         """Model-priced two-level cost (parallel/hierarchical.py shape):
         the measured flat duration carries no information about the
         ICI/DCN split, so this scenario is pure predict_collective_us —
-        the fixture-checkable arithmetic, not a calibrated replay."""
+        the fixture-checkable arithmetic, not a calibrated replay.
+        ``spec`` supplies the hierarchy to price against (default: this
+        model's own) — the what-if can evaluate two-level for a target
+        topology the trace never ran on."""
         if node.kind != "comm" or not node.nbytes \
                 or (node.op or "all-reduce") != "all-reduce":
             return node.dur_us
+        spec = spec if spec is not None else self.topology
         return predict_collective_us(
             "all-reduce", node.nbytes, self.world,
-            ici_bytes_per_sec=self.ici_bytes_per_sec,
-            ici_hop_latency=self.hop_latency_us * 1e-6,
+            ici_bytes_per_sec=spec.ici_bytes_per_sec,
+            ici_hop_latency=spec.ici_hop_latency_us * 1e-6,
             compression=compression if self.compressible(node) else None,
             orig_itemsize=_dtype_bytes(node.dtype),
-            two_level=True, local_size=self.local_size,
-            dcn_bytes_per_sec=self.dcn_bytes_per_sec,
-            dcn_hop_latency=self.dcn_hop_latency_us * 1e-6)
+            two_level=True, local_size=spec.local_size,
+            dcn_bytes_per_sec=spec.dcn_bytes_per_sec,
+            dcn_hop_latency=spec.dcn_hop_latency_us * 1e-6)
 
     def two_level_possible(self) -> bool:
-        return (self.local_size > 1 and self.world % self.local_size == 0
-                and self.world // self.local_size > 1)
+        return self.topology.two_level_possible()
 
 
 def identify_straggler(dag: StepDAG, sched: Schedule) -> Optional[int]:
@@ -537,15 +563,24 @@ def bucket_plan_search(dag: StepDAG, cm: CostModel,
 # ---------------------------------------------------------------------------
 def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
             bandwidth_factors: tuple = (2.0, 4.0),
-            plan_search: bool = True) -> dict:
+            plan_search: bool = True,
+            topology: Optional[TopologySpec] = None) -> dict:
     """Baseline replay + every scenario, ranked by predicted speedup.
 
     ``plan_search=False`` skips the agglomerative bucket search (the
     `fuse_buckets_<k>` scenario + `bucket_search` table) — it is the
     expensive part on big traces (O(n²) full-DAG replays, patience-
     bounded), and a consumer after a straggler report doesn't need a
-    fusion plan (`hvd_replay.py --no-plan-search`)."""
+    fusion plan (`hvd_replay.py --no-plan-search`).
+
+    ``topology`` supplies the hierarchy/tier assumptions the
+    ``two_level_comm`` scenario is gated and priced on (default: the
+    cost model's own) — so a trace captured on a FLAT world can still
+    evaluate two-level reduction against a projected multi-host target
+    (``hvd_replay --project``) instead of silently omitting it."""
     cm = cm or CostModel(world=dag.world)
+    tl_spec = (topology if topology is not None
+               else cm.topology).with_world(cm.world)
     base = schedule(dag)
     baseline_us = base.makespan
     scenarios: List[dict] = []
@@ -600,17 +635,17 @@ def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
                 f"every float gradient quantized to {comp} on the wire "
                 "(error-feedback residual carried, "
                 "HVD_COMPRESSION=" + comp + ")")
-    if cm.two_level_possible():
+    if tl_spec.two_level_possible():
         overrides = {
-            n.nid: cm.two_level_dur_us(n) for n in dag.nodes
+            n.nid: cm.two_level_dur_us(n, spec=tl_spec) for n in dag.nodes
             if n.kind == "comm" and n.nbytes
             and (n.op or "all-reduce") == "all-reduce"
         }
         if overrides:
             add("two_level_comm", schedule(dag, dur_overrides=overrides),
                 f"two-level allreduce: ICI reduce-scatter over "
-                f"{cm.local_size} local ranks + DCN all-reduce on the "
-                "shard + ICI all-gather (model-priced, "
+                f"{tl_spec.local_size} local ranks + DCN all-reduce on "
+                "the shard + ICI all-gather (model-priced, "
                 "HVD_TWO_LEVEL_ALLREDUCE=1)")
     search = bucket_plan_search(dag, cm) if plan_search else []
     if search:
@@ -643,6 +678,7 @@ def what_if(dag: StepDAG, cm: Optional[CostModel] = None,
             "world": cm.world,
             "ici_bytes_per_sec": cm.ici_bytes_per_sec,
             "hop_latency_us": cm.hop_latency_us,
+            "local_size": tl_spec.local_size,
         },
         "scenarios": scenarios,
         "bucket_search": search,

@@ -127,6 +127,11 @@ HVD_REPLAY_HOP_US = "HVD_REPLAY_HOP_US"                # what-if per-hop latency
 HVD_REPLAY_DCN_GBPS = "HVD_REPLAY_DCN_GBPS"            # two-level what-if cross bandwidth, GB/s (default 25)
 HVD_REPLAY_DCN_HOP_US = "HVD_REPLAY_DCN_HOP_US"        # two-level what-if cross hop latency, µs (default 10)
 HVD_REPLAY_LOCAL_SIZE = "HVD_REPLAY_LOCAL_SIZE"        # two-level what-if ICI group size (default HVD_LOCAL_SIZE)
+# fleet-scale digital twin (timeline/replay/projection.py,
+# docs/projection.md): topology-projected replay + tracked accuracy
+HVD_PROJECT_MODE = "HVD_PROJECT_MODE"                  # chain replication: distribution|slowest (default distribution)
+HVD_PROJECT_SLO_GUARD = "HVD_PROJECT_SLO_GUARD"        # 0 disables the autoscaler's projected-p99 shrink guard (default 1)
+HVD_BENCH_PROJECTION = "HVD_BENCH_PROJECTION"          # 0 skips bench.py's projection-accuracy leg
 # failure-domain runtime (horovod_tpu/elastic/, docs/fault_tolerance.md)
 HVD_HEARTBEAT_INTERVAL_SECONDS = "HVD_HEARTBEAT_INTERVAL_SECONDS"  # lease renewal (default 2)
 HVD_HEARTBEAT_DISABLE = "HVD_HEARTBEAT_DISABLE"        # 1 turns the lease/abort plane off

@@ -74,6 +74,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
     monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
     monkeypatch.setattr(bench, "_serving_leg", lambda: {})
+    monkeypatch.setattr(bench, "_projection_leg", lambda: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
