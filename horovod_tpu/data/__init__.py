@@ -1,1 +1,1 @@
-from .loader import ShardedLoader  # noqa: F401
+from .loader import ShardedLoader, prefetch_to_device  # noqa: F401

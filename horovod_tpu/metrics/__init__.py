@@ -26,6 +26,9 @@ hvd_step_seconds                histogram  train-step cadence (dispatch-to-
                                            async dispatch, see training.py)
 hvd_steps_total                 counter    train steps dispatched
 hvd_samples_total               counter    global samples dispatched
+hvd_train_loss                  gauge      trailing async loss fetch (N
+                                           steps old by construction —
+                                           never a pipeline stall)
 hvd_ring_ops_total              counter    ring-plane transfers, by ``op``
 hvd_ring_bytes_total            counter    ring-plane payload bytes
 hvd_ring_active                 gauge      1 when the peer ring is up
@@ -152,6 +155,11 @@ STEPS_TOTAL = registry.counter(
     "hvd_steps_total", "Train steps dispatched.")
 SAMPLES_TOTAL = registry.counter(
     "hvd_samples_total", "Global samples dispatched into train steps.")
+TRAIN_LOSS = registry.gauge(
+    "hvd_train_loss",
+    "Most recently fetched training loss — fetched on the trailing "
+    "HVD_LOSS_FETCH_STEPS cadence (training.py), so the value is N "
+    "steps old and the fetch never drains the dispatch pipeline.")
 
 RING_OPS = registry.counter(
     "hvd_ring_ops_total", "Peer-ring transfers executed.", ("op",))

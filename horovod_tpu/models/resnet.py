@@ -123,6 +123,71 @@ class PallasConvBN3x3(nn.Module):
         return out
 
 
+class BatchNormReLU(nn.Module):
+    """BatchNorm + ReLU with the elementwise apply fused into one Pallas
+    pass (ops/elementwise.py ``scale_bias_relu``) — the compute tier's
+    norm+activation join, selected by ``ResNet(norm_act="pallas")``.
+
+    The per-channel statistics (a tiny reduction XLA handles well) and
+    the folded ``scale``/``bias`` stay in jnp; the [B,H,W,C]-sized
+    normalize+activate traffic — the HBM-bound part — runs as the single
+    fused kernel.  Gradients flow through batch mean/var exactly like
+    ``flax.linen.BatchNorm`` (the folded affine is a function of the
+    batch stats, so autodiff chains the kernel's dscale/dbias back
+    through them).  Parameter names inside the module mirror
+    ``BatchNorm``'s (params scale/bias, batch_stats mean/var), but the
+    module path differs — like ``conv_bn="pallas"``, checkpoints do NOT
+    interchange with the pair it replaces."""
+
+    use_running_average: bool
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.elementwise import scale_bias_relu
+
+        c = x.shape[-1]
+        gamma = self.param("scale", nn.initializers.ones, (c,),
+                           self.param_dtype)
+        beta = self.param("bias", nn.initializers.zeros, (c,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        x = x.astype(self.dtype)
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = xf.mean(axis=axes)
+            var = jnp.maximum(
+                (xf * xf).mean(axis=axes) - mean * mean, 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * \
+                    lax.stop_gradient(mean)
+                ra_var.value = m * ra_var.value + (1 - m) * \
+                    lax.stop_gradient(var)
+        scale = gamma.astype(jnp.float32) * lax.rsqrt(var + self.epsilon)
+        bias = beta.astype(jnp.float32) - mean * scale
+        return scale_bias_relu(x, scale, bias)
+
+
+def _norm_relu(norm, norm_relu, y):
+    """Every ``norm()(y); relu(y)`` pair in the blocks goes through
+    here: XLA's own elementwise fusion by default, or the single-pass
+    Pallas norm+activation join when a ``BatchNormReLU`` partial is
+    wired in (``norm_act="pallas"``)."""
+    if norm_relu is not None:
+        return norm_relu()(y)
+    return nn.relu(norm()(y))
+
+
 def _residual_join(residual, y, kind: str):
     """The block output ``relu(residual + y)``: XLA elementwise fusion by
     default, or the Pallas single-pass kernel (the docs/PERF.md §56×56
@@ -141,13 +206,13 @@ class BottleneckBlock(nn.Module):
     norm: ModuleDef
     join: str = "xla"  # "xla" | "pallas"
     fused: ModuleDef = None  # PallasConvBN3x3 partial (conv_bn="pallas")
+    norm_relu: ModuleDef = None  # BatchNormReLU partial (norm_act="pallas")
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = _norm_relu(self.norm, self.norm_relu, y)
         if self.fused is not None and self.strides == 1:
             # the 3x3+BN+ReLU as one fused Pallas op (stride-1 blocks;
             # stride-2 stage entries keep the XLA pair)
@@ -155,8 +220,7 @@ class BottleneckBlock(nn.Module):
         else:
             y = self.conv(self.filters, (3, 3),
                           strides=(self.strides,) * 2)(y)
-            y = self.norm()(y)
-            y = nn.relu(y)
+            y = _norm_relu(self.norm, self.norm_relu, y)
         y = self.conv(self.filters * 4, (1, 1))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
@@ -175,6 +239,7 @@ class BasicBlock(nn.Module):
     norm: ModuleDef
     join: str = "xla"  # "xla" | "pallas"
     fused: ModuleDef = None  # PallasConvBN3x3 partial (conv_bn="pallas")
+    norm_relu: ModuleDef = None  # BatchNormReLU partial (norm_act="pallas")
 
     @nn.compact
     def __call__(self, x):
@@ -186,8 +251,7 @@ class BasicBlock(nn.Module):
         else:
             y = self.conv(self.filters, (3, 3),
                           strides=(self.strides,) * 2)(x)
-            y = self.norm()(y)
-            y = nn.relu(y)
+            y = _norm_relu(self.norm, self.norm_relu, y)
         y = self.conv(self.filters, (3, 3))(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
@@ -209,6 +273,7 @@ class ResNet(nn.Module):
     stem: str = "conv"  # "conv" | "space_to_depth" (same params/output)
     residual_join: str = "xla"  # "xla" | "pallas" (same math, see blocks)
     conv_bn: str = "xla"  # "xla" | "pallas" (fused 3x3+BN+ReLU, see blocks)
+    norm_act: str = "xla"  # "xla" | "pallas" (fused BN-apply+ReLU join)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -231,6 +296,17 @@ class ResNet(nn.Module):
                 f"unknown conv_bn {self.conv_bn!r} (want 'xla' or "
                 "'pallas')"
             )
+        norm_relu = None
+        if self.norm_act == "pallas":
+            norm_relu = partial(
+                BatchNormReLU, use_running_average=not train,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+            )
+        elif self.norm_act != "xla":
+            raise ValueError(
+                f"unknown norm_act {self.norm_act!r} (want 'xla' or "
+                "'pallas')"
+            )
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = SpaceToDepthConvInit(
@@ -245,8 +321,11 @@ class ResNet(nn.Module):
                 f"unknown stem {self.stem!r} (want 'conv' or "
                 "'space_to_depth')"
             )
-        x = norm(name="bn_init")(x)
-        x = nn.relu(x)
+        if norm_relu is not None:
+            x = norm_relu(name="bn_init")(x)
+        else:
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
@@ -255,6 +334,7 @@ class ResNet(nn.Module):
                     filters=self.num_filters * 2 ** i,
                     strides=strides, conv=conv, norm=norm,
                     join=self.residual_join, fused=fused,
+                    norm_relu=norm_relu,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,

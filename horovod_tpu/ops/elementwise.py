@@ -9,7 +9,14 @@ XLA's own elementwise fusion standalone and end-to-end (the result —
 either a headline move or a measured negative — is recorded in
 docs/PERF.md).
 
-Off-TPU the kernel runs in Pallas interpreter mode, same policy as
+``scale_bias_relu`` is the compute-tier companion (docs/PERF.md
+"compute tier"): the norm+activation join ``relu(x * scale + bias)`` —
+the elementwise half of every BatchNorm→ReLU pair once the per-channel
+statistics are folded — in one HBM pass with a custom VJP whose
+backward reuses the masked-grad kernel.  models/resnet.py wires it in
+as ``norm_act="pallas"`` (the ``BatchNormReLU`` module).
+
+Off-TPU the kernels run in Pallas interpreter mode, same policy as
 ops/flash_attention.py.
 """
 
@@ -90,3 +97,76 @@ def _residual_relu_bwd(block_rows, interpret, out, g):
 
 
 residual_relu.defvjp(_residual_relu_fwd, _residual_relu_bwd)
+
+
+# ---------------------------------------------------------------------------
+# norm+activation join: relu(x * scale + bias) in one pass
+# ---------------------------------------------------------------------------
+def _scale_bias_relu_kernel(x_ref, s_ref, b_ref, o_ref):
+    y = x_ref[...].astype(jnp.float32) * s_ref[0][None, :] + b_ref[0][None, :]
+    o_ref[...] = jnp.maximum(y, 0).astype(o_ref.dtype)
+
+
+def _affine_call(x, scale, bias, *, block_rows, interpret):
+    """One blocked pass of the affine+relu kernel; scale/bias ride as
+    [1, C] rows broadcast to every block (the conv_bn.py layout)."""
+    lanes = x.shape[-1]
+    xf = x.reshape(-1, lanes)
+    rows = xf.shape[0]
+    cap = max(8, _BLOCK_BYTES // (lanes * x.dtype.itemsize))
+    block = min(block_rows, cap, rows)
+    out = pl.pallas_call(
+        _scale_bias_relu_kernel,
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[
+            pl.BlockSpec((block, lanes), lambda i: (i, 0)),
+            pl.BlockSpec((1, lanes), lambda i: (0, 0)),
+            pl.BlockSpec((1, lanes), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, lanes), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), x.dtype),
+        interpret=_resolve_interpret(interpret),
+    )(xf, scale.reshape(1, lanes).astype(jnp.float32),
+      bias.reshape(1, lanes).astype(jnp.float32))
+    return out.reshape(x.shape)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def scale_bias_relu(x, scale, bias, block_rows: int = 1024,
+                    interpret: Optional[bool] = None):
+    """``relu(x * scale + bias)`` as a single Pallas pass — the folded
+    norm+activation join.  ``x``: any shape with channels last;
+    ``scale``/``bias``: [C] (f32 — the folded BN affine).  The custom
+    VJP masks the upstream gradient with the saved output (one masked
+    pass, the ``residual_relu`` backward kernel) and reduces
+    ``dscale``/``dbias`` over the non-channel axes; gradients flow to
+    ``scale``/``bias`` so a caller computing them from batch statistics
+    gets the full BatchNorm backward through ordinary autodiff
+    (models/resnet.py ``BatchNormReLU``)."""
+    if scale.shape != (x.shape[-1],) or bias.shape != (x.shape[-1],):
+        raise ValueError(
+            f"scale/bias must be [{x.shape[-1]}], got "
+            f"{scale.shape} / {bias.shape}")
+    return _affine_call(x, scale, bias, block_rows=block_rows,
+                        interpret=interpret)
+
+
+def _scale_bias_relu_fwd(x, scale, bias, block_rows, interpret):
+    out = scale_bias_relu(x, scale, bias, block_rows, interpret)
+    return out, (x, scale, out)
+
+
+def _scale_bias_relu_bwd(block_rows, interpret, res, g):
+    x, scale, out = res
+    # masked upstream grad in one pass (reuses the relu-grad kernel)
+    gm = _flat_call(_relu_grad_kernel, out, g,
+                    block_rows=block_rows, interpret=interpret)
+    gm32 = gm.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    dx = (gm32 * scale).astype(x.dtype)
+    dscale = (gm32 * x.astype(jnp.float32)).sum(axis=axes)
+    dbias = gm32.sum(axis=axes)
+    return dx, dscale.astype(scale.dtype), dbias.astype(scale.dtype)
+
+
+scale_bias_relu.defvjp(_scale_bias_relu_fwd, _scale_bias_relu_bwd)

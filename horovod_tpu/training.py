@@ -36,6 +36,54 @@ class TrainState(NamedTuple):
     residual: Any = ()
 
 
+class TrailingLossFetcher:
+    """The async-host-pipeline loss fetch (docs/PERF.md compute tier).
+
+    ``push(loss)`` is called with every dispatched step's loss handle;
+    every ``every`` steps ONE handle is retained, and the retained
+    handle from the PREVIOUS cadence — by then ``every`` dispatches
+    old, long since complete — is fetched.  The fetch therefore never
+    drains the dispatch pipeline the way a per-step ``device_get``
+    does (the serialization the compute-anatomy profiler's host-gap
+    metric flags); the freshest fetched value is ``.value`` (a float,
+    ``every``..2×``every`` steps behind) and is exported as the
+    ``hvd_train_loss`` gauge.  ``every <= 0`` disables entirely."""
+
+    def __init__(self, every: int):
+        self.every = max(int(every), 0)
+        self._pending: list = []
+        self._n = 0
+        self.value: Optional[float] = None
+        self.step: Optional[int] = None
+
+    def push(self, loss) -> None:
+        if self.every <= 0:
+            return
+        self._n += 1
+        if self._n % self.every:
+            return
+        self._pending.append((self._n, loss))
+        if len(self._pending) > 1:
+            self._fetch(*self._pending.pop(0))
+
+    def _fetch(self, n, loss) -> None:
+        import numpy as np
+
+        self.value = float(np.asarray(jax.device_get(loss)))
+        self.step = n
+        from . import metrics
+
+        if metrics.on():
+            metrics.TRAIN_LOSS.set(self.value)
+
+    def flush(self) -> Optional[float]:
+        """Drain every retained handle (end-of-training); returns the
+        final fetched value."""
+        while self._pending:
+            self._fetch(*self._pending.pop(0))
+        return self.value
+
+
 def scan_steps(step_fn: Callable, k: int) -> Callable:
     """Compile ``k`` optimizer steps into one program via ``lax.scan``
     (amortizes per-step host dispatch — the round-2 ResNet profiling win,
@@ -72,6 +120,9 @@ def make_train_step(
     profile_guided: Optional[bool] = None,
     profile: Optional[bool] = None,
     in_graph_steps: int = 1,
+    fused_optimizer: Optional[bool] = None,
+    remat_policy: Optional[str] = None,
+    loss_fetch_steps: Optional[int] = None,
 ):
     """Returns ``step(state, batch, labels) -> (state, loss)`` compiled SPMD
     over the global mesh.
@@ -129,6 +180,23 @@ def make_train_step(
       reference's timed inner loop also re-feeds one synthetic batch,
       examples/tensorflow2_synthetic_benchmark.py:72-97; measured +6%
       on the v5e, docs/PERF.md).  Real data pipelines keep the default 1.
+    * ``fused_optimizer`` (default: ``HVD_FUSED_OPTIMIZER``, on when
+      ``optimizer`` is a :class:`~horovod_tpu.optim.fused_update.
+      FusedOptimizer`) routes the update through the flat fused
+      elementwise kernel instead of the per-leaf optax traversal —
+      same flat state either way, so the autotuner can flip the knob
+      through the re-jit seam without a state migration.
+    * ``remat_policy`` (default ``HVD_REMAT_POLICY``: none|full|dots)
+      rematerializes the loss closure under ``jax.checkpoint`` — a
+      compute knob the tuner can rotate when activations are the
+      HBM bottleneck.
+    * ``loss_fetch_steps`` (default ``HVD_LOSS_FETCH_STEPS``, 16)
+      fetches loss/metrics through a TRAILING async handle every N
+      steps (``step.loss_fetcher.value``) instead of a per-step
+      ``device_get`` — the dispatch pipeline stays deep; the forced
+      per-step sync survives only inside profiler/tuner measuring
+      windows, which need it for honest timing (docs/profiling.md
+      host-gap section is the before/after proof).  0 disables.
     """
     from .ops import collectives
     from .parallel.hierarchical import (
@@ -146,8 +214,43 @@ def make_train_step(
     if two_level is None:
         two_level = use_two_level_default()
 
+    # -- compute tier defaults (docs/PERF.md "compute tier") ----------------
+    from .optim.fused_update import FusedOptimizer
+
+    fusable = isinstance(optimizer, FusedOptimizer)
+    if fused_optimizer is None:
+        fused_optimizer = env_util.get_bool(env_util.HVD_FUSED_OPTIMIZER,
+                                            fusable)
+    if fused_optimizer and not fusable:
+        log.info("HVD_FUSED_OPTIMIZER is on but the optimizer is not a "
+                 "FusedOptimizer — keeping the per-leaf optax path")
+        fused_optimizer = False
+    if remat_policy is None:
+        remat_policy = env_util.get_str(env_util.HVD_REMAT_POLICY)
+    if remat_policy in ("", "none"):
+        remat_policy = None
+    if loss_fetch_steps is None:
+        loss_fetch_steps = env_util.get_int(
+            env_util.HVD_LOSS_FETCH_STEPS,
+            env_util.DEFAULT_LOSS_FETCH_STEPS)
+    fetcher = TrailingLossFetcher(loss_fetch_steps)
+
+    def _remat_wrap(fn, policy):
+        """The remat knob: checkpoint the loss closure so the backward
+        recomputes activations instead of holding them in HBM."""
+        if not policy or policy == "none":
+            return fn
+        if policy == "dots":
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.checkpoint_dots)
+        if policy != "full":
+            raise ValueError(
+                f"unknown remat policy {policy!r} (none|full|dots)")
+        return jax.checkpoint(fn)
+
     def _build(threshold_b, hier, named_buckets=None, comp=None,
-               bucket_compression=None, tlvl=None):
+               bucket_compression=None, tlvl=None, fused_opt=False,
+               remat=None):
         comp = comp if comp is not None else compression
         tlvl = two_level if tlvl is None else tlvl
         # error feedback threads TrainState.residual — only on the fused
@@ -221,20 +324,32 @@ def make_train_step(
                     )
             return grads, residual
 
+        # fused knob: flat single-kernel update vs per-leaf traversal —
+        # both paths of a FusedOptimizer share one flat state layout, so
+        # the autotuner can flip this through a re-jit with no state
+        # migration (optim/fused_update.py)
+        fused_active = bool(fused_opt) and fusable
+
         def _apply_update(state, grads, new_model_state, residual):
             with jax.named_scope("hvd_optimizer_update"):
-                updates, opt_state = optimizer.update(
-                    grads, state.opt_state, state.params
-                )
-                import optax
+                if fused_active:
+                    params, opt_state = optimizer.fused_update(
+                        grads, state.opt_state, state.params)
+                else:
+                    updates, opt_state = optimizer.update(
+                        grads, state.opt_state, state.params
+                    )
+                    import optax
 
-                params = optax.apply_updates(state.params, updates)
+                    params = optax.apply_updates(state.params, updates)
             return TrainState(params, opt_state, new_model_state,
                               state.step + 1, residual)
 
         def per_rank_step(state: TrainState, x, y):
             (loss, new_model_state), grads = jax.value_and_grad(
-                lambda p: _compute_loss(p, state.model_state, x, y),
+                _remat_wrap(
+                    lambda p: _compute_loss(p, state.model_state, x, y),
+                    remat),
                 has_aux=True,
             )(state.params)
             grads, residual = _reduce_grads(grads, state.residual)
@@ -281,7 +396,9 @@ def make_train_step(
 
             def backward_seg(state, x, y):
                 (loss, new_ms), grads = jax.value_and_grad(
-                    lambda p: _compute_loss(p, state.model_state, x, y),
+                    _remat_wrap(
+                        lambda p: _compute_loss(p, state.model_state, x, y),
+                        remat),
                     has_aux=True,
                 )(state.params)
                 return loss[None], _stack(new_ms), _stack(grads)
@@ -326,17 +443,35 @@ def make_train_step(
         autotune = env_util.get_bool(env_util.HVD_AUTOTUNE)
 
     pm = None
-    box = {}
+    box = {"fused_base": fused_optimizer, "remat_base": remat_policy}
+    fetcher_base_every = fetcher.every
 
-    def _rebuild(threshold_b, hier, plan=None):
+    def _rebuild(threshold_b, hier, plan=None, fused=None, remat=None):
         """(Re)compile the SPMD step and remember the knobs + the core
         mesh epoch it was built against, so a later elastic membership
         change (core.reinit bumps the epoch and swaps the mesh) can
         rebuild with the same knobs.  ``plan`` is a profile-guided
         FusionPlanSpec: its explicit bucket vector overrides the scalar
-        threshold, and its per-bucket ``compression`` names override the
-        wire format (optim/profile_guided.py)."""
-        named = plan.buckets if plan is not None else None
+        threshold, its per-bucket ``compression`` names override the
+        wire format, and its ``compute`` dict overrides the compute
+        knobs (optim/profile_guided.py; a compute-only plan has no
+        buckets and leaves threshold bucketing untouched).  ``fused`` /
+        ``remat`` move the base compute knobs (the GP tuner's
+        categorical dims); None leaves the base unchanged."""
+        if fused is not None:
+            box["fused_base"] = fused
+        if remat is not None:
+            box["remat_base"] = None if remat == "none" else remat
+        pc = (getattr(plan, "compute", None) or {}) \
+            if plan is not None else {}
+        fused_eff = pc.get("fused_optimizer", box["fused_base"])
+        remat_eff = pc.get("remat_policy", box["remat_base"])
+        # the async-pipeline knob is host-side: the plan moves the
+        # fetch cadence without a re-jit, rollback restores the base
+        fetcher.every = max(int(pc.get("loss_fetch_steps",
+                                       fetcher_base_every)), 0)
+        named = plan.buckets if plan is not None and plan.buckets \
+            else None
         bucket_comp = getattr(plan, "compression", None) \
             if plan is not None else None
         if bucket_comp is not None and box.get("guard_tripped"):
@@ -355,22 +490,38 @@ def make_train_step(
                      "applying the fusion layout uncompressed")
             bucket_comp = None
         comp = box.get("compression", compression)
+        # Everything jit-relevant, hashed: a rebuild whose compiled
+        # program would be byte-identical (e.g. a plan moving ONLY the
+        # host-side loss-fetch cadence, or its rollback) skips the
+        # re-trace/recompile — on a big model that's multi-seconds per
+        # knob trial that would otherwise land inside the tuner's
+        # verify window.
+        sig = (threshold_b, hier and named is None,
+               tuple(tuple(b) for b in named) if named else None,
+               tuple(bucket_comp) if bucket_comp else None,
+               id(comp), two_level and named is None, fused_eff,
+               remat_eff, core._require_init().epoch)
+        if sig == box.get("build_sig"):
+            box["plan"] = plan
+            return
         # An explicit bucket plan owns the comm layout: the hierarchical
         # path reduces per leaf and would silently drop named_buckets
         # while the tuner reports the plan applied.  box keeps the
-        # original hier so rollback (plan=None) restores it.
+        # original hier so rollback (plan=None) restores it.  A
+        # compute-only plan (no buckets) leaves the comm layout alone.
         fn, ef, profile_factory = _build(
-            threshold_b, hier and plan is None, named,
-            comp, bucket_comp, two_level and plan is None)
+            threshold_b, hier and named is None, named,
+            comp, bucket_comp, two_level and named is None,
+            fused_eff, remat_eff)
         # any rebuild (new plan, elastic epoch, guard trip) invalidates
         # the profiler's cached decomposed segments — they must re-jit
         # against the same knobs as the fused program
         box.pop("profile_fns", None)
         box.update(
             fn=fn, threshold=threshold_b, hier=hier, plan=plan,
-            ef_active=ef, compression=comp,
-            profile_factory=profile_factory,
-            core_epoch=core._require_init().epoch,
+            ef_active=ef, compression=comp, fused=fused_eff,
+            remat=remat_eff, profile_factory=profile_factory,
+            core_epoch=core._require_init().epoch, build_sig=sig,
         )
 
     if autotune:
@@ -380,13 +531,22 @@ def make_train_step(
             fusion_threshold_bytes=threshold_bytes
             or env_util.fusion_threshold_bytes(),
             hierarchical_allreduce=hierarchical,
+            fused_optimizer=fused_optimizer if fusable else None,
+            remat_policy=remat_policy,
         )
+        # HVD_AUTOTUNE_COMPUTE widens the GP rotation to the compute
+        # knobs — fused_optimizer only where the optimizer can fuse
+        tune_compute = env_util.get_bool(env_util.HVD_AUTOTUNE_COMPUTE)
         pm = ParameterManager(
             enabled=True, log_file=autotune_log_file, initial=initial,
+            tune_fused_optimizer=tune_compute and fusable,
+            tune_remat=tune_compute,
         )
         pm.on_update = lambda p: _rebuild(p.fusion_threshold_bytes,
                                           p.hierarchical_allreduce,
-                                          p.fusion_plan)
+                                          p.fusion_plan,
+                                          fused=p.fused_optimizer,
+                                          remat=p.remat_policy)
         _rebuild(initial.fusion_threshold_bytes,
                  initial.hierarchical_allreduce)
     else:
@@ -607,6 +767,7 @@ def make_train_step(
             else:
                 result = _profiled_step(state, x, y)
             _maybe_guard(result[0])
+            fetcher.push(result[1])
             return result
         if timeline.active and not under_trace:
             timeline.record_step(owner="train_step")
@@ -617,6 +778,7 @@ def make_train_step(
             result = box["fn"](state, x, y)
         if not under_trace:
             _maybe_guard(result[0])
+            fetcher.push(result[1])
         return result
 
     # Profile-guided loop (optim/profile_guided.py): analyze the job's
@@ -651,7 +813,34 @@ def make_train_step(
             else:
                 _rebuild(box["threshold"], box["hier"], plan)
 
-        tuner = tuner_from_env(_analyze, _apply_plan)
+        def _anatomy():
+            """The compute tier's plan source: the in-job profiler's
+            anatomy when a window has finalized, else this rank's
+            compute.json from an earlier run of the same trace dir."""
+            if profiler is not None and profiler.anatomy is not None:
+                return profiler.anatomy
+            if trace_dir:
+                from .timeline.profiler import own_rank_anatomy
+
+                return own_rank_anatomy(trace_dir)
+            return None
+
+        # knobs the base config already has on are not plan candidates:
+        # proposing them would be a no-op guaranteed to miss its
+        # prediction and get condemned.  loss_fetch_steps is ALWAYS
+        # excluded in-job: the tuner's baseline and verify windows both
+        # force a per-step result sync for honest timing — exactly the
+        # serialization the knob removes — so its realized delta inside
+        # a verify window is ~0 by construction and the guard band
+        # could only condemn (or falsely verify) it.  The knob stays
+        # reachable via HVD_LOSS_FETCH_STEPS, explicit plans, and the
+        # offline planner (scripts/compute_path_bench.py).
+        active = {"loss_fetch_steps": fetcher.every}
+        if fused_optimizer:
+            active["fused_optimizer"] = True
+        tuner = tuner_from_env(_analyze, _apply_plan, anatomy_fn=_anatomy,
+                               fused_available=fusable,
+                               active_compute=active)
         if not trace_dir:
             from .utils.logging import get_logger
 
@@ -662,6 +851,7 @@ def make_train_step(
 
     if pm is None and tuner is None:
         _invoke.compute_profiler = profiler
+        _invoke.loss_fetcher = fetcher
         return _invoke
 
     warm_start = env_util.get_bool(env_util.HVD_AUTOTUNE_WARM_START, True)
@@ -749,6 +939,7 @@ def make_train_step(
     step_autotuned.parameter_manager = pm
     step_autotuned.profile_guided_tuner = tuner
     step_autotuned.compute_profiler = profiler
+    step_autotuned.loss_fetcher = fetcher
     return step_autotuned
 
 

@@ -175,6 +175,16 @@ HVD_SERVE_MAX_REPLICAS = "HVD_SERVE_MAX_REPLICAS"      # grow ceiling (default 0
 HVD_SERVE_DRAIN_TIMEOUT_SECONDS = "HVD_SERVE_DRAIN_TIMEOUT_SECONDS"  # drain handshake budget (default elastic timeout)
 HVD_SERVE_WEIGHT_COMPRESSION = "HVD_SERVE_WEIGHT_COMPRESSION"  # none|bf16|int8|fp8 at-rest weight format
 HVD_BENCH_SERVE = "HVD_BENCH_SERVE"                    # 0 skips bench.py's serving leg
+# compute-path optimization tier (optim/fused_update.py, training.py,
+# data/loader.py, optim/compute_knobs.py; docs/PERF.md "compute tier"):
+# fused step kernels + async host pipeline + compute-knob autotuning
+HVD_FUSED_OPTIMIZER = "HVD_FUSED_OPTIMIZER"            # 0 forces the per-leaf optax path even for a FusedOptimizer
+HVD_FUSED_UPDATE_PALLAS = "HVD_FUSED_UPDATE_PALLAS"    # force the Pallas (1) / jnp (0) fused-update backend; default: Pallas on TPU only
+HVD_LOSS_FETCH_STEPS = "HVD_LOSS_FETCH_STEPS"          # trailing async loss fetch cadence (default 16; 0 never fetches)
+HVD_PREFETCH_DEPTH = "HVD_PREFETCH_DEPTH"              # device prefetch queue depth in data/loader.py (default 2; 0 disables)
+HVD_REMAT_POLICY = "HVD_REMAT_POLICY"                  # none|full|dots rematerialization of the loss closure
+HVD_AUTOTUNE_COMPUTE = "HVD_AUTOTUNE_COMPUTE"          # 1 lets the GP autotuner rotate the compute knobs too
+HVD_BENCH_COMPUTE_OPT = "HVD_BENCH_COMPUTE_OPT"        # 0 skips bench.py's compute-path A/B leg (host_gap_pct source)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -211,6 +221,8 @@ DEFAULT_SERVE_QUEUE_LOW = 0.5                      # idle threshold, per replica
 DEFAULT_SERVE_HYSTERESIS_TICKS = 3                 # sustained ticks before an autoscale action
 DEFAULT_SERVE_COOLDOWN_SECONDS = 10.0              # spacing between autoscale actions
 DEFAULT_SERVE_MIN_REPLICAS = 1                     # autoscaler shrink floor
+DEFAULT_LOSS_FETCH_STEPS = 16                      # trailing loss-fetch cadence (training.py)
+DEFAULT_PREFETCH_DEPTH = 2                         # device prefetch queue depth (data/loader.py)
 
 
 def get_int(name: str, default: int) -> int:

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""compute_path_bench: the compute-tier A/B + the compute-knob planner
+self-test.
+
+Two modes:
+
+* default — run the fused-update + async-pipeline A/B on the current
+  mesh (optim/compute_knobs.py ``run_bench_fixture``; the same fixture
+  bench.py's ``--child-compute-opt`` leg times) and print the JSON
+  verdict: ``compute_opt_delta_pct`` (img/s with the tier on vs off),
+  ``host_gap_pct`` (the async pipeline's proof, from a real profiler
+  window), and the loss-equality check;
+* ``--check`` — replay the hand-computed compute-knob fixture
+  (``COMPUTE_AUTOTUNE_EXPECTED``: the profiler fixture's anatomy must
+  plan loss_fetch_steps at +9.0% and fused_optimizer at +2.5%,
+  exactly) and exit 0/1 — the tier-1 self-test, same contract as
+  ``hvd_autotune.py --check``.
+
+Run::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python scripts/compute_path_bench.py
+    python scripts/compute_path_bench.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--check", action="store_true",
+                   help="replay the hand-computed planner fixture")
+    p.add_argument("--steps", type=int, default=40,
+                   help="A/B steps per side")
+    p.add_argument("--host-delay-ms", type=float, default=3.0,
+                   help="injected per-batch host delay (the synthetic "
+                        "input pipeline the prefetch loader overlaps)")
+    args = p.parse_args(argv)
+
+    if args.check:
+        from horovod_tpu.optim.compute_knobs import (
+            COMPUTE_AUTOTUNE_EXPECTED, check_fixture,
+        )
+
+        ok = check_fixture()
+        print(f"compute_path_bench --check: "
+              f"{'OK' if ok else 'FAILED'} — planner vs "
+              f"COMPUTE_AUTOTUNE_EXPECTED "
+              f"(async {COMPUTE_AUTOTUNE_EXPECTED['async_speedup_pct']}%, "
+              f"fused {COMPUTE_AUTOTUNE_EXPECTED['fused_speedup_pct']}%)")
+        return 0 if ok else 1
+
+    from horovod_tpu.optim.compute_knobs import run_bench_fixture
+
+    out = run_bench_fixture(steps=args.steps,
+                            host_delay_s=args.host_delay_ms / 1e3)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

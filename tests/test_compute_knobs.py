@@ -1,0 +1,470 @@
+"""Compute-knob autotuning (optim/compute_knobs.py + the widened
+TunableParams/ProfileGuidedTuner): the hand-computed fixture in the
+AUTOTUNE_EXPECTED style, the two-knob apply→verify→rollback loop
+through the existing guard band, the per-category GP split for the new
+categorical dims, and the training.py rebuild-seam integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.optim.autotune import ParameterManager, TunableParams
+from horovod_tpu.optim.compute_knobs import (
+    COMPUTE_AUTOTUNE_EXPECTED,
+    KNOB_FUSED_OPTIMIZER,
+    KNOB_LOSS_FETCH,
+    check_fixture,
+    compute_fixture_anatomy,
+    compute_plans_from_anatomy,
+)
+from horovod_tpu.optim.fused_update import fused_sgd
+from horovod_tpu.optim.profile_guided import (
+    FusionPlanSpec, ProfileGuidedTuner,
+)
+
+E = COMPUTE_AUTOTUNE_EXPECTED
+
+
+# ---------------------------------------------------------------------------
+# planner vs the hand-computed fixture
+# ---------------------------------------------------------------------------
+def test_planner_recovers_fixture_exactly():
+    """The acceptance pin: the profiler fixture's anatomy (1000 µs
+    steps, 50 µs optimizer_update, 100 µs host gap) plans
+    loss_fetch_steps at exactly +9.0% / 910 µs and fused_optimizer at
+    exactly +2.5% / 975 µs, ranked in that order."""
+    plans = compute_plans_from_anatomy(compute_fixture_anatomy())
+    assert [set(p.compute) for p in plans] == [
+        {KNOB_LOSS_FETCH}, {KNOB_FUSED_OPTIMIZER}]
+    async_p, fused_p = plans
+    assert async_p.baseline_step_us == pytest.approx(E["baseline_step_us"])
+    assert async_p.predicted_step_us == pytest.approx(
+        E["async_predicted_step_us"])
+    assert async_p.predicted_speedup_pct == pytest.approx(
+        E["async_speedup_pct"])
+    assert fused_p.compute == {KNOB_FUSED_OPTIMIZER: True}
+    assert fused_p.predicted_step_us == pytest.approx(
+        E["fused_predicted_step_us"])
+    assert fused_p.predicted_speedup_pct == pytest.approx(
+        E["fused_speedup_pct"])
+    assert not async_p.buckets and not fused_p.buckets
+    assert check_fixture()
+
+
+def test_planner_respects_exclusions_and_fusability():
+    anatomy = compute_fixture_anatomy()
+    only_async = compute_plans_from_anatomy(anatomy, fused_available=False)
+    assert [set(p.compute) for p in only_async] == [{KNOB_LOSS_FETCH}]
+    only_fused = compute_plans_from_anatomy(anatomy,
+                                            exclude=[KNOB_LOSS_FETCH])
+    assert [set(p.compute) for p in only_fused] == [{KNOB_FUSED_OPTIMIZER}]
+    assert compute_plans_from_anatomy(
+        anatomy, exclude=[KNOB_LOSS_FETCH, KNOB_FUSED_OPTIMIZER]) == []
+    assert compute_plans_from_anatomy(None) == []
+    assert compute_plans_from_anatomy({"steps": 0}) == []
+
+
+def test_compute_plan_roundtrips_wire_format():
+    plan = FusionPlanSpec(buckets=[], compute={KNOB_FUSED_OPTIMIZER: True},
+                          predicted_speedup_pct=2.5)
+    assert FusionPlanSpec.from_dict(plan.to_dict()) == plan
+
+
+# ---------------------------------------------------------------------------
+# the two-knob closed loop: apply → verify → (rollback)
+# ---------------------------------------------------------------------------
+def _loop(seq_us, **kw):
+    applied = []
+    tuner = ProfileGuidedTuner(
+        analyze_fn=lambda: None, apply_fn=applied.append,
+        anatomy_fn=compute_fixture_anatomy, window_steps=4, **kw)
+    for us in seq_us:
+        tuner.on_step(us * 1e-6)
+    return tuner, applied
+
+
+def test_tuner_explores_two_compute_knobs_end_to_end():
+    """The acceptance pin: the tuner applies the async plan (+9.0%
+    predicted), verifies it at 910 µs, re-baselines WITH it applied,
+    applies the fused plan on top (knobs accumulate), and verifies the
+    combined 885 µs end state — two compute knobs through the same
+    guard band, no comm plan involved."""
+    base = E["baseline_step_us"]
+    mid = E["async_predicted_step_us"]
+    done = E["combined_step_us"]
+    tuner, applied = _loop(
+        [base] * 4 + [mid] * 4       # plan 1: baseline → verify
+        + [mid] * 4 + [done] * 4     # plan 2: fresh baseline → verify
+        + [done] * 4,                # no candidates left → frozen
+        guard_band_pct=10.0)
+    assert [r["outcome"] for r in tuner.history] == \
+        ["applied", "verified", "applied", "verified"]
+    assert applied[0].compute == {KNOB_LOSS_FETCH: 16}
+    assert applied[1].compute == {KNOB_LOSS_FETCH: 16,
+                                  KNOB_FUSED_OPTIMIZER: True}
+    assert tuner._verified_compute == applied[1].compute
+    assert not tuner.active
+    # realized landed in-band on both verifies
+    assert tuner.history[1]["realized_speedup_pct"] == pytest.approx(
+        (base - mid) / base * 100.0, abs=0.05)
+
+
+def test_tuner_rolls_back_regressed_compute_knob_to_last_good():
+    """Rollback pin: the second knob realizes nothing → past the guard
+    band → the tuner rolls back to the LAST VERIFIED plan (async only,
+    not None), condemns the knob, and never re-proposes it."""
+    base = E["baseline_step_us"]
+    mid = E["async_predicted_step_us"]
+    tuner, applied = _loop(
+        [base] * 4 + [mid] * 4       # plan 1 verifies
+        + [mid] * 4 + [mid] * 4      # plan 2 realizes +0% → rollback
+        + [mid] * 8,
+        guard_band_pct=1.0)
+    assert [r["outcome"] for r in tuner.history] == \
+        ["applied", "verified", "applied", "rolled_back"]
+    assert applied[-1] is not None
+    assert applied[-1].compute == {KNOB_LOSS_FETCH: 16}
+    assert tuner.plan.compute == {KNOB_LOSS_FETCH: 16}
+    assert tuner._condemned_compute == {KNOB_FUSED_OPTIMIZER}
+    assert not tuner.active              # nothing left to try
+
+
+def test_compute_plans_lose_to_better_comm_plan():
+    """When the trace yields a comm plan predicting more than the best
+    compute knob, the comm plan wins the window (same predicted-speedup
+    scale)."""
+    comm = FusionPlanSpec(buckets=[["g0"], ["g1"]],
+                          predicted_step_us=600.0,
+                          baseline_step_us=1000.0,
+                          predicted_speedup_pct=40.0)
+    applied = []
+    tuner = ProfileGuidedTuner(
+        analyze_fn=lambda: {"steps": []}, apply_fn=applied.append,
+        anatomy_fn=compute_fixture_anatomy, window_steps=2)
+    import horovod_tpu.optim.profile_guided as pg
+
+    orig = pg.plan_from_summary
+    pg.plan_from_summary = lambda s: comm
+    try:
+        for us in [1000e-6] * 2:
+            tuner.on_step(us)
+    finally:
+        pg.plan_from_summary = orig
+    assert applied and applied[0].buckets == comm.buckets
+
+
+def test_verified_comm_layout_survives_compute_plan():
+    """A compute knob tried after a verified comm plan re-asserts the
+    comm plan's buckets in the new plan (the rebuild is whole-state)."""
+    comm = FusionPlanSpec(buckets=[["g0"], ["g1"]],
+                          predicted_step_us=900.0,
+                          baseline_step_us=1000.0,
+                          predicted_speedup_pct=10.0)
+    applied = []
+    tuner = ProfileGuidedTuner(
+        analyze_fn=lambda: {"steps": []}, apply_fn=applied.append,
+        anatomy_fn=compute_fixture_anatomy, window_steps=2,
+        guard_band_pct=50.0)
+    import horovod_tpu.optim.profile_guided as pg
+
+    orig = pg.plan_from_summary
+    pg.plan_from_summary = lambda s: comm
+    try:
+        for us in [1000] * 2 + [900] * 2 + [900] * 2:
+            tuner.on_step(us * 1e-6)
+    finally:
+        pg.plan_from_summary = orig
+    assert applied[0].buckets == comm.buckets
+    assert len(applied) >= 2
+    assert applied[1].buckets == comm.buckets     # carried forward
+    assert applied[1].compute                     # plus a compute knob
+
+
+# ---------------------------------------------------------------------------
+# TunableParams: the new categorical dims guard (the PR 6 contract)
+# ---------------------------------------------------------------------------
+def test_fused_optimizer_flip_selects_distinct_gp_key():
+    """The satellite pin: flipping fused_optimizer changes category()
+    — its observations can never share the fusion-threshold GP of any
+    other category — while the GP input vector stays identical; and an
+    absent (None) knob keeps the legacy comm-only key."""
+    off = TunableParams(fused_optimizer=False)
+    on = TunableParams(fused_optimizer=True)
+    legacy = TunableParams()
+    np.testing.assert_array_equal(off.as_vector(), on.as_vector())
+    assert off.category() != on.category()
+    assert legacy.category() == (False,)
+    assert off.category() != legacy.category()
+    for dim in ("fused_optimizer", "remat_policy"):
+        assert dim in TunableParams.CATEGORICAL_DIMS
+        assert dim not in TunableParams.CONTINUOUS_DIMS
+
+
+def test_flipped_knob_observations_cannot_cross_gps(monkeypatch):
+    monkeypatch.setenv("HVD_AUTOTUNE_PYTHON", "1")
+    pm = ParameterManager(enabled=True, warmup_samples=0,
+                          steps_per_sample=1, max_samples=8,
+                          tune_hierarchical=False,
+                          tune_fused_optimizer=True,
+                          initial=TunableParams(fused_optimizer=True))
+    while not pm.frozen:
+        s = 2e9 if pm.current.fused_optimizer else 1e9
+        pm.record_step(s, 1.0)
+    cats = set(pm._bo)
+    assert cats == {(False, ("fused_optimizer", False)),
+                    (False, ("fused_optimizer", True))}
+    for cat, bo in pm._bo.items():
+        expect = 2e9 if cat[1][1] else 1e9
+        assert all(y == pytest.approx(expect) for y in bo.ys)
+    assert pm.current.fused_optimizer is True    # the better surface won
+
+
+def test_untuned_compute_knob_pinned_out_of_rotation(monkeypatch):
+    """tune_fused_optimizer=False (the default): the rotation must
+    never flip the knob, whatever it is pinned to."""
+    monkeypatch.setenv("HVD_AUTOTUNE_PYTHON", "1")
+    pm = ParameterManager(enabled=True, warmup_samples=0,
+                          steps_per_sample=1, max_samples=4,
+                          tune_hierarchical=True,
+                          initial=TunableParams(fused_optimizer=True))
+    assert all(k["fused_optimizer"] is True for k in pm._category_knobs)
+    while not pm.frozen:
+        assert pm.current.fused_optimizer is True
+        pm.record_step(1e9, 1.0)
+    assert pm.current.fused_optimizer is True
+
+
+def test_remat_rotation_uses_explicit_none_string(monkeypatch):
+    """tune_remat proposes 'none'/'full'/'dots' (never None — None
+    means *leave unchanged* at the training rebuild seam), the initial
+    absent value normalizes onto the rotation's 'none' category (no
+    orphan GP for the first observation), and the default sample
+    budget scales per category."""
+    monkeypatch.setenv("HVD_AUTOTUNE_PYTHON", "1")
+    monkeypatch.delenv("HVD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+                       raising=False)
+    pm = ParameterManager(enabled=True, warmup_samples=0,
+                          steps_per_sample=1, tune_hierarchical=False,
+                          tune_remat=True)
+    vals = {k["remat_policy"] for k in pm._category_knobs}
+    assert vals == {"none", "full", "dots"}
+    assert pm.current.category() in pm._bo        # normalized, not orphan
+    assert pm.max_samples == 10 * len(pm._categories)
+    for _ in range(pm.max_samples):
+        assert pm.current.remat_policy in ("none", "full", "dots")
+        pm.record_step(1e9, 1.0)
+    assert pm.frozen
+
+
+# ---------------------------------------------------------------------------
+# training.py integration: the rebuild seam applies compute knobs
+# ---------------------------------------------------------------------------
+def _mlp(rng):
+    from horovod_tpu.models.mlp import MLP
+
+    model = MLP(features=(16, 4))
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    return model, loss_fn, x, y
+
+
+def test_compute_plan_applies_through_rebuild_seam(hvd_init, rng):
+    """A compute-only plan (no buckets) flips fused/remat/loss-fetch
+    through ParameterManager.apply_plan → _rebuild and training
+    continues on both sides of clear_plan; threshold bucketing and
+    hierarchical state are untouched (no comm-layout side effects)."""
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model, loss_fn, x, y = _mlp(rng)
+    opt = fused_sgd(0.05, momentum=0.9)
+    step = make_train_step(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=opt, autotune=True, donate=False)
+    state = init_train_state(model, opt, jnp.zeros((2, 8)))
+    xs, ys = shard_batch(x), shard_batch(y)
+    state, _ = step(state, xs, ys)
+    plan = FusionPlanSpec(buckets=[], compute={
+        KNOB_FUSED_OPTIMIZER: False, "remat_policy": "full",
+        KNOB_LOSS_FETCH: 4})
+    step.parameter_manager.apply_plan(plan)
+    state, loss = step(state, xs, ys)
+    assert np.isfinite(float(np.asarray(loss)))
+    assert step.loss_fetcher.every == 4
+    step.parameter_manager.clear_plan()
+    state, loss = step(state, xs, ys)
+    assert np.isfinite(float(np.asarray(loss)))
+
+
+def test_remat_policy_is_numerically_transparent(hvd_init, rng):
+    """remat_policy='full' recomputes activations — same math, same
+    losses as the default (what makes it a safe tuner knob)."""
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model, loss_fn, x, y = _mlp(rng)
+    outs = {}
+    for remat in (None, "full", "dots"):
+        opt = optax.sgd(0.05)
+        step = make_train_step(
+            apply_fn=lambda v, a, train=True: model.apply(v, a),
+            loss_fn=loss_fn, optimizer=opt, donate=False,
+            remat_policy=remat)
+        state = init_train_state(model, opt, jnp.zeros((2, 8)))
+        xs, ys = shard_batch(x), shard_batch(y)
+        for _ in range(2):
+            state, loss = step(state, xs, ys)
+        outs[remat] = float(np.asarray(jax.device_get(loss)))
+    assert outs[None] == pytest.approx(outs["full"], rel=1e-6)
+    assert outs[None] == pytest.approx(outs["dots"], rel=1e-6)
+
+
+def test_tuner_plans_compute_knobs_from_profiler_anatomy(hvd_init, rng,
+                                                        monkeypatch,
+                                                        tmp_path):
+    """End to end through make_train_step(profile_guided=True): with a
+    compute.json already in the trace dir (the offline anatomy source),
+    real steps drive the tuner to an applied compute plan through the
+    re-jit seam."""
+    import json
+    import os
+
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    rank_dir = tmp_path / "0"
+    os.makedirs(rank_dir)
+    with open(rank_dir / "compute.json", "w") as f:
+        json.dump({"rank": 0, "clock": "fixture",
+                   "anatomy": compute_fixture_anatomy(), "events": []}, f)
+    monkeypatch.setenv("HVD_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_AUTOTUNE_WINDOW_STEPS", "3")
+
+    model, loss_fn, x, y = _mlp(rng)
+    opt = fused_sgd(0.05, momentum=0.9)
+    # base config leaves the fused knob OFF so it is a real candidate
+    # (knobs already on are excluded, and loss_fetch is ALWAYS excluded
+    # in-job — the measuring windows' honesty sync makes it
+    # unverifiable there; see the active_compute test)
+    step = make_train_step(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=opt, profile_guided=True,
+        donate=False, fused_optimizer=False, loss_fetch_steps=0)
+    tuner = step.profile_guided_tuner
+    assert tuner is not None and tuner.anatomy_fn is not None
+    assert set(tuner.active_compute) == {KNOB_LOSS_FETCH}
+    state = init_train_state(model, opt, jnp.zeros((2, 8)))
+    xs, ys = shard_batch(x), shard_batch(y)
+    for _ in range(10):
+        state, loss = step(state, xs, ys)
+        if tuner.phase == tuner.PHASE_VERIFY:
+            break
+    assert tuner.plan is not None and tuner.plan.compute
+    assert tuner.history[0]["outcome"] == "applied"
+    assert np.isfinite(float(np.asarray(loss)))
+
+# ---------------------------------------------------------------------------
+# review-hardening pins
+# ---------------------------------------------------------------------------
+def test_active_base_knobs_are_not_candidates(hvd_init, rng):
+    """A default job (trailing loss fetch on, FusedOptimizer fused)
+    must NOT have those knobs proposed as plans — a no-op plan is
+    guaranteed to miss its prediction, get condemned, and waste two
+    windows plus a re-jit."""
+    from horovod_tpu.training import make_train_step
+
+    model, loss_fn, x, y = _mlp(rng)
+    step = make_train_step(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=fused_sgd(0.05, momentum=0.9),
+        profile_guided=True, donate=False)
+    tuner = step.profile_guided_tuner
+    assert set(tuner.active_compute) == {KNOB_FUSED_OPTIMIZER,
+                                         KNOB_LOSS_FETCH}
+    tuner.anatomy_fn = compute_fixture_anatomy
+    assert tuner._compute_candidates() == []
+
+
+def test_comm_replan_reasserts_verified_compute_knobs():
+    """After a compute knob verifies, a later comm-only re-plan must
+    carry it forward — the rebuild is whole-state, so a plan without
+    the knob would silently revert a verified optimization while it
+    stays excluded from re-proposal."""
+    import horovod_tpu.optim.profile_guided as pg
+
+    applied = []
+    tuner = ProfileGuidedTuner(
+        analyze_fn=lambda: {"steps": []}, apply_fn=applied.append,
+        anatomy_fn=compute_fixture_anatomy, window_steps=2,
+        guard_band_pct=50.0, cycle_flush_steps=2)
+    comm = FusionPlanSpec(buckets=[["g0"], ["g1"]],
+                          predicted_step_us=500.0,
+                          baseline_step_us=1000.0,
+                          predicted_speedup_pct=50.0)
+    orig = pg.plan_from_summary
+    # window 1: no comm plan → best compute plan applies and verifies
+    pg.plan_from_summary = lambda s: None
+    try:
+        for us in [1000] * 2 + [910] * 2:
+            tuner.on_step(us * 1e-6)
+        assert applied[0].compute == {KNOB_LOSS_FETCH: 16}
+        # next windows: a comm plan wins the argmax — it must re-assert
+        # the verified loss_fetch knob, not silently drop it
+        pg.plan_from_summary = lambda s: FusionPlanSpec.from_dict(
+            comm.to_dict())
+        for us in [910] * 2 + [800] * 2:
+            tuner.on_step(us * 1e-6)
+    finally:
+        pg.plan_from_summary = orig
+    comm_applied = [p for p in applied if p is not None and p.buckets]
+    assert comm_applied, [p and p.to_dict() for p in applied]
+    assert comm_applied[0].compute.get(KNOB_LOSS_FETCH) == 16
+
+
+def test_verify_exit_decision_follows_process_zero():
+    """Multi-process: whether the loop re-baselines for another compute
+    knob is process 0's decision through the plan broadcast — per-rank
+    anatomies differ, and a rank transitioning differently would stop
+    joining the window collectives (hang)."""
+    synced = []
+
+    def plan_sync(d):
+        synced.append(d)
+        if isinstance(d, dict) and "more_compute" in d:
+            return {"more_compute": False}      # process 0 says stop
+        return d
+
+    applied = []
+    tuner = ProfileGuidedTuner(
+        analyze_fn=lambda: None, apply_fn=applied.append,
+        anatomy_fn=compute_fixture_anatomy,     # locally: more remain
+        window_steps=2, guard_band_pct=10.0, plan_sync=plan_sync)
+    for us in [1000] * 2 + [910] * 2:
+        tuner.on_step(us * 1e-6)
+    assert tuner.history[-1]["outcome"] == "verified"
+    # local anatomy still offers fused_optimizer, but process 0 said no
+    assert not tuner.active
+    assert any(isinstance(d, dict) and "more_compute" in d
+               for d in synced)
+
+
+def test_tune_remat_rotation_keeps_pinned_current_value(monkeypatch):
+    """A caller pinned to remat 'dots' stays reachable when the dim is
+    tuned — the rotation must never drop the current value."""
+    monkeypatch.setenv("HVD_AUTOTUNE_PYTHON", "1")
+    pm = ParameterManager(enabled=True, warmup_samples=0,
+                          steps_per_sample=1, max_samples=6,
+                          tune_hierarchical=False, tune_remat=True,
+                          initial=TunableParams(remat_policy="dots"))
+    vals = {k["remat_policy"] for k in pm._category_knobs}
+    assert vals == {"none", "full", "dots"}

@@ -1,0 +1,286 @@
+"""Fused optimizer update (optim/fused_update.py): optax parity across
+SGD/momentum/Adam, the NumPy oracle, Pallas-vs-jnp bit identity under
+jit, per-leaf-vs-fused bit identity (the autotuner knob-flip contract),
+donation safety, and composition with error-feedback residuals and
+in_graph_steps > 1 scan carries."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.optim.fused_update import (
+    FusedOptimizer,
+    FusedOptState,
+    flatten_by_dtype,
+    fused_adam,
+    fused_sgd,
+    numpy_fused_update,
+    unflatten_by_dtype,
+)
+
+
+@pytest.fixture()
+def tree(rng):
+    return {
+        "a": {"w": jnp.asarray(rng.normal(size=(7, 5)), jnp.float32),
+              "b": jnp.asarray(rng.normal(size=(5,)), jnp.float32)},
+        "c": jnp.asarray(rng.normal(size=(300,)), jnp.float32),
+    }
+
+
+@pytest.fixture()
+def grads(rng, tree):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), p.dtype), tree)
+
+
+OPTS = [fused_sgd(0.1), fused_sgd(0.1, momentum=0.9), fused_adam(1e-3)]
+IDS = ["sgd", "momentum", "adam"]
+
+
+def _leaves(t):
+    return jax.tree_util.tree_leaves(t)
+
+
+# ---------------------------------------------------------------------------
+# parity: fused == optax == numpy oracle
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opt", OPTS, ids=IDS)
+def test_fused_matches_optax_reference(opt, tree, grads):
+    """The acceptance pin: 4 steps of the fused path vs the exact optax
+    construction it mirrors — allclose at fp32 with pinned tolerances
+    (the expressions are order-identical; only compiler fusion can
+    differ)."""
+    st = opt.init(tree)
+    rst = opt.reference.init(tree)
+    p_f, p_r = tree, tree
+    for _ in range(4):
+        p_f, st = opt.fused_update(grads, st, p_f)
+        upd, rst = opt.reference.update(grads, rst, p_r)
+        p_r = optax.apply_updates(p_r, upd)
+    for a, b in zip(_leaves(p_f), _leaves(p_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("opt", OPTS, ids=IDS)
+def test_fused_matches_numpy_oracle(opt, tree, grads):
+    st = opt.init(tree)
+    p_f = tree
+    p_np = jax.tree_util.tree_map(np.asarray, tree)
+    g_np = jax.tree_util.tree_map(np.asarray, grads)
+    np_state = None
+    for _ in range(3):
+        p_f, st = opt.fused_update(grads, st, p_f)
+        p_np, np_state = numpy_fused_update(opt, p_np, g_np, np_state)
+    for a, b in zip(_leaves(p_f), _leaves(p_np)):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=2e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("opt", OPTS, ids=IDS)
+def test_per_leaf_path_is_bit_identical_to_fused(opt, tree, grads):
+    """The knob-flip contract: update() (per-leaf traversal) and
+    fused_update() share one flat state layout and produce BIT-equal
+    parameters under jit, so the autotuner's fused_optimizer flip is a
+    pure performance decision — training numerics cannot move."""
+    st = opt.init(tree)
+
+    @jax.jit
+    def fused(g, s, p):
+        return opt.fused_update(g, s, p)
+
+    @jax.jit
+    def per_leaf(g, s, p):
+        upd, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s2
+
+    pf, sf = fused(grads, st, tree)
+    pl, sl = per_leaf(grads, st, tree)
+    for a, b in zip(_leaves(pf), _leaves(pl)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(_leaves(sf), _leaves(sl)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("opt", OPTS, ids=IDS)
+def test_pallas_and_jnp_backends_bit_identical_under_jit(opt, tree, grads,
+                                                         monkeypatch):
+    """HVD_FUSED_UPDATE_PALLAS forces the backend; under jit (the real
+    execution context — the SPMD step is always compiled) the
+    interpreter-mode Pallas kernel and the jnp expression are BIT
+    identical."""
+    st = opt.init(tree)
+    monkeypatch.setenv("HVD_FUSED_UPDATE_PALLAS", "1")
+    pp, sp = jax.jit(lambda g, s, p: opt.fused_update(g, s, p))(
+        grads, st, tree)
+    monkeypatch.setenv("HVD_FUSED_UPDATE_PALLAS", "0")
+    pj, sj = jax.jit(lambda g, s, p: opt.fused_update(g, s, p))(
+        grads, st, tree)
+    for a, b in zip(_leaves((pp, sp)), _leaves((pj, sj))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mixed_dtype_tree_gets_per_dtype_buffers(rng):
+    tree = {"f32": jnp.asarray(rng.normal(size=(40,)), jnp.float32),
+            "bf16": jnp.asarray(rng.normal(size=(24,)), jnp.bfloat16)}
+    flat, meta = flatten_by_dtype(tree)
+    assert set(flat) == {"float32", "bfloat16"}
+    back = unflatten_by_dtype(flat, meta)
+    for a, b in zip(_leaves(tree), _leaves(back)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    opt = fused_sgd(0.1, momentum=0.9)
+    st = opt.init(tree)
+    assert set(st.mu) == {"float32", "bfloat16"}
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.ones_like(p), tree)
+    p2, st2 = opt.fused_update(grads, st, tree)
+    for a, b in zip(_leaves(tree), _leaves(p2)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fused optimizer"):
+        FusedOptimizer(kind="rmsprop")
+
+
+# ---------------------------------------------------------------------------
+# training-step integration: donation, scan carries, error feedback
+# ---------------------------------------------------------------------------
+def _mlp_problem(rng):
+    import optax as _optax
+
+    from horovod_tpu.models.mlp import MLP
+
+    model = MLP(features=(16, 4))
+
+    def loss_fn(logits, labels):
+        return _optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    return model, loss_fn, x, y
+
+
+def _drive(model, loss_fn, x, y, opt, *, steps=3, **mk):
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    step = make_train_step(
+        apply_fn=lambda v, a, train=True: model.apply(v, a),
+        loss_fn=loss_fn, optimizer=opt, **mk)
+    state = init_train_state(model, opt, jnp.zeros((2, 8)))
+    xs, ys = shard_batch(x), shard_batch(y)
+    loss = None
+    for _ in range(steps):
+        state, loss = step(state, xs, ys)
+    return state, float(np.asarray(jax.device_get(loss)))
+
+
+def test_donation_safety_fused_vs_undonated(hvd_init, rng):
+    """donate=True must produce the same trajectory as donate=False:
+    the fused path writes fresh buffers from the flat views, so a
+    donated state can never surface a stale buffer."""
+    model, loss_fn, x, y = _mlp_problem(rng)
+    opt = fused_sgd(0.05, momentum=0.9)
+    s_don, l_don = _drive(model, loss_fn, x, y, opt, donate=True)
+    s_ref, l_ref = _drive(model, loss_fn, x, y, opt, donate=False)
+    assert l_don == l_ref
+    for a, b in zip(_leaves(s_don.params), _leaves(s_ref.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_vs_plain_optax_train_step_losses_match(hvd_init, rng):
+    """End to end through make_train_step: the fused optimizer's
+    trajectory matches plain optax to fp32 tolerance (the ISSUE's
+    'losses bit-equal or pinned-tolerance equal' acceptance)."""
+    model, loss_fn, x, y = _mlp_problem(rng)
+    _, l_fused = _drive(model, loss_fn, x, y,
+                        fused_sgd(0.05, momentum=0.9), donate=False)
+    _, l_ref = _drive(model, loss_fn, x, y,
+                      optax.sgd(0.05, momentum=0.9), donate=False)
+    np.testing.assert_allclose(l_fused, l_ref, rtol=1e-6)
+
+
+def test_fused_composes_with_in_graph_steps(hvd_init, rng):
+    """K scanned in-graph steps over the fused update == K sequential
+    calls — the FusedOptState structure is scan-carry stable."""
+    model, loss_fn, x, y = _mlp_problem(rng)
+    opt = fused_sgd(0.05, momentum=0.9)
+    s_seq, l_seq = _drive(model, loss_fn, x, y, opt, steps=4,
+                          donate=False)
+    s_scan, l_scan = _drive(model, loss_fn, x, y, opt, steps=1,
+                            donate=False, in_graph_steps=4)
+    np.testing.assert_allclose(l_seq, l_scan, rtol=1e-5)
+    for a, b in zip(_leaves(s_seq.params), _leaves(s_scan.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert int(s_scan.step) == 4
+
+
+def test_fused_composes_with_error_feedback(hvd_init, rng):
+    """Error-feedback int8 compression + the fused update: the residual
+    threads TrainState.residual as usual (the reduce and the update are
+    independent blocks) — and with in_graph_steps > 1 the pre-built
+    residual carry survives the scan."""
+    from horovod_tpu.ops.compression import Compression
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model, loss_fn, x, y = _mlp_problem(rng)
+    opt = fused_sgd(0.05, momentum=0.9)
+    comp = Compression.lookup("int8", error_feedback=True)
+    for igs in (1, 2):
+        step = make_train_step(
+            apply_fn=lambda v, a, train=True: model.apply(v, a),
+            loss_fn=loss_fn, optimizer=opt, compression=comp,
+            donate=False, in_graph_steps=igs)
+        state = init_train_state(model, opt, jnp.zeros((2, 8)),
+                                 compression=comp)
+        xs, ys = shard_batch(x), shard_batch(y)
+        for _ in range(2):
+            state, loss = step(state, xs, ys)
+        assert np.isfinite(float(np.asarray(loss)))
+        assert jax.tree_util.tree_leaves(state.residual)
+        assert isinstance(state.opt_state, FusedOptState)
+
+
+def test_knob_flip_mid_job_keeps_state_layout(hvd_init, rng):
+    """The autotuner's fused_optimizer flip re-jits but does NOT
+    migrate optimizer state: a compute-only plan flipping the knob off
+    then back on keeps training bit-for-bit on the same trajectory as
+    never flipping (both paths share the flat layout AND the math)."""
+    from horovod_tpu.optim.profile_guided import FusionPlanSpec
+    from horovod_tpu.training import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    model, loss_fn, x, y = _mlp_problem(rng)
+    opt = fused_sgd(0.05, momentum=0.9)
+
+    def build():
+        step = make_train_step(
+            apply_fn=lambda v, a, train=True: model.apply(v, a),
+            loss_fn=loss_fn, optimizer=opt, autotune=True, donate=False)
+        state = init_train_state(model, opt, jnp.zeros((2, 8)))
+        return step, state, shard_batch(x), shard_batch(y)
+
+    step, state, xs, ys = build()
+    state, _ = step(state, xs, ys)
+    step.parameter_manager.apply_plan(FusionPlanSpec(
+        buckets=[], compute={"fused_optimizer": False}))
+    state, _ = step(state, xs, ys)
+    step.parameter_manager.clear_plan()
+    state, loss_flipped = step(state, xs, ys)
+
+    step2, state2, xs, ys = build()
+    for _ in range(3):
+        state2, loss_straight = step2(state2, xs, ys)
+    assert float(np.asarray(loss_flipped)) == \
+        float(np.asarray(loss_straight))
+    for a, b in zip(_leaves(state.params), _leaves(state2.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
