@@ -65,6 +65,7 @@ COMPRESSION_TIMEOUT_S = 420  # compressed comparison run (one compile)
 SERVE_TIMEOUT_S = 180      # serving fixture: a few MLP compiles + ~1.5 s trace
 PROJECTION_TIMEOUT_S = 240  # digital-twin leg: two traced MLP drives (1 + 8 dev)
 COMPUTE_OPT_TIMEOUT_S = 240  # compute-path A/B: two MLP drives + a profiler window
+CONTROL_TIMEOUT_S = 120    # control-plane churn: ~5k loopback HTTP requests
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -225,6 +226,58 @@ def _measure_compute_opt() -> None:
         "host_gap_pct": out["host_gap_pct"],
         "compute_opt_loss_equal": out["loss_equal"],
     }))
+
+
+def _measure_control() -> None:
+    """Child-process entry for the control-plane churn leg: the
+    simulated 64-host/512-rank heartbeat/metrics/fingerprint storm of
+    scripts/control_plane_bench.py against a real sharded rendezvous
+    server (docs/control_plane.md).  Pure host-side machinery — no
+    accelerator involved — so it runs anywhere; the tracked numbers are
+    the relay-vs-per-rank request reduction and the p99 lease-renewal /
+    epoch-commit latencies."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from control_plane_bench import run_bench
+
+    out = run_bench(hosts=64, ranks=512, ticks=3)
+    print("RESULT " + json.dumps({
+        "control_p99_lease_ms": out["p99_lease_renewal_ms"],
+        "control_p99_epoch_ms": out["p99_epoch_commit_ms"],
+        "control_abort_ms": out["abort_propagation_ms"],
+        "control_request_reduction_x": out["request_reduction_x"],
+    }))
+
+
+def _control_leg() -> dict:
+    """The control-plane tail fields, from a separately-timed child so
+    a hung or failed churn run can never cost the main number
+    (HVD_BENCH_CONTROL=0 skips).  ``control_p99_*`` are null on any
+    failure — same contract as every other leg."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_CONTROL, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-control", CONTROL_TIMEOUT_S)
+        if payload is not None:
+            return {
+                "control_p99_lease_ms": payload.get("control_p99_lease_ms"),
+                "control_p99_epoch_ms": payload.get("control_p99_epoch_ms"),
+                "control_abort_ms": payload.get("control_abort_ms"),
+                "control_request_reduction_x":
+                    payload.get("control_request_reduction_x"),
+            }
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"control_p99_lease_ms": None, "control_p99_epoch_ms": None,
+            "control_abort_ms": None, "control_request_reduction_x": None,
+            "control_error": reason}
 
 
 def _compute_opt_leg() -> dict:
@@ -445,6 +498,10 @@ def main() -> None:
             # fused-update + async-pipeline on-vs-off delta and the
             # async pipeline's host_gap_pct, alongside mfu
             out.update(_compute_opt_leg())
+            # control-plane tail (HVD_BENCH_CONTROL=0 skips): churn-
+            # harness p99 lease/epoch latencies + relay request
+            # reduction — the control plane's own tracked numbers
+            out.update(_control_leg())
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -474,6 +531,8 @@ if __name__ == "__main__":
         _measure_projection()
     elif "--child-compute-opt" in sys.argv:
         _measure_compute_opt()
+    elif "--child-control" in sys.argv:
+        _measure_control()
     elif "--child" in sys.argv:
         _measure()
     else:

@@ -76,6 +76,11 @@ GC_WINDOW = 64
 #: how many recent shared dispatches per peer the ordering index keeps
 ORDER_WINDOW = 32
 
+#: scope-cache size that triggers GC-window-based pruning (the bound is
+#: soft: a 1k-rank world legitimately holds ranks x groups live streams,
+#: and pruning must never evict a stream's newest fingerprint)
+CACHE_SOFT_LIMIT = 16384
+
 
 class CollectiveDivergenceError(RuntimeError):
     """Ranks disagreed on which collective to run next (or one rank never
@@ -191,6 +196,13 @@ class Sanitizer:
         self._clock = 0
         self._order = OrderIndex()
         self._lock = threading.Lock()
+        # batched peer reads (docs/control_plane.md): one GET
+        # /scope/sanitizer?since=<cursor> per poll round replaces a GET
+        # per peer; the cache holds every decoded fingerprint the
+        # cursor has swept past, pruned by the peers' own GC deletes
+        self._cursor: Optional[int] = None
+        self._scope_server: Optional[str] = None
+        self._scope_cache: Dict[str, dict] = {}
 
     # -- internals -----------------------------------------------------------
     def _epoch(self) -> int:
@@ -241,6 +253,75 @@ class Sanitizer:
         metrics.SANITIZER_MISMATCHES.inc()
         raise CollectiveDivergenceError(msg)
 
+    def _publish(self, key: str, fp: dict) -> None:
+        """PUT this rank's fingerprint — through the host relay when
+        one is discoverable (the storm batches into O(hosts) upstream
+        requests), direct otherwise, with the shared pass-through
+        fallback (run/relay.py control_put)."""
+        from ..run import relay
+        from ..run.http_server import SANITIZER_SCOPE
+
+        relay.control_put(self.addr, self.port, SANITIZER_SCOPE, key,
+                          json.dumps(fp).encode(), secret=self.secret)
+
+    def _refresh_scope(self) -> None:
+        """One batched scope read: advance the cursor, fold changed
+        fingerprints into the cache, drop GC'd keys, and reset
+        everything when the server incarnation changed (failover)."""
+        from ..run.http_client import get_scope
+        from ..run.http_server import SANITIZER_SCOPE
+
+        resp = get_scope(self.addr, self.port, SANITIZER_SCOPE,
+                         since=self._cursor, secret=self.secret)
+        sid = resp.get("server_id")
+        if resp.get("full") or sid != self._scope_server:
+            self._scope_cache.clear()
+            self._scope_server = sid
+        self._cursor = resp.get("version")
+        for key, raw in resp.get("entries", {}).items():
+            try:
+                self._scope_cache[key] = json.loads(raw)
+            except (ValueError, TypeError):
+                self._scope_cache[key] = {
+                    "op": "<undecodable>", "name": "", "shape": [],
+                    "dtype": "", "clock": 0}
+        for key in resp.get("removed", ()):
+            self._scope_cache.pop(key, None)
+        if len(self._scope_cache) > CACHE_SOFT_LIMIT:
+            self._prune_cache()
+
+    def _prune_cache(self) -> None:
+        """Bound the cache by each (group, epoch, rank)'s sequence
+        window, mirroring the peers' own GC: entries more than
+        GC_WINDOW behind that stream's newest seq can never be needed
+        again.  Never evicts a stream's NEWEST fingerprint — dropping a
+        peer's current entry would strand it past the cursor and turn a
+        healthy peer into a false silent-peer divergence (keys are
+        ``<group>.<epoch>.<seq>.<rank>``; group slugs are dot-free by
+        :func:`group_key`)."""
+
+        def parse(key):
+            parts = key.rsplit(".", 3)
+            if len(parts) != 4 or not parts[2].isdigit():
+                return None
+            return (parts[0], parts[1], parts[3]), int(parts[2])
+
+        newest: Dict[Tuple, int] = {}
+        for key in self._scope_cache:
+            parsed = parse(key)
+            if parsed is None:
+                continue
+            stream, seq = parsed
+            newest[stream] = max(newest.get(stream, -1), seq)
+        for key in list(self._scope_cache):
+            parsed = parse(key)
+            if parsed is None:
+                self._scope_cache.pop(key, None)
+                continue
+            stream, seq = parsed
+            if seq < newest[stream] - GC_WINDOW:
+                self._scope_cache.pop(key, None)
+
     # -- the check -----------------------------------------------------------
     def check(self, *, op: str, name: str, shape: Sequence[int], dtype,
               group: str = WORLD_GROUP,
@@ -251,9 +332,13 @@ class Sanitizer:
         (default: all ranks — the flat world).  Returns the per-(group,
         epoch) sequence number it verified; raises
         CollectiveDivergenceError on signature divergence, a silent
-        peer, or a cross-group ordering inversion."""
-        from ..run.http_client import get_kv, put_kv
-        from ..run.http_server import SANITIZER_SCOPE
+        peer, or a cross-group ordering inversion.
+
+        The peer wait is batched (docs/control_plane.md): every poll
+        round is ONE cursor-based scope read covering all peers of all
+        groups, instead of a GET per peer — the O(ranks x groups) poll
+        traffic this plane used to put on the rendezvous server."""
+        import time as _time
 
         from .. import metrics
 
@@ -271,16 +356,46 @@ class Sanitizer:
             self._gc_epoch(group, retired_epoch)
         mine = fingerprint(seq, op=op, name=name, shape=shape, dtype=dtype,
                            group=group, epoch=epoch, clock=clock)
-        put_kv(self.addr, self.port, SANITIZER_SCOPE,
-               self._kv_key(group, match_epoch, seq, self.rank),
-               json.dumps(mine).encode(), self.secret)
-        for peer in members:
-            if peer == self.rank:
-                continue
-            raw = get_kv(self.addr, self.port, SANITIZER_SCOPE,
-                         self._kv_key(group, match_epoch, seq, peer),
-                         self.secret, wait=True, timeout=self.timeout)
-            if raw is None:
+        self._publish(self._kv_key(group, match_epoch, seq, self.rank),
+                      mine)
+        need = {peer: self._kv_key(group, match_epoch, seq, peer)
+                for peer in members if peer != self.rank}
+        deadline = _time.monotonic() + self.timeout
+        delay = 0.01
+        while need:
+            self._refresh_scope()
+            for peer in sorted(need):
+                theirs = self._scope_cache.get(need[peer])
+                if theirs is None:
+                    continue
+                if {k: theirs.get(k) for k in ("op", "name", "shape",
+                                               "dtype")} \
+                        != {k: mine[k] for k in ("op", "name", "shape",
+                                                 "dtype")}:
+                    self._raise(
+                        f"collective sanitizer: divergence at sequence "
+                        f"{seq} of group '{group}' (epoch {epoch}) — rank "
+                        f"{self.rank} dispatched {_sig(mine)} but rank "
+                        f"{peer} dispatched {_sig(theirs)}"
+                    )
+                inverted = self._order.observe(
+                    peer, (group, match_epoch, seq), clock,
+                    int(theirs.get("clock", 0)))
+                if inverted is not None:
+                    g2, _, s2 = inverted
+                    self._raise(
+                        "collective sanitizer: cross-group ordering "
+                        f"inversion — rank {self.rank} issued sequence "
+                        f"{s2} of group '{g2}' before sequence {seq} of "
+                        f"group '{group}' ({_sig(mine)}), but rank {peer} "
+                        "issued them in the opposite order; each rank "
+                        "blocks in a different group's collective"
+                    )
+                del need[peer]
+            if not need:
+                break
+            if _time.monotonic() >= deadline:
+                peer = min(need)
                 self._raise(
                     f"collective sanitizer: rank {peer} published no "
                     f"fingerprint for sequence {seq} of group '{group}' "
@@ -291,35 +406,15 @@ class Sanitizer:
                     "upstream, or a different membership epoch under "
                     "HVD_SANITIZER_EPOCH_STRICT)"
                 )
-            theirs = json.loads(raw)
-            if {k: theirs.get(k) for k in ("op", "name", "shape", "dtype")} \
-                    != {k: mine[k] for k in ("op", "name", "shape",
-                                             "dtype")}:
-                self._raise(
-                    f"collective sanitizer: divergence at sequence {seq} "
-                    f"of group '{group}' (epoch {epoch}) — rank "
-                    f"{self.rank} dispatched {_sig(mine)} but rank {peer} "
-                    f"dispatched {_sig(theirs)}"
-                )
-            inverted = self._order.observe(
-                peer, (group, match_epoch, seq), clock,
-                int(theirs.get("clock", 0)))
-            if inverted is not None:
-                g2, _, s2 = inverted
-                self._raise(
-                    "collective sanitizer: cross-group ordering inversion "
-                    f"— rank {self.rank} issued sequence {s2} of group "
-                    f"'{g2}' before sequence {seq} of group '{group}' "
-                    f"({_sig(mine)}), but rank {peer} issued them in the "
-                    "opposite order; each rank blocks in a different "
-                    "group's collective"
-                )
+            _time.sleep(delay)
+            delay = min(delay * 1.5, 0.25)
         metrics.SANITIZER_CHECKS.inc()
         if seq >= GC_WINDOW:
             # best-effort GC of this rank's own stale fingerprint — a
             # long job must not grow the launcher's store without bound
             try:
                 from ..run.http_client import delete_kv
+                from ..run.http_server import SANITIZER_SCOPE
 
                 delete_kv(self.addr, self.port, SANITIZER_SCOPE,
                           self._kv_key(group, match_epoch,

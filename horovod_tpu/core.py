@@ -274,6 +274,16 @@ def init(
         from .timeline.timeline import timeline
 
         timeline.initialize()
+        # Per-host relay election (run/relay.py, HVD_RELAY=1): local
+        # rank 0 stands up the aggregator BEFORE the pusher/heartbeat
+        # resolve their control endpoint, so this host's batchable
+        # traffic rides one upstream connection from the first beat.
+        try:
+            from .run import relay
+
+            relay.start_from_env()
+        except Exception as e:  # noqa: BLE001 — the relay is an
+            log.warning("relay setup failed: %s", e)  # optimization
         # Live metrics export: when the launcher stood up a rendezvous
         # server and passed its address (HVD_METRICS_KV_*), start pushing
         # this rank's snapshots so the launcher's GET /metrics sees us.
@@ -320,6 +330,12 @@ def shutdown() -> None:
         from .elastic import heartbeat
 
         heartbeat.stop()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .run import relay
+
+        relay.stop()  # drains one final upstream flush
     except Exception:  # noqa: BLE001
         pass
     with _lock:

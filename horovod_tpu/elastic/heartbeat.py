@@ -85,10 +85,14 @@ class HeartbeatThread(threading.Thread):
             self.beat()
 
     def beat(self) -> None:
-        """One tick: renew the lease, then poll the abort flag.  Never
-        raises — a flaky rendezvous link must not take the rank down; the
+        """One tick: renew the lease AND learn the abort verdict in the
+        same round trip — the renewal's reply carries the flag
+        (run/http_server.py ``_apply_one``; through a per-host relay the
+        reply serves the relay's flush-refreshed cache).  Never raises —
+        a flaky rendezvous link must not take the rank down; the
         retrying HTTP client (HVD_HTTP_RETRIES) absorbs transients."""
-        from ..run.http_client import get_kv, put_kv
+        from ..run import relay
+        from ..run.http_client import get_kv
 
         lease = {
             "rank": self.rank,
@@ -96,10 +100,15 @@ class HeartbeatThread(threading.Thread):
             "interval": self.interval,
             "pid": os.getpid(),
         }
+        reply = None
         try:
             if self.renew:
-                put_kv(self.addr, self.port, HEALTH_SCOPE, str(self.rank),
-                       json.dumps(lease).encode(), secret=self.secret)
+                # through the host relay when one is resolved, with the
+                # shared permanent fallback to the direct path
+                reply = relay.control_put(
+                    self.addr, self.port, HEALTH_SCOPE, str(self.rank),
+                    json.dumps(lease).encode(), secret=self.secret,
+                    want_reply=True)
             self.beats += 1
             from .. import metrics
 
@@ -107,39 +116,55 @@ class HeartbeatThread(threading.Thread):
                 metrics.HEARTBEATS.inc()
         except Exception as e:  # noqa: BLE001
             log.debug("heartbeat lease renewal failed: %s", e)
+        if reply is not None and "abort" in reply:
+            info = reply.get("abort")
+            if isinstance(info, dict):
+                self._observe_abort(info)
+            return
+        # abort-poll-only mode (renew=False), a failed renewal, or a
+        # reply without the piggyback: fall back to the explicit GET
         try:
             raw = get_kv(self.addr, self.port, ABORT_SCOPE, ABORT_KEY,
                          secret=self.secret)
         except Exception as e:  # noqa: BLE001
             log.debug("heartbeat abort poll failed: %s", e)
             return
-        if raw is not None and self.abort_info is None:
+        if raw is not None:
             try:
                 info = json.loads(raw)
             except (ValueError, TypeError):
                 info = {"reason": "<undecodable abort flag>",
                         "source": "unknown"}
-            flag_epoch = info.get("epoch") if isinstance(info, dict) else None
-            try:
-                flag_epoch = int(flag_epoch) if flag_epoch is not None \
-                    else None
-            except (TypeError, ValueError):
-                flag_epoch = None  # malformed epoch: honor like epoch-less
-            if flag_epoch is not None and flag_epoch < self.epoch:
-                log.debug("ignoring stale abort flag for epoch %s "
-                          "(this rank is in epoch %d)", flag_epoch, self.epoch)
-                return
-            self.abort_info = info
-            log.error("heartbeat observed %s", format_abort(self.abort_info))
-            from .. import metrics
+            if not isinstance(info, dict):
+                info = {"reason": repr(info), "source": "unknown"}
+            self._observe_abort(info)
 
-            if metrics.on():
-                metrics.ABORTS.labels("observed").inc()
-            # Keep renewing the lease: an elastic survivor lives on and
-            # rebuilds, and the gap until it reaches the abort seam can
-            # be a whole step or checkpoint save — letting the lease die
-            # here reads as a SECOND failure to the driver.  Fail-stop
-            # jobs exit moments later and server-side expiry reaps them.
+    def _observe_abort(self, info: dict) -> None:
+        """Record an observed abort flag (once), honoring the epoch
+        filter: flags stamped with an OLDER epoch are stale."""
+        if self.abort_info is not None:
+            return
+        flag_epoch = info.get("epoch")
+        try:
+            flag_epoch = int(flag_epoch) if flag_epoch is not None \
+                else None
+        except (TypeError, ValueError):
+            flag_epoch = None  # malformed epoch: honor like epoch-less
+        if flag_epoch is not None and flag_epoch < self.epoch:
+            log.debug("ignoring stale abort flag for epoch %s "
+                      "(this rank is in epoch %d)", flag_epoch, self.epoch)
+            return
+        self.abort_info = info
+        log.error("heartbeat observed %s", format_abort(self.abort_info))
+        from .. import metrics
+
+        if metrics.on():
+            metrics.ABORTS.labels("observed").inc()
+        # Keep renewing the lease: an elastic survivor lives on and
+        # rebuilds, and the gap until it reaches the abort seam can
+        # be a whole step or checkpoint save — letting the lease die
+        # here reads as a SECOND failure to the driver.  Fail-stop
+        # jobs exit moments later and server-side expiry reaps them.
 
     def stop(self) -> None:
         self._stop_event.set()

@@ -205,6 +205,29 @@ HTTP_RETRIES = registry.counter(
     "hvd_http_retries_total",
     "Rendezvous HTTP requests retried after a transient failure "
     "(URLError or 5xx).")
+HTTP_REUSE = registry.counter(
+    "hvd_http_reuse_total",
+    "Rendezvous HTTP requests served over a pooled keep-alive "
+    "connection instead of a fresh TCP connect (run/http_client.py).")
+CP_FAILOVERS = registry.counter(
+    "hvd_cp_failovers_total",
+    "Requests that abandoned a dead rendezvous address for the next "
+    "entry of the HVD_RENDEZVOUS_ADDRS failover list.")
+RELAY_FLUSHES = registry.counter(
+    "hvd_relay_flushes_total",
+    "Per-host relay upstream batch flushes (run/relay.py; one PUT "
+    "/batch each, replacing one request per buffered key).")
+RELAY_ENTRIES = registry.counter(
+    "hvd_relay_entries_total",
+    "KV entries the per-host relay aggregated into upstream batches.")
+RELAY_FALLBACKS = registry.counter(
+    "hvd_relay_fallbacks_total",
+    "Control-plane clients that fell back from an unreachable per-host "
+    "relay to the primary rendezvous (pass-through mode).")
+METRICS_DELTA_PUSHES = registry.counter(
+    "hvd_metrics_delta_pushes_total",
+    "Metric snapshot pushes sent as family deltas instead of full "
+    "snapshots (metrics/push.py), by outcome.", ("outcome",))
 FAULTS_INJECTED = registry.counter(
     "hvd_faults_injected_total",
     "Faults injected by the HVD_FAULT_SPEC harness, by kind.", ("kind",))

@@ -55,6 +55,10 @@ _CONFIG_SCHEMA = {
         "slo_ms": "serve_slo_ms",
         "autoscale": "serve_autoscale",
     },
+    "control_plane": {
+        "relay": "relay",
+        "journal": "journal",
+    },
     "logging": {
         "level": "log_level",
         "hide_timestamp": "log_hide_timestamp",

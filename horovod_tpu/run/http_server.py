@@ -29,6 +29,33 @@ clock at receipt), ``GET /health`` reports per-rank lease age with
 live/stale/dead verdicts plus the job-wide abort flag, and the
 ``/abort/flag`` key is the coordinated-abort protocol's single source of
 truth.
+
+**Control-plane tier (docs/control_plane.md).**  The store behind this
+surface is the sharded :class:`~horovod_tpu.run.store.ShardedKVStore`
+(``HVD_CP_SHARDS`` independent dict+lock shards with per-scope change
+tracking), and three wire additions make thousand-rank worlds cheap and
+survivable:
+
+* ``PUT /batch`` — one signed request carrying many KV entries
+  (``{"entries": [{"p": "/scope/key", "v": <base64>}, ...]}``), the
+  upstream leg of the per-host relay tree (run/relay.py).  The reply
+  carries the job-wide abort flag and the ``server_id``.
+* ``GET /scope/<name>?since=V`` — scope-level batch read: only the keys
+  changed after version ``V`` (plus removals), with a full-resync
+  answer when the cursor predates the retained history.  The path
+  prefix ``/scope/`` is reserved — a KV scope literally named "scope"
+  cannot be served.
+* a ``PUT`` under ``/health/`` answers with the abort verdict in the
+  response body, collapsing the heartbeat's renew + abort-poll pair
+  into one round trip (elastic/heartbeat.py).
+
+Writes to ``/membership/epoch`` are **fenced**: an epoch that does not
+advance the committed one is rejected (HTTP 409 /
+:class:`EpochFencedError`), so a stale primary resurrected after a
+warm-standby takeover (run/journal.py) cannot roll the world back.
+``server_id`` (a per-incarnation random token carried in mutating
+replies and scope reads) is how clients detect a failover and resync
+their delta/cursor state.
 """
 
 from __future__ import annotations
@@ -39,10 +66,13 @@ import json
 import socket
 import threading
 import time
+import uuid
+from base64 import b64decode, b64encode
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from ..utils.logging import get_logger
+from .store import ShardedKVStore
 
 log = get_logger(__name__)
 
@@ -113,6 +143,8 @@ STATE_PREFIX = "state."
 DRAIN_PREFIX = "drain."
 DRAIN_ACK_PREFIX = "drain_ack."
 
+EPOCH_PATH = f"/{MEMBERSHIP_SCOPE}/{EPOCH_KEY}"
+
 # serving plane (horovod_tpu/serving/, docs/inference.md): tpurun
 # --serve attaches a ServingFrontend to this server — signed POST
 # /infer (one inference request), POST /serving/pull + /serving/result
@@ -123,6 +155,20 @@ DRAIN_ACK_PREFIX = "drain_ack."
 #: ``dead`` past DEAD_FACTOR — the server-side lease expiry.
 STALE_FACTOR = 2.0
 DEAD_FACTOR = 4.0
+
+
+#: the batched-write route (one request, many KV entries) and the
+#: reserved scope-read route prefix (GET /scope/<name>?since=V)
+BATCH_PATH = "/batch"
+SCOPE_ROUTE_PREFIX = "/scope/"
+
+
+class EpochFencedError(RuntimeError):
+    """A ``/membership/epoch`` write did not advance the committed
+    epoch.  Raised on the in-process path; the HTTP surface answers
+    409.  This is the split-brain fence: after a standby takeover, a
+    resurrected stale primary (or a partitioned driver) cannot commit a
+    regressed world."""
 
 
 def sign(secret: bytes, path: str, body: bytes = b"") -> str:
@@ -270,11 +316,128 @@ def build_autotune_report(store: Dict[str, bytes]) -> Dict[str, object]:
     return report
 
 
+def _decode_abort(store) -> Optional[object]:
+    """The job-wide abort flag, parsed (None when unset) — piggybacked
+    on health-renewal and /batch replies so one round trip answers both
+    "lease renewed" and "is the job aborting"."""
+    raw = store.get(f"/{ABORT_SCOPE}/{ABORT_KEY}")
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except (ValueError, TypeError):
+        return {"reason": "<undecodable abort flag>"}
+
+
+def _epoch_of(value: bytes) -> Optional[int]:
+    try:
+        rec = json.loads(value)
+        return int(rec.get("epoch"))
+    except (ValueError, TypeError, AttributeError):
+        return None
+
+
+def apply_put(httpd, path: str, value: bytes) -> None:
+    """One KV write — the single choke point shared by ``do_PUT``,
+    ``PUT /batch``, and the in-process :meth:`RendezvousServer.put`:
+    fences ``/membership/epoch`` regressions (:class:`EpochFencedError`)
+    and stamps health leases on the server's clock."""
+    store = httpd.store
+    if path == EPOCH_PATH:
+        # check-then-put under one lock: two concurrent writers (the
+        # live driver and a partitioned stale one — the very race the
+        # fence exists for) must serialize, or both could pass the
+        # check against the same committed epoch
+        with httpd.fence_lock:
+            new = _epoch_of(value)
+            cur_raw = store.get(EPOCH_PATH)
+            if cur_raw is not None:
+                cur = _epoch_of(cur_raw)
+                if cur is not None and (new is None or new < cur):
+                    raise EpochFencedError(
+                        f"membership epoch write ({new}) does not advance "
+                        f"the committed epoch ({cur}); rejected by the "
+                        "split-brain fence")
+            store.put(path, value)
+        return
+    store.put(path, value)
+    if path.startswith(_HEALTH_PREFIX):
+        # the lease stamp: receipt on the SERVER clock, so age /
+        # expiry never depend on worker clocks (GET /health)
+        with httpd.lock:
+            httpd.lease_times[path] = time.monotonic()
+
+
+class _DeltaResync(Exception):
+    """A metrics delta PUT cannot be merged (unknown base incarnation
+    or no stored snapshot): the pusher must resend a full snapshot."""
+
+
+def _parse_metrics_delta(body: bytes) -> Optional[dict]:
+    """Decode a metrics-scope PUT body as a delta payload, or None for
+    a plain full snapshot.  Deltas are written with ``__delta__`` as
+    the first key (metrics/push.py), so the cheap prefix check keeps
+    full-snapshot PUTs off the JSON parser twice."""
+    if b'"__delta__"' not in body[:32]:
+        return None
+    try:
+        payload = json.loads(body)
+    except (ValueError, TypeError):
+        return None
+    if isinstance(payload, dict) and payload.get("__delta__"):
+        return payload
+    return None
+
+
+def _merge_metrics_delta(store, path: str, delta: dict,
+                         server_id: str) -> bytes:
+    """Merge a delta push into the stored full snapshot; raises
+    :class:`_DeltaResync` when the delta's base incarnation is not this
+    server (restart/failover) or there is nothing to merge into."""
+    if delta.get("base_id") != server_id:
+        raise _DeltaResync()
+    cur_raw = store.get(path)
+    if cur_raw is None:
+        raise _DeltaResync()
+    try:
+        cur = json.loads(cur_raw)
+    except (ValueError, TypeError):
+        raise _DeltaResync()
+    fams = cur.get("metrics")
+    if not isinstance(fams, dict):
+        raise _DeltaResync()
+    changed = delta.get("metrics")
+    if isinstance(changed, dict):
+        fams.update(changed)
+    for name in delta.get("removed") or ():
+        fams.pop(name, None)
+    cur["ts"] = delta.get("ts", time.time())
+    return json.dumps(cur).encode()
+
+
 class KVStoreHandler(BaseHTTPRequestHandler):
     """GET /scope/key → 200 bytes | 404; PUT stores; DELETE /scope
     finalizes the scope (rendezvous complete)."""
 
     protocol_version = "HTTP/1.1"
+    # reap idle keep-alive connections (run/http_client.py pools one
+    # connection per client thread) instead of holding a server thread
+    # per dead client forever
+    timeout = 65
+    # small replies written in several send() calls + Nagle + the
+    # client's delayed ACK = ~40 ms per exchange on a keep-alive
+    # connection; the control plane lives on small exchanges
+    disable_nagle_algorithm = True
+
+    def _count(self) -> None:
+        if getattr(self.server, "rdv_dead", False):
+            # stop() ran but this keep-alive connection's handler thread
+            # is still alive: a stopped server must look DEAD to pooled
+            # clients (connection aborted → their failover path), not
+            # like a live store serving a stale world
+            raise ConnectionAbortedError("rendezvous server stopped")
+        with self.server.count_lock:  # type: ignore[attr-defined]
+            self.server.requests_served += 1  # type: ignore[attr-defined]
 
     def _verify(self, body: bytes = b"") -> bool:
         secret = self.server.secret  # type: ignore[attr-defined]
@@ -299,10 +462,9 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         the launcher's own in-process registry last."""
         from ..metrics.registry import registry
 
-        store: Dict[str, bytes] = self.server.store  # type: ignore
-        with self.server.lock:  # type: ignore
-            pushed = {k[len(_METRICS_PREFIX):]: v for k, v in store.items()
-                      if k.startswith(_METRICS_PREFIX)}
+        store: ShardedKVStore = self.server.store  # type: ignore
+        pushed = {k[len(_METRICS_PREFIX):]: v
+                  for k, v in store.prefix_items(_METRICS_PREFIX).items()}
         snaps = []
         for rank in sorted(pushed, key=lambda r: (not r.isdigit(), int(r)
                                                   if r.isdigit() else 0, r)):
@@ -322,10 +484,9 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         sanitizer (or an operator) is chasing a divergence.  Keys are
         ``<group>.<epoch>.<seq>.<rank>`` (analysis/sanitizer.py); legacy
         two-part ``<seq>.<rank>`` keys render under ``world`` epoch 0."""
-        store: Dict[str, bytes] = self.server.store  # type: ignore
-        with self.server.lock:  # type: ignore
-            raw = {k[len(_SANITIZER_PREFIX):]: v for k, v in store.items()
-                   if k.startswith(_SANITIZER_PREFIX)}
+        store: ShardedKVStore = self.server.store  # type: ignore
+        raw = {k[len(_SANITIZER_PREFIX):]: v
+               for k, v in store.prefix_items(_SANITIZER_PREFIX).items()}
         table: Dict[str, Dict[str, Dict[str, object]]] = {}
         for key, val in raw.items():
             parts = key.split(".")
@@ -348,23 +509,50 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         """Per-rank lease ages and verdicts plus the abort flag, so one
         GET answers both "who is alive" and "is the job aborting"."""
         with self.server.lock:  # type: ignore
-            return build_health_report(
-                dict(self.server.store),  # type: ignore
-                dict(self.server.lease_times),  # type: ignore
-            )
+            lease_times = dict(self.server.lease_times)  # type: ignore
+        return build_health_report(
+            self.server.store.items(), lease_times)  # type: ignore
 
     def do_GET(self) -> None:  # noqa: N802
+        self._count()
         if not self._verify():
             self._reply(401)
             return
-        path = self.path.rstrip("/")
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/")
+        if path.startswith(SCOPE_ROUTE_PREFIX) and "since=" in query:
+            # scope-level batch read with a change cursor: GET
+            # /scope/<name>?since=V (docs/control_plane.md).  The
+            # ``since`` parameter is what selects this route — clients
+            # always send one (-1 = full) — so a plain GET of a KV key
+            # under a scope literally named "scope" still works.
+            from urllib.parse import parse_qs
+
+            scope = path[len(SCOPE_ROUTE_PREFIX):]
+            since = None
+            vals = parse_qs(query).get("since")
+            if vals:
+                try:
+                    since = int(vals[0])
+                except ValueError:
+                    since = None
+            res = self.server.store.scope_since(scope, since)  # type: ignore
+            body = json.dumps({
+                "server_id": self.server.server_id,  # type: ignore
+                "version": res["version"],
+                "full": res["full"],
+                "entries": {k: b64encode(v).decode()
+                            for k, v in res["entries"].items()},
+                "removed": res["removed"],
+            }).encode()
+            self._reply(200, body, content_type="application/json")
+            return
         if path == "/health":
             self._reply(200, json.dumps(self._health_report()).encode(),
                         content_type="application/json")
             return
         if path == "/membership":
-            with self.server.lock:  # type: ignore
-                store = dict(self.server.store)  # type: ignore
+            store = self.server.store.items()  # type: ignore
             self._reply(200, json.dumps(build_membership_report(store))
                         .encode(), content_type="application/json")
             return
@@ -409,38 +597,32 @@ class KVStoreHandler(BaseHTTPRequestHandler):
                         content_type="application/json")
             return
         if path == "/replay":
-            with self.server.lock:  # type: ignore
-                val = self.server.store.get(  # type: ignore
-                    f"/{REPLAY_SCOPE}/{REPLAY_SUMMARY_KEY}")
+            val = self.server.store.get(  # type: ignore
+                f"/{REPLAY_SCOPE}/{REPLAY_SUMMARY_KEY}")
             if val is None:
                 self._reply(404)
             else:
                 self._reply(200, val, content_type="application/json")
             return
         if path == "/projection":
-            with self.server.lock:  # type: ignore
-                val = self.server.store.get(  # type: ignore
-                    f"/{PROJECTION_SCOPE}/{PROJECTION_SUMMARY_KEY}")
+            val = self.server.store.get(  # type: ignore
+                f"/{PROJECTION_SCOPE}/{PROJECTION_SUMMARY_KEY}")
             if val is None:
                 self._reply(404)
             else:
                 self._reply(200, val, content_type="application/json")
             return
         if path == "/autotune":
-            with self.server.lock:  # type: ignore
-                store = dict(self.server.store)  # type: ignore
+            store = self.server.store.items()  # type: ignore
             self._reply(200, json.dumps(build_autotune_report(store))
                         .encode(), content_type="application/json")
             return
         if path == "/profile":
-            with self.server.lock:  # type: ignore
-                store = dict(self.server.store)  # type: ignore
+            store = self.server.store.items()  # type: ignore
             self._reply(200, json.dumps(build_profile_report(store))
                         .encode(), content_type="application/json")
             return
-        store: Dict[str, bytes] = self.server.store  # type: ignore
-        with self.server.lock:  # type: ignore
-            val = store.get(self.path)
+        val = self.server.store.get(self.path)  # type: ignore
         if val is None:
             self._reply(404)
         else:
@@ -451,6 +633,7 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         KV store itself has no POST surface, so every POST belongs to
         the attached ServingFrontend — 503 when none is attached (the
         job was not launched with ``tpurun --serve``)."""
+        self._count()
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         if not self._verify(body):
@@ -490,29 +673,97 @@ class KVStoreHandler(BaseHTTPRequestHandler):
                     content_type="application/json")
 
     def do_PUT(self) -> None:  # noqa: N802
+        self._count()
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         if not self._verify(body):
             self._reply(401)
             return
-        with self.server.lock:  # type: ignore
-            self.server.store[self.path] = body  # type: ignore
-            if self.path.startswith(_HEALTH_PREFIX):
-                # the lease stamp: receipt on the SERVER clock, so age /
-                # expiry never depend on worker clocks (GET /health)
-                self.server.lease_times[self.path] = (  # type: ignore
-                    time.monotonic())
-        self._reply(200)
+        if self.path == BATCH_PATH:
+            self._handle_batch(body)
+            return
+        try:
+            reply = self._apply_one(self.path, body)
+        except EpochFencedError as e:
+            self._reply(409, json.dumps({"error": str(e)}).encode(),
+                        content_type="application/json")
+            return
+        except _DeltaResync:
+            self._reply(409, json.dumps({
+                "server_id": self.server.server_id,  # type: ignore
+                "resync": True}).encode(),
+                content_type="application/json")
+            return
+        if reply is None:
+            self._reply(200)
+        else:
+            self._reply(200, json.dumps(reply).encode(),
+                        content_type="application/json")
+
+    def _apply_one(self, path: str, body: bytes) -> Optional[dict]:
+        """Store one PUT.  Health renewals answer with the abort
+        verdict (the heartbeat's batched round trip); metrics PUTs may
+        be delta payloads merged server-side; both reply the
+        ``server_id`` so clients detect failovers."""
+        httpd = self.server
+        if path.startswith(_METRICS_PREFIX):
+            delta = _parse_metrics_delta(body)
+            if delta is not None:
+                body = _merge_metrics_delta(
+                    httpd.store, path, delta,  # type: ignore
+                    httpd.server_id)  # type: ignore[attr-defined]
+            apply_put(httpd, path, body)
+            return {"server_id": httpd.server_id}  # type: ignore
+        apply_put(httpd, path, body)
+        if path.startswith(_HEALTH_PREFIX):
+            return {"server_id": httpd.server_id,  # type: ignore
+                    "abort": _decode_abort(httpd.store)}  # type: ignore
+        return None
+
+    def _handle_batch(self, body: bytes) -> None:
+        """``PUT /batch``: apply many KV entries in one signed request
+        (the relay tree's upstream leg).  Undecodable entries are
+        counted and skipped; a fenced epoch write rejects the batch."""
+        try:
+            payload = json.loads(body)
+        except ValueError as e:
+            self._reply(400, json.dumps(
+                {"error": f"undecodable batch body: {e}"}).encode(),
+                content_type="application/json")
+            return
+        applied = skipped = 0
+        try:
+            for entry in payload.get("entries") or ():
+                path = entry.get("p") if isinstance(entry, dict) else None
+                if not isinstance(path, str) or not path.startswith("/"):
+                    skipped += 1
+                    continue
+                try:
+                    value = b64decode(entry.get("v") or "")
+                except (ValueError, TypeError):
+                    skipped += 1
+                    continue
+                apply_put(self.server, path, value)
+                applied += 1
+        except EpochFencedError as e:
+            self._reply(409, json.dumps({"error": str(e)}).encode(),
+                        content_type="application/json")
+            return
+        self._reply(200, json.dumps({
+            "server_id": self.server.server_id,  # type: ignore
+            "abort": _decode_abort(self.server.store),  # type: ignore
+            "applied": applied,
+            "skipped": skipped,
+        }).encode(), content_type="application/json")
 
     def do_DELETE(self) -> None:  # noqa: N802
+        self._count()
         if not self._verify():
             self._reply(401)
             return
-        prefix = self.path.rstrip("/") + "/"
+        deleted = self.server.store.delete_matching(self.path)  # type: ignore
         with self.server.lock:  # type: ignore
-            store = self.server.store  # type: ignore
-            for k in [k for k in store if k.startswith(prefix) or k == self.path]:
-                del store[k]
+            for k in deleted:
                 self.server.lease_times.pop(k, None)  # type: ignore
             # only whole-scope deletes mark rendezvous finalization;
             # per-key deletes (sanitizer fingerprint GC) must not grow
@@ -525,24 +776,84 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         log.debug("kvstore: " + fmt, *args)
 
 
+class QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that does not print tracebacks for expected
+    connection teardowns: a stopped server aborting its keep-alive
+    connections (``rdv_dead``) and clients hanging up mid-request."""
+
+    def handle_error(self, request, client_address):  # noqa: D102
+        import sys as _sys
+
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (ConnectionAbortedError, ConnectionResetError,
+                            BrokenPipeError)):
+            return
+        super().handle_error(request, client_address)
+
+
 class RendezvousServer:
     """Threaded KV server owned by the launcher (reference
     run/http/http_server.py RendezvousServer; started by gloo_run at
     reference run/gloo_run.py:268-272)."""
 
-    def __init__(self, secret: Optional[bytes] = None, port: int = 0):
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), KVStoreHandler)
-        self._httpd.store = {}  # type: ignore[attr-defined]
+    def __init__(self, secret: Optional[bytes] = None, port: int = 0,
+                 journal_path: Optional[str] = None,
+                 shards: Optional[int] = None):
+        self._httpd = QuietThreadingHTTPServer(("0.0.0.0", port),
+                                               KVStoreHandler)
+        store = ShardedKVStore(shards=shards)
+        journal = None
+        if journal_path:
+            import os as _os
+
+            from .journal import Journal, replay
+
+            # recovery BEFORE journaling resumes: a restarted primary
+            # picks its state (and, critically, the committed epoch the
+            # fence compares against) back up from its own journal
+            # instead of starting empty — without re-journaling the
+            # replayed records
+            if _os.path.exists(journal_path):
+                n = replay(journal_path, store)
+                if n:
+                    log.info("rendezvous: recovered %d journal records "
+                             "from %s", n, journal_path)
+            journal = Journal(journal_path)
+            store.journal = journal
+        self._journal = journal
+        self._httpd.store = store  # type: ignore[attr-defined]
+        # guards the non-sharded side state: lease_times + finalized
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.secret = secret  # type: ignore[attr-defined]
         self._httpd.finalized = set()  # type: ignore[attr-defined]
         self._httpd.lease_times = {}  # type: ignore[attr-defined]
         self._httpd.serving_frontend = None  # type: ignore[attr-defined]
+        # per-incarnation identity: clients detect a restart/failover by
+        # the server_id changing in mutating replies and scope reads
+        self._httpd.server_id = uuid.uuid4().hex  # type: ignore
+        self._httpd.requests_served = 0  # type: ignore[attr-defined]
+        self._httpd.count_lock = threading.Lock()  # type: ignore
+        # serializes the /membership/epoch check-then-put (apply_put)
+        self._httpd.fence_lock = threading.Lock()  # type: ignore
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    @property
+    def store(self) -> ShardedKVStore:
+        return self._httpd.store  # type: ignore[attr-defined]
+
+    @property
+    def server_id(self) -> str:
+        return self._httpd.server_id  # type: ignore[attr-defined]
+
+    @property
+    def requests_served(self) -> int:
+        """Total HTTP requests handled (the churn benchmark's
+        request-rate instrument, scripts/control_plane_bench.py)."""
+        return self._httpd.requests_served  # type: ignore[attr-defined]
 
     def start(self) -> int:
         self._thread = threading.Thread(
@@ -554,62 +865,64 @@ class RendezvousServer:
         return self.port
 
     def stop(self) -> None:
+        self._httpd.rdv_dead = True  # type: ignore[attr-defined]
         self._httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
+        # release the port: pooled keep-alive clients must see a dead
+        # primary as connection-refused, not a silent accept-less bind
+        self._httpd.server_close()
+        if self._journal is not None:
+            self._journal.close()
 
     # direct (in-process) access for the launcher itself
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        with self._httpd.lock:  # type: ignore[attr-defined]
-            return self._httpd.store.get(f"/{scope}/{key}")  # type: ignore
+        return self.store.get(f"/{scope}/{key}")
 
     def put(self, scope: str, key: str, value: bytes) -> None:
-        with self._httpd.lock:  # type: ignore[attr-defined]
-            self._httpd.store[f"/{scope}/{key}"] = value  # type: ignore
+        """One in-process write, through the same fence/journal/lease
+        choke point as the HTTP surface (raises
+        :class:`EpochFencedError` on a regressed epoch commit)."""
+        apply_put(self._httpd, f"/{scope}/{key}", value)
 
     def delete(self, scope: str, key: str) -> None:
         """Drop one key (e.g. the elastic driver revoking a dead rank's
         /health lease)."""
         path = f"/{scope}/{key}"
+        self.store.pop(path)
         with self._httpd.lock:  # type: ignore[attr-defined]
-            self._httpd.store.pop(path, None)  # type: ignore[attr-defined]
             self._httpd.lease_times.pop(path, None)  # type: ignore
 
     def scope_items(self, scope: str) -> Dict[str, bytes]:
         """Snapshot of every key under ``scope`` (key names without the
         scope prefix) — the elastic driver's poll of announces/acks."""
         prefix = f"/{scope}/"
-        with self._httpd.lock:  # type: ignore[attr-defined]
-            return {k[len(prefix):]: v
-                    for k, v in self._httpd.store.items()  # type: ignore
-                    if k.startswith(prefix)}
+        return {k[len(prefix):]: v
+                for k, v in self.store.prefix_items(prefix).items()}
+
+    def scope_since(self, scope: str,
+                    since: Optional[int] = None) -> Dict[str, object]:
+        """In-process equivalent of ``GET /scope/<name>?since=V``."""
+        return self.store.scope_since(scope, since)
 
     def health_report(self) -> Dict[str, object]:
         """In-process equivalent of GET /health (the elastic driver polls
         lease verdicts without going through its own HTTP stack)."""
         with self._httpd.lock:  # type: ignore[attr-defined]
-            return build_health_report(
-                dict(self._httpd.store),  # type: ignore[attr-defined]
-                dict(self._httpd.lease_times),  # type: ignore[attr-defined]
-            )
+            lease_times = dict(self._httpd.lease_times)  # type: ignore
+        return build_health_report(self.store.items(), lease_times)
 
     def membership_report(self) -> Dict[str, object]:
         """In-process equivalent of GET /membership."""
-        with self._httpd.lock:  # type: ignore[attr-defined]
-            return build_membership_report(
-                dict(self._httpd.store))  # type: ignore[attr-defined]
+        return build_membership_report(self.store.items())
 
     def autotune_report(self) -> Dict[str, object]:
         """In-process equivalent of GET /autotune."""
-        with self._httpd.lock:  # type: ignore[attr-defined]
-            return build_autotune_report(
-                dict(self._httpd.store))  # type: ignore[attr-defined]
+        return build_autotune_report(self.store.items())
 
     def profile_report(self) -> Dict[str, object]:
         """In-process equivalent of GET /profile."""
-        with self._httpd.lock:  # type: ignore[attr-defined]
-            return build_profile_report(
-                dict(self._httpd.store))  # type: ignore[attr-defined]
+        return build_profile_report(self.store.items())
 
     def projection_report(self) -> Optional[Dict[str, object]]:
         """In-process equivalent of GET /projection (None when no
@@ -639,11 +952,11 @@ class RendezvousServer:
         ``abort``/``health`` scopes between restart attempts so a stale
         flag cannot abort the fresh incarnation)."""
         prefix = f"/{scope}/"
+        self.store.clear_scope(scope)
         with self._httpd.lock:  # type: ignore[attr-defined]
-            store = self._httpd.store  # type: ignore[attr-defined]
-            for k in [k for k in store if k.startswith(prefix)]:
-                del store[k]
-                self._httpd.lease_times.pop(k, None)  # type: ignore
+            lease_times = self._httpd.lease_times  # type: ignore
+            for k in [k for k in lease_times if k.startswith(prefix)]:
+                del lease_times[k]
 
 
 def find_free_port() -> int:

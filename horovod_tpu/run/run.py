@@ -121,6 +121,21 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                              "grow/shrink membership epochs from load "
                              "(needs --elastic; spares announced via "
                              "join_world are held for it)")
+    parser.add_argument("--relay", action="store_true", dest="relay",
+                        help="control-plane relay tree: local rank 0 on "
+                             "each host aggregates heartbeat renewals, "
+                             "metric snapshots, and sanitizer "
+                             "fingerprints into batched upstream PUTs "
+                             "(HVD_RELAY=1, docs/control_plane.md) — "
+                             "steady-state rendezvous traffic drops from "
+                             "O(ranks) to O(hosts) requests per interval")
+    parser.add_argument("--journal", dest="journal", metavar="PATH",
+                        help="append every rendezvous KV mutation to this "
+                             "file (HVD_RENDEZVOUS_JOURNAL) so a warm "
+                             "standby (scripts/hvd_standby.py) can replay "
+                             "it and take over on launcher death; pair "
+                             "with HVD_RENDEZVOUS_ADDRS listing "
+                             "primary,standby for client failover")
     parser.add_argument("--controller", dest="controller",
                         choices=["auto", "xla", "native"], default="auto",
                         help="eager control plane: 'native' runs the C++ "
@@ -551,7 +566,11 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                 f"{env_util.HVD_METRICS_SECRET} must be hex, got "
                 f"{secret_hex!r}"
             )
-        rdv_server = RendezvousServer(secret=rdv_secret)
+        journal_path = getattr(args, "journal", None) \
+            or env.get(env_util.HVD_RENDEZVOUS_JOURNAL,
+                       os.environ.get(env_util.HVD_RENDEZVOUS_JOURNAL))
+        rdv_server = RendezvousServer(secret=rdv_secret,
+                                      journal_path=journal_path)
         rdv_port = rdv_server.start()
         rdv_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
             else socket.gethostname()
@@ -559,6 +578,18 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
         env[env_util.HVD_METRICS_KV_ADDR] = rdv_host
         env[env_util.HVD_METRICS_KV_PORT] = str(rdv_port)
         env[env_util.HVD_METRICS_SECRET] = rdv_secret.hex()
+        # ordered failover list for workers: the operator's
+        # primary,standby list wins (warm standby via --journal +
+        # scripts/hvd_standby.py); otherwise advertise the primary so
+        # every client resolves addresses one way
+        env.setdefault(
+            env_util.HVD_RENDEZVOUS_ADDRS,
+            os.environ.get(env_util.HVD_RENDEZVOUS_ADDRS)
+            or f"{rdv_host}:{rdv_port}")
+        if journal_path:
+            log.info("rendezvous journal at %s (standby: "
+                     "scripts/hvd_standby.py --journal %s)",
+                     journal_path, journal_path)
         if metrics_on:
             # never echo an operator-provided credential into job logs; a
             # generated one must be printed or the endpoint is unusable
@@ -574,6 +605,9 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
         if heartbeat_on:
             log.info("health: GET http://%s:%d/health reports per-rank "
                      "lease verdicts", rdv_host, rdv_port)
+    if getattr(args, "relay", False):
+        env = dict(env)
+        env[env_util.HVD_RELAY] = "1"
 
     controller = getattr(args, "controller", "auto") or "auto"
     if controller == "auto":
@@ -593,12 +627,43 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
         return 0
 
     elastic = bool(getattr(args, "elastic", False))
+    elastic_store = rdv_server
     if elastic and rdv_server is None:
-        raise RuntimeError(
-            "--elastic needs the launcher rendezvous plane: re-enable "
-            f"{env_util.HVD_METRICS} or heartbeats, and unset any external "
-            f"{env_util.HVD_METRICS_KV_ADDR} sink"
-        )
+        # an operator-provided external rendezvous (HVD_METRICS_KV_ADDR
+        # + optional HVD_RENDEZVOUS_ADDRS failover list): the driver
+        # commits epochs over HTTP instead of in-process — this is the
+        # HA deployment where the rendezvous outlives the launcher
+        # (docs/control_plane.md)
+        ext_port = env.get(env_util.HVD_METRICS_KV_PORT,
+                           os.environ.get(env_util.HVD_METRICS_KV_PORT))
+        if not external_sink or not ext_port:
+            raise RuntimeError(
+                "--elastic needs the launcher rendezvous plane: re-enable "
+                f"{env_util.HVD_METRICS} or heartbeats, or point "
+                f"{env_util.HVD_METRICS_KV_ADDR}/PORT at an external "
+                "rendezvous server"
+            )
+        from .http_client import RemoteStore
+
+        addrs_raw = env.get(env_util.HVD_RENDEZVOUS_ADDRS,
+                            os.environ.get(env_util.HVD_RENDEZVOUS_ADDRS))
+        addrs = []
+        for tok in (addrs_raw or "").split(","):
+            tok = tok.strip()
+            if tok and ":" in tok:
+                host, _, p = tok.rpartition(":")
+                try:
+                    addrs.append((host, int(p)))
+                except ValueError:
+                    pass
+        if not addrs:
+            addrs = [(external_sink, int(ext_port))]
+        secret_hex = env.get(env_util.HVD_METRICS_SECRET,
+                             os.environ.get(env_util.HVD_METRICS_SECRET))
+        elastic_store = RemoteStore(
+            addrs, secret=bytes.fromhex(secret_hex) if secret_hex else None)
+        log.info("elastic: driving membership through the external "
+                 "rendezvous at %s", addrs)
     serve = bool(getattr(args, "serve", False)) \
         or env_util.parse_bool(env.get(env_util.HVD_SERVE), False)
     serve_broker = None
@@ -645,7 +710,7 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                 from ..elastic.driver import ElasticDriver
 
                 driver = ElasticDriver(
-                    rdv_server, [str(i) for i in range(len(hosts))],
+                    elastic_store, [str(i) for i in range(len(hosts))],
                     min_np=getattr(args, "min_np", None)
                     or env_util.get_int(env_util.HVD_ELASTIC_MIN_NP, 1),
                     controller=controller, controller_host=ctrl_host,
@@ -716,19 +781,24 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                 attempt,
             )
             time.sleep(delay)
-            if rdv_server is not None:
+            if elastic_store is not None:
                 # a stale abort flag, dead lease, or last-attempt
                 # membership record must not kill the fresh incarnation
-                # at its first heartbeat
+                # at its first heartbeat (works through RemoteStore for
+                # an external rendezvous too)
                 from .http_server import (
                     ABORT_SCOPE,
                     HEALTH_SCOPE,
                     MEMBERSHIP_SCOPE,
                 )
 
-                rdv_server.clear_scope(ABORT_SCOPE)
-                rdv_server.clear_scope(HEALTH_SCOPE)
-                rdv_server.clear_scope(MEMBERSHIP_SCOPE)
+                try:
+                    elastic_store.clear_scope(ABORT_SCOPE)
+                    elastic_store.clear_scope(HEALTH_SCOPE)
+                    elastic_store.clear_scope(MEMBERSHIP_SCOPE)
+                except Exception as e:  # noqa: BLE001 — an unreachable
+                    log.warning(         # external store: workers' epoch
+                        "restart scope reset failed: %s", e)  # filter copes
     finally:
         if rdv_server is not None:
             rdv_server.stop()
@@ -839,7 +909,11 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     kwargs = kwargs or {}
     extra_env = dict(extra_env or {})
     secret = _secrets.token_bytes(16)
-    server = RendezvousServer(secret=secret)
+    server = RendezvousServer(
+        secret=secret,
+        journal_path=extra_env.get(
+            env_util.HVD_RENDEZVOUS_JOURNAL,
+            os.environ.get(env_util.HVD_RENDEZVOUS_JOURNAL)))
     port = server.start()
     # Multi-process workers need an eager transport: default to a
     # parent-hosted native controller on loopback (bound to port 0 — no

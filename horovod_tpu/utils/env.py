@@ -185,6 +185,18 @@ HVD_PREFETCH_DEPTH = "HVD_PREFETCH_DEPTH"              # device prefetch queue d
 HVD_REMAT_POLICY = "HVD_REMAT_POLICY"                  # none|full|dots rematerialization of the loss closure
 HVD_AUTOTUNE_COMPUTE = "HVD_AUTOTUNE_COMPUTE"          # 1 lets the GP autotuner rotate the compute knobs too
 HVD_BENCH_COMPUTE_OPT = "HVD_BENCH_COMPUTE_OPT"        # 0 skips bench.py's compute-path A/B leg (host_gap_pct source)
+# hierarchical HA control plane (run/store.py, run/journal.py,
+# run/relay.py; docs/control_plane.md): sharded KV + per-host relay
+# aggregation + warm-standby failover
+HVD_CP_SHARDS = "HVD_CP_SHARDS"                        # KV store shard count (default 8)
+HVD_RENDEZVOUS_ADDRS = "HVD_RENDEZVOUS_ADDRS"          # ordered host:port,host:port failover list (primary first)
+HVD_RENDEZVOUS_JOURNAL = "HVD_RENDEZVOUS_JOURNAL"      # mutation-journal path; enables warm-standby replay
+HVD_RELAY = "HVD_RELAY"                                # 1 = local-rank-0 runs the per-host relay daemon
+HVD_RELAY_PORT = "HVD_RELAY_PORT"                      # relay listen port (default 0 = ephemeral)
+HVD_RELAY_FLUSH_MS = "HVD_RELAY_FLUSH_MS"              # relay upstream batch-flush cadence, ms (default 200)
+HVD_HTTP_KEEPALIVE = "HVD_HTTP_KEEPALIVE"              # 0 disables pooled keep-alive connections (debug)
+HVD_METRICS_DELTA = "HVD_METRICS_DELTA"                # 0 forces full metric snapshots every push (default delta)
+HVD_BENCH_CONTROL = "HVD_BENCH_CONTROL"                # 0 skips bench.py's control-plane churn leg
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -223,6 +235,8 @@ DEFAULT_SERVE_COOLDOWN_SECONDS = 10.0              # spacing between autoscale a
 DEFAULT_SERVE_MIN_REPLICAS = 1                     # autoscaler shrink floor
 DEFAULT_LOSS_FETCH_STEPS = 16                      # trailing loss-fetch cadence (training.py)
 DEFAULT_PREFETCH_DEPTH = 2                         # device prefetch queue depth (data/loader.py)
+DEFAULT_CP_SHARDS = 8                              # run/store.py KV shard count
+DEFAULT_RELAY_FLUSH_MS = 500.0                     # run/relay.py upstream batch cadence
 
 
 def get_int(name: str, default: int) -> int:

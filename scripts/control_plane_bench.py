@@ -1,0 +1,271 @@
+"""Control-plane churn benchmark: measure the rendezvous plane itself.
+
+The paper's thesis applied to our own control plane: a coordinator's
+latency must be a *measured, optimized* number, not an assumption.
+This harness simulates a large world's steady-state control traffic —
+heartbeat lease renewals, metric snapshot pushes, sanitizer
+fingerprints, membership epoch commits, an abort storm — against a
+REAL :class:`~horovod_tpu.run.http_server.RendezvousServer` (sharded
+store, batch endpoints) in process, and reports
+(docs/control_plane.md):
+
+* ``request_reduction_x`` — primary-server requests per tick in
+  per-rank mode (every rank renews/pushes/fingerprints directly)
+  vs. relay mode (each host's :class:`~horovod_tpu.run.relay.
+  RelayDaemon` coalesces its ranks' keys into ONE ``PUT /batch`` per
+  tick).  The acceptance bar is >= 5x at 64 hosts x 512 ranks.
+* ``p99_lease_renewal_ms`` — wall-time p99 of direct batched renewals
+  (``put_kv_reply`` with the abort piggyback) under pool concurrency.
+* ``p99_epoch_commit_ms`` — wall-time p99 of ElasticDriver epoch
+  commits through a :class:`~horovod_tpu.run.http_client.RemoteStore`
+  (the HA deployment's commit path: clear health + fenced epoch PUT +
+  blocklist PUT over HTTP).
+* ``abort_propagation_ms`` — abort flag set on the primary → observed
+  by relay-routed heartbeat daemons (renewal-reply piggyback through
+  the relay's flush-refreshed cache).
+
+Run::
+
+    python scripts/control_plane_bench.py                 # 64h x 512r
+    python scripts/control_plane_bench.py --hosts 8 --ranks 32
+    python scripts/control_plane_bench.py --check         # tier-1 fixture
+
+``--check`` runs a small world (8 hosts x 32 ranks, 3 ticks) and
+asserts the reduction and latency bars; ``bench.py --child-control``
+runs the full world and lands ``control_p99_*`` in the bench JSON tail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.elastic.driver import ElasticDriver  # noqa: E402
+from horovod_tpu.elastic.heartbeat import HeartbeatThread  # noqa: E402
+from horovod_tpu.run import http_client  # noqa: E402
+from horovod_tpu.run.http_server import RendezvousServer  # noqa: E402
+from horovod_tpu.run.relay import RelayDaemon  # noqa: E402
+
+SECRET = b"control-plane-bench"
+
+
+def percentile(samples, q: float) -> float:
+    """Nearest-rank percentile (the serving plane's convention)."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = max(0, min(len(ordered) - 1,
+                     int(round(q / 100.0 * len(ordered) + 0.5)) - 1))
+    return ordered[idx]
+
+
+def _rank_payloads(rank: int, tick: int):
+    """The three steady-state keys one rank touches per tick: its
+    health lease, its metrics snapshot, and one sanitizer fingerprint
+    (sequence = tick)."""
+    lease = json.dumps({"rank": rank, "count": tick,
+                        "interval": 2.0}).encode()
+    snap = json.dumps({"metrics": {"hvd_steps_total": {
+        "type": "counter", "samples": [{"labels": {}, "value": tick}]}},
+        "ts": tick}).encode()
+    fp = json.dumps({"seq": tick, "op": "allreduce", "name": f"g{rank}",
+                     "shape": [1024], "dtype": "float32",
+                     "group": "world", "epoch": 0,
+                     "clock": tick}).encode()
+    return [
+        (f"/health/{rank}", lease),
+        (f"/metrics/{rank}", snap),
+        (f"/sanitizer/world.0.{tick}.{rank}", fp),
+    ]
+
+
+def measure_per_rank(server: RendezvousServer, ranks: int, ticks: int,
+                     pool: ThreadPoolExecutor):
+    """Per-rank (no relay) steady state: every rank renews its lease
+    (ONE batched round trip carrying the abort verdict back), pushes
+    its snapshot, and publishes its fingerprint, directly against the
+    primary.  Returns (requests_per_tick, renewal_latency_samples)."""
+    port = server.port
+    latencies: list = []
+    lat_lock = threading.Lock()
+
+    def one_rank(rank: int, tick: int) -> None:
+        t0 = time.perf_counter()
+        http_client.put_kv_reply("127.0.0.1", port, "health", str(rank),
+                                 _rank_payloads(rank, tick)[0][1],
+                                 secret=SECRET)
+        dt = (time.perf_counter() - t0) * 1e3
+        with lat_lock:
+            latencies.append(dt)
+        for path, value in _rank_payloads(rank, tick)[1:]:
+            scope, _, key = path.lstrip("/").partition("/")
+            http_client.put_kv("127.0.0.1", port, scope, key, value,
+                               secret=SECRET)
+
+    before = server.requests_served
+    for tick in range(ticks):
+        list(pool.map(lambda r: one_rank(r, tick), range(ranks)))
+    total = server.requests_served - before
+    return total / ticks, latencies
+
+
+def measure_relay(server: RendezvousServer, hosts: int, ranks: int,
+                  ticks: int):
+    """Relay-tree steady state: each host's relay coalesces its ranks'
+    keys and ships ONE ``PUT /batch`` per tick.  Local rank → relay
+    hops are loopback buffer calls (they never touch the measured
+    primary); the upstream flush is real HTTP.  Returns
+    requests_per_tick at the primary."""
+    relays = [RelayDaemon("127.0.0.1", server.port, secret=SECRET,
+                          flush_ms=10_000)  # manual flushes only
+              for _ in range(hosts)]
+    per_host = max(ranks // hosts, 1)
+    before = server.requests_served
+    for tick in range(ticks):
+        for h, relay in enumerate(relays):
+            for r in range(h * per_host, min((h + 1) * per_host, ranks)):
+                for path, value in _rank_payloads(r, tick):
+                    relay.buffer(path, value)
+            relay.flush_now()
+    total = server.requests_served - before
+    for relay in relays:
+        relay._stop_event.set()  # never started; just mark dead
+        relay._httpd.server_close()
+    return total / ticks
+
+
+def measure_epoch_commits(server: RendezvousServer, world: int,
+                          commits: int = 20):
+    """ElasticDriver epoch commits through RemoteStore (the HA commit
+    path): p99 wall time of clear-health + fenced epoch PUT + blocklist
+    PUT over HTTP."""
+    store = http_client.RemoteStore([("127.0.0.1", server.port)],
+                                    secret=SECRET)
+    workers = [str(i) for i in range(world)]
+    driver = ElasticDriver(store, workers, controller="xla")
+    samples = []
+    for i in range(commits):
+        t0 = time.perf_counter()
+        driver.commit(workers, reason=f"bench commit {i}")
+        samples.append((time.perf_counter() - t0) * 1e3)
+    driver.shutdown()
+    return samples
+
+
+def measure_abort_propagation(server: RendezvousServer,
+                              daemons: int = 4,
+                              interval: float = 0.05):
+    """Abort flag set on the primary → observed by heartbeat daemons
+    whose renewals ride a relay (the slowest path: verdict reaches the
+    relay cache at its next flush, the rank at its next renewal)."""
+    relay = RelayDaemon("127.0.0.1", server.port, secret=SECRET,
+                        flush_ms=interval * 1e3 / 2)
+    rport = relay.start()
+    hbs = [HeartbeatThread(i, daemons, "127.0.0.1", rport, secret=SECRET,
+                           interval=interval) for i in range(daemons)]
+    for hb in hbs:
+        hb.start()
+    time.sleep(3 * interval)  # steady state before the storm
+    t0 = time.perf_counter()
+    server.put("abort", "flag", json.dumps(
+        {"reason": "bench abort", "source": "bench"}).encode())
+    deadline = time.monotonic() + 30 * interval + 2.0
+    while time.monotonic() < deadline:
+        if all(hb.abort_info is not None for hb in hbs):
+            break
+        time.sleep(interval / 10)
+    latency_ms = (time.perf_counter() - t0) * 1e3
+    observed = sum(hb.abort_info is not None for hb in hbs)
+    for hb in hbs:
+        hb.stop()
+    relay.stop()
+    return latency_ms, observed, daemons
+
+
+def run_bench(hosts: int = 64, ranks: int = 512, ticks: int = 3,
+              pool_workers: int = 32) -> dict:
+    """The whole churn suite against one fresh sharded server."""
+    server = RendezvousServer(secret=SECRET)
+    server.start()
+    try:
+        with ThreadPoolExecutor(max_workers=pool_workers) as pool:
+            per_rank_rate, lease_lat = measure_per_rank(
+                server, ranks, ticks, pool)
+        relay_rate = measure_relay(server, hosts, ranks, ticks)
+        epoch_lat = measure_epoch_commits(server, world=min(ranks, 64))
+        abort_ms, observed, daemons = measure_abort_propagation(server)
+        return {
+            "hosts": hosts,
+            "ranks": ranks,
+            "ticks": ticks,
+            "per_rank_requests_per_tick": round(per_rank_rate, 1),
+            "relay_requests_per_tick": round(relay_rate, 1),
+            "request_reduction_x": round(
+                per_rank_rate / relay_rate, 2) if relay_rate else None,
+            "p50_lease_renewal_ms": round(percentile(lease_lat, 50), 3),
+            "p99_lease_renewal_ms": round(percentile(lease_lat, 99), 3),
+            "p50_epoch_commit_ms": round(percentile(epoch_lat, 50), 3),
+            "p99_epoch_commit_ms": round(percentile(epoch_lat, 99), 3),
+            "abort_propagation_ms": round(abort_ms, 1),
+            "abort_observed": f"{observed}/{daemons}",
+        }
+    finally:
+        server.stop()
+
+
+def run_check() -> int:
+    """Tier-1 fixture: a small world must still clear the acceptance
+    bars (>= 5x request reduction, sane latencies, full abort fan-out)."""
+    out = run_bench(hosts=8, ranks=32, ticks=3, pool_workers=16)
+    print(json.dumps(out, indent=1))
+    failures = []
+    if not out["request_reduction_x"] or out["request_reduction_x"] < 5.0:
+        failures.append(
+            f"request reduction {out['request_reduction_x']}x < 5x")
+    if not 0.0 < out["p99_lease_renewal_ms"] < 1000.0:
+        failures.append(
+            f"implausible lease p99 {out['p99_lease_renewal_ms']} ms")
+    if not 0.0 < out["p99_epoch_commit_ms"] < 5000.0:
+        failures.append(
+            f"implausible epoch-commit p99 {out['p99_epoch_commit_ms']} ms")
+    if out["abort_observed"].split("/")[0] != out["abort_observed"].split("/")[1]:
+        failures.append(f"abort not fully observed: {out['abort_observed']}")
+    if failures:
+        print("CONTROL PLANE BENCH CHECK FAILED")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("CONTROL PLANE BENCH CHECK PASSED")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hosts", type=int, default=64)
+    ap.add_argument("--ranks", type=int, default=512)
+    ap.add_argument("--ticks", type=int, default=3)
+    ap.add_argument("--workers", type=int, default=32,
+                    help="client thread-pool width for the per-rank mode")
+    ap.add_argument("--check", action="store_true",
+                    help="small-world self-test with the acceptance bars "
+                         "(tier-1)")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="print the result dict as one JSON line")
+    args = ap.parse_args(argv)
+    if args.check:
+        return run_check()
+    out = run_bench(hosts=args.hosts, ranks=args.ranks, ticks=args.ticks,
+                    pool_workers=args.workers)
+    print(json.dumps(out) if args.json_out else json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

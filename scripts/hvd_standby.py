@@ -1,0 +1,59 @@
+"""Warm-standby rendezvous server: tail a primary's mutation journal
+and serve the identical KV/HTTP surface for failover.
+
+The HA half of the control plane (docs/control_plane.md): launch the
+primary with ``tpurun --journal /shared/rdv.journal`` (or
+``HVD_RENDEZVOUS_JOURNAL``), run this CLI on a second host against the
+same journal path, and list both servers in ``HVD_RENDEZVOUS_ADDRS``
+(primary first).  Clients — heartbeats, membership waits, relays, the
+RemoteStore-backed elastic driver — walk the list when the primary
+dies and land here with membership epochs, the abort flag, and
+autotune/serving state intact; the server-side epoch fence keeps a
+resurrected stale primary from rolling the world back.
+
+Run::
+
+    python scripts/hvd_standby.py --journal /shared/rdv.journal \
+        --port 29401 [--secret HEX]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.run.journal import StandbyServer  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--journal", required=True,
+                    help="the primary's HVD_RENDEZVOUS_JOURNAL path "
+                         "(shared filesystem or a synced copy)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (default: ephemeral, printed)")
+    ap.add_argument("--secret", default=None,
+                    help="hex HMAC job secret (HVD_METRICS_SECRET); "
+                         "must match the primary's so signed client "
+                         "requests keep verifying after failover")
+    args = ap.parse_args(argv)
+    secret = bytes.fromhex(args.secret) if args.secret else None
+    standby = StandbyServer(args.journal, secret=secret, port=args.port)
+    port = standby.start()
+    print(f"standby rendezvous serving on port {port} "
+          f"(journal {args.journal}, {standby.applied} records replayed)",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        standby.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_serving_leg", lambda: {})
     monkeypatch.setattr(bench, "_projection_leg", lambda: {})
     monkeypatch.setattr(bench, "_compute_opt_leg", lambda: {})
+    monkeypatch.setattr(bench, "_control_leg", lambda: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
@@ -352,6 +353,73 @@ def test_compute_opt_leg_merged_and_skippable(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert "compute_opt_delta_pct" not in out
     assert not any("--child-compute-opt" in c for c in calls)
+
+
+def test_control_leg_merged_and_skippable(monkeypatch, capsys):
+    """The control-plane churn leg (docs/control_plane.md) lands
+    control_p99_lease_ms / control_p99_epoch_ms / control_abort_ms /
+    control_request_reduction_x in the JSON tail, degrades to nulls on
+    a hung child, and HVD_BENCH_CONTROL=0 skips it."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        def __init__(self, line):
+            self.returncode = 0
+            self.stdout = "RESULT " + line + "\n"
+            self.stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        if "--child-control" in cmd:
+            return FakeProc(json.dumps(
+                {"control_p99_lease_ms": 12.5, "control_p99_epoch_ms": 1.4,
+                 "control_abort_ms": 80.0,
+                 "control_request_reduction_x": 24.0}))
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench, "_autotune_delta", lambda v: {})
+    monkeypatch.setattr(bench, "_compression_delta", lambda v: {})
+    monkeypatch.setattr(bench, "_serving_leg", lambda: {})
+    monkeypatch.setattr(bench, "_projection_leg", lambda: {})
+    monkeypatch.setattr(bench, "_compute_opt_leg", lambda: {})
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_CONTROL", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["control_p99_lease_ms"] == 12.5
+    assert out["control_p99_epoch_ms"] == 1.4
+    assert out["control_request_reduction_x"] == 24.0
+    assert any("--child-control" in c for c in calls)
+
+    # a hung churn child degrades to nulls, never costs the main number
+    def raise_for_leg(cmd, *a, **k):
+        if "--child-control" in cmd:
+            raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench.subprocess, "run", raise_for_leg)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["control_p99_lease_ms"] is None
+    assert out["control_p99_epoch_ms"] is None
+    assert "timeout" in out["control_error"]
+
+    # HVD_BENCH_CONTROL=0: no child run, no tail fields
+    calls.clear()
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("HVD_BENCH_CONTROL", "0")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "control_p99_lease_ms" not in out
+    assert not any("--child-control" in c for c in calls)
 
 
 def test_run_timeout_retries_then_skips(monkeypatch, capsys):
