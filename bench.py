@@ -66,6 +66,7 @@ SERVE_TIMEOUT_S = 180      # serving fixture: a few MLP compiles + ~1.5 s trace
 PROJECTION_TIMEOUT_S = 240  # digital-twin leg: two traced MLP drives (1 + 8 dev)
 COMPUTE_OPT_TIMEOUT_S = 240  # compute-path A/B: two MLP drives + a profiler window
 CONTROL_TIMEOUT_S = 120    # control-plane churn: ~5k loopback HTTP requests
+WATCH_TIMEOUT_S = 90       # watchdog leg: pure host-side detector replay
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -247,6 +248,106 @@ def _measure_control() -> None:
         "control_abort_ms": out["abort_propagation_ms"],
         "control_request_reduction_x": out["request_reduction_x"],
     }))
+
+
+def _measure_watch() -> None:
+    """Child-process entry for the watchdog leg: a scripted step-time
+    regression (2 ranks, 200 quiet steps at ~0.100 s, then rank 0
+    degrading to 0.200 s) replayed through a real rendezvous server +
+    Watchdog (observe/watchdog.py) — pure host-side machinery, runs
+    anywhere.  Tracked numbers: detection latency in steps past the
+    regression onset, false positives over the quiet phase, and the
+    per-append cost of the always-on ring buffer (the ONLY thing the
+    step path pays)."""
+    import json as _json
+    import time as _time
+
+    os.environ["HVD_WATCH_INTERVAL_SECONDS"] = "999"  # tick() driven by hand
+    from horovod_tpu.metrics import timeseries as ts_mod
+    from horovod_tpu.observe.watchdog import Watchdog
+    from horovod_tpu.run.http_server import RendezvousServer
+
+    # ring-buffer append cost: the step path's entire overhead
+    n = 200_000
+    t0 = _time.perf_counter()
+    for i in range(n):
+        ts_mod.record(ts_mod.STEP_SECONDS, 0.1, step=i)
+    append_us = (_time.perf_counter() - t0) / n * 1e6
+    ts_mod.store.reset()
+
+    server = RendezvousServer()
+    server.start()
+    try:
+        dog = Watchdog(server)        # not started: ticks driven below
+        stores = {r: ts_mod.TimeseriesStore(enabled=True)
+                  for r in ("0", "1")}
+        quiet_steps, onset_extra, chunk = 200, 100, 5
+
+        def _feed(step):
+            for rank, st in stores.items():
+                dt = 0.100 if step % 2 else 0.101
+                if rank == "0" and step > quiet_steps:
+                    dt = 0.200             # the scripted regression
+                st.record(ts_mod.STEP_SECONDS, dt, step=step)
+                server.put("timeseries", rank,
+                           _json.dumps(st.snapshot()).encode())
+
+        false_positives = 0
+        detect_step = None
+        for step in range(1, quiet_steps + onset_extra + 1):
+            _feed(step)
+            if step % chunk:
+                continue
+            alerts = dog.tick()
+            if step <= quiet_steps:
+                false_positives += len(alerts)
+            elif detect_step is None and any(
+                    a["signal"] in ("step_time_regression",
+                                    "straggler_drift") for a in alerts):
+                detect_step = step
+        print("RESULT " + _json.dumps({
+            "watch_detect_steps": (detect_step - quiet_steps)
+            if detect_step is not None else None,
+            "watch_false_positives": false_positives,
+            "watch_armed": dog.arms > 0,
+            "watch_append_us": round(append_us, 3),
+            "watch_overhead_pct_1ms_step": round(append_us / 1e3 * 100, 4),
+        }))
+    finally:
+        server.stop()
+
+
+def _watch_leg() -> dict:
+    """The watchdog tail fields, from a separately-timed child so a
+    hung or failed detector replay can never cost the main number
+    (HVD_BENCH_WATCH=0 skips).  Null-on-failure, same contract as
+    every other leg."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_WATCH, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-watch", WATCH_TIMEOUT_S)
+        if payload is not None:
+            return {
+                "watch_detect_steps": payload.get("watch_detect_steps"),
+                "watch_false_positives":
+                    payload.get("watch_false_positives"),
+                "watch_armed": payload.get("watch_armed"),
+                "watch_append_us": payload.get("watch_append_us"),
+                "watch_overhead_pct_1ms_step":
+                    payload.get("watch_overhead_pct_1ms_step"),
+            }
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"watch_detect_steps": None, "watch_false_positives": None,
+            "watch_armed": None, "watch_append_us": None,
+            "watch_overhead_pct_1ms_step": None, "watch_error": reason}
 
 
 def _control_leg() -> dict:
@@ -502,6 +603,10 @@ def main() -> None:
             # harness p99 lease/epoch latencies + relay request
             # reduction — the control plane's own tracked numbers
             out.update(_control_leg())
+            # watchdog tail (HVD_BENCH_WATCH=0 skips): detection
+            # latency + false positives on a scripted regression trace,
+            # and the ring-buffer append cost the step path pays
+            out.update(_watch_leg())
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -533,6 +638,8 @@ if __name__ == "__main__":
         _measure_compute_opt()
     elif "--child-control" in sys.argv:
         _measure_control()
+    elif "--child-watch" in sys.argv:
+        _measure_watch()
     elif "--child" in sys.argv:
         _measure()
     else:

@@ -293,6 +293,16 @@ def init(
             start_pusher_from_env(_state.process_index)
         except Exception as e:  # noqa: BLE001 — metrics must never
             log.warning("metrics pusher setup failed: %s", e)  # block init
+        # Telemetry history flusher (metrics/timeseries.py): ships the
+        # ring-buffer series the watchdog's detectors read, and polls
+        # the observe/arm broadcast so an alert can arm this rank's
+        # trace+profile window off the step path.
+        try:
+            from .metrics.timeseries import start_flusher_from_env
+
+            start_flusher_from_env(_state.process_index)
+        except Exception as e:  # noqa: BLE001 — history must never
+            log.warning("timeseries flusher setup failed: %s", e)  # block init
         # Heartbeat leases + coordinated-abort polling (elastic/
         # heartbeat.py): active when the launcher exported rendezvous
         # wiring and this is a multi-process job.
@@ -324,6 +334,12 @@ def shutdown() -> None:
         from .metrics.push import stop_pusher
 
         stop_pusher()  # flushes one final snapshot to the launcher
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .metrics.timeseries import stop_flusher
+
+        stop_flusher()  # final history flush
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -78,6 +78,13 @@ hvd_projection_efficiency       gauge      projected scaling efficiency vs
                                            the source replay baseline
 hvd_projection_err_pct          gauge      tracked projected-vs-measured
                                            step-time error of the twin
+hvd_alerts_total                counter    watchdog alerts raised, by
+                                           ``signal``/``severity``
+                                           (horovod_tpu/observe/)
+hvd_watch_arms_total            counter    trace+profile windows auto-armed
+                                           by a confirmed alert
+hvd_timeseries_flushes_total    counter    time-series history flushes, by
+                                           ``mode`` (delta/full/resync)
 ==============================  =========  ==================================
 """
 
@@ -336,6 +343,21 @@ PROJECTION_ERR_PCT = registry.gauge(
     "world that was actually run (the twin's tracked accuracy — "
     "docs/projection.md validation contract).")
 
+ALERTS_TOTAL = registry.counter(
+    "hvd_alerts_total",
+    "Online-watchdog alerts raised by the observe/ detectors, by signal "
+    "(step_time_regression/straggler/mfu_drop/comm_beta_drift/slo_burn) "
+    "and severity (warning/critical) — docs/observe.md.",
+    ("signal", "severity"))
+WATCH_ARMS = registry.counter(
+    "hvd_watch_arms_total",
+    "Trace+profile windows auto-armed by a confirmed step-time or "
+    "straggler alert (observe/watchdog.py KV broadcast).")
+TIMESERIES_FLUSHES = registry.counter(
+    "hvd_timeseries_flushes_total",
+    "Time-series history flushes shipped to the launcher, by mode "
+    "(delta/full/resync) — metrics/timeseries.py.", ("mode",))
+
 COMPRESSION_RESIDUAL_NORM = registry.gauge(
     "hvd_compression_residual_norm",
     "Global L2 norm of the error-feedback residual pytree, sampled every "
@@ -383,6 +405,11 @@ def record_eager(op: str, nbytes: int, negotiate_s: float,
     EAGER_CALLS.labels(op).inc()
     if nbytes:
         EAGER_BYTES.labels(op).inc(nbytes)
+        # dispatch cost density (µs per MiB moved): the series the
+        # observe/ comm-β drift detector compares against the α–β model
+        if timeseries.on():
+            timeseries.record(timeseries.DISPATCH_US_PER_MIB,
+                              total_s * 1e6 / (nbytes / 2**20))
     EAGER_SECONDS.labels(op).observe(total_s)
     NEGOTIATE_SECONDS.labels(op).observe(negotiate_s)
 
@@ -433,6 +460,7 @@ def dump_metrics_json(path: str) -> None:
     registry.dump(path)
 
 
+from . import timeseries  # noqa: E402  (ring-buffer history plane)
 from .push import (  # noqa: E402,F401  (import after instruments exist)
     start_pusher,
     start_pusher_from_env,

@@ -104,28 +104,38 @@ def _label_str(labels: Dict[str, str]) -> str:
 
 
 class _Child:
-    """One labeled time series."""
+    """One labeled time series.
 
-    __slots__ = ("_lock", "value")
+    ``ts`` is the child's last-mutation wall time — snapshot() folds it
+    into the family-level ``updated`` stamp so consumers (the observe/
+    watchdog, GET /metrics.json) can tell a stale *family* apart from a
+    stale snapshot.  Stamped inside the existing per-update lock: one
+    extra ``time.time()`` per update, well inside the hot-path budget.
+    """
+
+    __slots__ = ("_lock", "value", "ts")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.value = 0.0
+        self.ts = 0.0
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self.value += amount
+            self.ts = time.time()
 
     def set(self, value: float) -> None:
         with self._lock:
             self.value = float(value)
+            self.ts = time.time()
 
     def get(self) -> float:
         return self.value
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count", "ts")
 
     def __init__(self, buckets: Tuple[float, ...]) -> None:
         self._lock = threading.Lock()
@@ -133,12 +143,14 @@ class _HistogramChild:
         self.counts = [0] * len(buckets)  # per-bucket, NON-cumulative
         self.sum = 0.0
         self.count = 0
+        self.ts = 0.0
 
     def observe(self, value: float) -> None:
         v = float(value)
         with self._lock:
             self.sum += v
             self.count += 1
+            self.ts = time.time()
             # linear scan: bucket lists are short (<= ~20) and the scan
             # usually exits in the first few entries for latency data
             for i, ub in enumerate(self.buckets):
@@ -315,13 +327,20 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """JSON-able state: the wire form ranks push to the launcher."""
-        self._run_collectors()
+        # a disabled registry is silent end to end: call sites don't
+        # push, and pull-gauges don't refresh (their ``updated`` stamp
+        # would otherwise tick on every scrape)
+        if self.enabled:
+            self._run_collectors()
         out: Dict[str, dict] = {}
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
             samples = []
+            updated = 0.0
             for labels, child in m.samples():
+                if child.ts > updated:
+                    updated = child.ts
                 if m.kind == "histogram":
                     with child._lock:
                         samples.append({
@@ -335,6 +354,11 @@ class MetricsRegistry:
             entry = {"type": m.kind, "help": m.help, "samples": samples}
             if m.kind == "histogram":
                 entry["le"] = list(m.buckets)
+            # per-family staleness stamp (None = registered but never
+            # updated): lets GET /metrics.json consumers and the observe/
+            # watchdog flag one dead signal inside an otherwise-fresh
+            # snapshot, instead of trusting the snapshot-level ts alone
+            entry["updated"] = updated or None
             out[m.name] = entry
         return {"metrics": out, "ts": time.time()}
 

@@ -1,0 +1,156 @@
+"""Hand-computed detector fixtures.
+
+``watch_fixture()`` builds deterministic traces for every detector;
+``WATCH_EXPECTED`` pins the exact values the detectors must produce
+on them (thresholds, fire steps, severities), derived by hand:
+
+* regression: 40 baseline samples alternating 0.100/0.102 s
+  (median 0.101, MAD 0.001, sigma 0.0014826) give threshold
+  0.101 + 5 * 0.0014826 = 0.1084130 and critical bar 0.115826;
+  the 0.120 s regression starting at step 41 drives the EWMA
+  (alpha 0.5, seeded at 0.101) through 0.1105, 0.11525, 0.117625 —
+  the third consecutive breach fires at step 43, critical because
+  0.117625 > 0.115826.
+* straggler: ranks 0-3 at 0.100 s except rank 1 at 0.140 s — world
+  median 0.100, ratio 1.4 > skew 1.3 but < critical bar 1.6.
+* mfu: 8 x 0.40 then 8 x 0.30 — baseline median 0.40, trailing-
+  quarter median 0.30, drop 25% > 20% but < 40%.
+* beta: measured 120 us/MiB vs predicted 50 — ratio 2.4 > 2 but < 4.
+* burn: 3 of 50 samples above the 250 ms SLO — breach fraction 0.06
+  over budget 0.01 = burn 6.0 > 2 * threshold(2.0), critical.
+* quiet: flat traces on which no detector may fire.
+
+``evaluate_fixture()`` runs the detectors on these traces; the tests
+and ``hvd_watch --check`` both compare its output to WATCH_EXPECTED.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from . import detectors
+
+Sample = Tuple[int, float]
+
+REGRESSION_PARAMS = {"alpha": 0.5, "k": 5.0, "warmup": 40, "confirm": 3}
+STRAGGLER_PARAMS = {"skew": 1.3, "min_samples": 8, "window": 64}
+MFU_PARAMS = {"drop_pct": 20.0, "min_samples": 8}
+BETA_PARAMS = {"drift": 2.0, "min_samples": 8}
+BETA_PREDICTED_US_PER_MIB = 50.0
+BURN_PARAMS = {"budget": 0.01, "burn_threshold": 2.0, "min_samples": 16}
+BURN_SLO_MS = 250.0
+
+WATCH_EXPECTED: Dict[str, Any] = {
+    "regression": {
+        "severity": "critical",
+        "baseline_median": 0.101,
+        "baseline_mad": 0.001,
+        "threshold": 0.1084130,
+        "ewma": 0.117625,
+        "fired_step": 43,
+    },
+    "straggler": {
+        "severity": "warning",
+        "rank": "1",
+        "ratio": 1.4,
+        "world_median": 0.100,
+    },
+    "mfu": {
+        "severity": "warning",
+        "baseline_mfu": 0.40,
+        "recent_mfu": 0.30,
+        "drop_pct": 25.0,
+    },
+    "beta": {
+        "severity": "warning",
+        "measured_us_per_mib": 120.0,
+        "ratio": 2.4,
+    },
+    "burn": {
+        "severity": "critical",
+        "breaches": 3,
+        "breach_fraction": 0.06,
+        "burn_rate": 6.0,
+    },
+    "quiet": None,
+}
+
+
+def _baseline(n: int = 40, lo: float = 0.100, hi: float = 0.102,
+              start_step: int = 1) -> List[Sample]:
+    return [(start_step + i, lo if i % 2 == 0 else hi) for i in range(n)]
+
+
+def watch_fixture() -> Dict[str, Any]:
+    regression = _baseline(40)
+    regression += [(41 + i, 0.120) for i in range(8)]
+
+    straggler = {
+        rank: [(i + 1, 0.140 if rank == "1" else 0.100) for i in range(16)]
+        for rank in ("0", "1", "2", "3")
+    }
+
+    mfu = [(i + 1, 0.40) for i in range(8)]
+    mfu += [(9 + i, 0.30) for i in range(8)]
+
+    beta = [(i + 1, 120.0) for i in range(16)]
+
+    burn = [(i + 1, 200.0) for i in range(47)]
+    burn += [(48 + i, 300.0) for i in range(3)]
+
+    quiet = {
+        "regression": _baseline(48),
+        "straggler": {
+            rank: [(i + 1, 0.100) for i in range(16)]
+            for rank in ("0", "1", "2", "3")
+        },
+        "mfu": [(i + 1, 0.40) for i in range(16)],
+        "beta": [(i + 1, 60.0) for i in range(16)],
+        "burn": [(i + 1, 200.0) for i in range(50)],
+    }
+
+    return {
+        "regression": regression,
+        "straggler": straggler,
+        "mfu": mfu,
+        "beta": beta,
+        "burn": burn,
+        "quiet": quiet,
+    }
+
+
+def evaluate_fixture(fixture: Dict[str, Any] = None) -> Dict[str, Any]:
+    """Run every detector on the fixture traces.
+
+    Returns ``{"regression": alert, ..., "quiet": [alerts]}`` where
+    the quiet entry collects any (unexpected) alerts from the flat
+    traces.
+    """
+    fx = fixture if fixture is not None else watch_fixture()
+    out: Dict[str, Any] = {
+        "regression": detectors.ewma_mad_regression(
+            fx["regression"], **REGRESSION_PARAMS),
+        "straggler": detectors.straggler_drift(
+            fx["straggler"], **STRAGGLER_PARAMS),
+        "mfu": detectors.mfu_drop(fx["mfu"], **MFU_PARAMS),
+        "beta": detectors.comm_beta_drift(
+            fx["beta"], BETA_PREDICTED_US_PER_MIB, **BETA_PARAMS),
+        "burn": detectors.slo_burn_rate(
+            fx["burn"], BURN_SLO_MS, **BURN_PARAMS),
+    }
+    quiet = fx["quiet"]
+    quiet_alerts = [
+        a for a in (
+            detectors.ewma_mad_regression(
+                quiet["regression"], **REGRESSION_PARAMS),
+            detectors.straggler_drift(
+                quiet["straggler"], **STRAGGLER_PARAMS),
+            detectors.mfu_drop(quiet["mfu"], **MFU_PARAMS),
+            detectors.comm_beta_drift(
+                quiet["beta"], BETA_PREDICTED_US_PER_MIB, **BETA_PARAMS),
+            detectors.slo_burn_rate(quiet["burn"], BURN_SLO_MS,
+                                    **BURN_PARAMS),
+        ) if a is not None
+    ]
+    out["quiet"] = quiet_alerts
+    return out

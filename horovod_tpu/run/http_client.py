@@ -520,6 +520,29 @@ def get_profile(addr: str, port: int, secret: Optional[bytes] = None,
         return json.loads(resp.read().decode())
 
 
+def get_timeseries(addr: str, port: int, secret: Optional[bytes] = None,
+                   timeout: float = 10.0) -> dict:
+    """The telemetry time-series table from ``GET /timeseries``:
+    per-rank ring-buffer histories plus the cross-rank summary
+    (docs/observe.md) — the watchdog's and ``hvd_watch``'s read."""
+    import json
+
+    with _request("GET", addr, port, "/timeseries", secret=secret,
+                  timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def get_alerts(addr: str, port: int, secret: Optional[bytes] = None,
+               timeout: float = 10.0) -> dict:
+    """The watchdog alert log from ``GET /alerts``, newest first
+    (docs/observe.md alert schema)."""
+    import json
+
+    with _request("GET", addr, port, "/alerts", secret=secret,
+                  timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
 def get_autotune(addr: str, port: int, secret: Optional[bytes] = None,
                  timeout: float = 10.0) -> dict:
     """The profile-guided tuning table from ``GET /autotune``: every

@@ -115,6 +115,22 @@ AUTOTUNE_SCOPE = "autotune"
 _AUTOTUNE_PREFIX = f"/{AUTOTUNE_SCOPE}/"
 AUTOTUNE_PLAN_PREFIX = "plan."
 
+# always-on telemetry time-series (metrics/timeseries.py): each rank's
+# flusher lands its ring-buffer history under timeseries/<rank> — full
+# snapshots or append-deltas merged server-side — and GET /timeseries
+# renders the per-rank series (docs/observe.md)
+TIMESERIES_SCOPE = "timeseries"
+_TIMESERIES_PREFIX = f"/{TIMESERIES_SCOPE}/"
+
+# online anomaly watchdog (horovod_tpu/observe/): alert records live
+# under alerts/<id> (GET /alerts renders them newest-first), and the
+# auto-arm broadcast — the KV-broadcast trace+profile start step every
+# rank applies consistently — lives at observe/arm
+ALERTS_SCOPE = "alerts"
+_ALERTS_PREFIX = f"/{ALERTS_SCOPE}/"
+OBSERVE_SCOPE = "observe"
+ARM_KEY = "arm"
+
 # failure-domain runtime (elastic/heartbeat.py, elastic/abort.py): ranks
 # renew leases under /health/<rank>; the server stamps each PUT on ITS
 # clock and GET /health renders per-rank lease age + live/stale/dead
@@ -282,6 +298,75 @@ def build_profile_report(store: Dict[str, bytes]) -> Dict[str, object]:
     return {"ranks": per_rank, "aggregate": aggregate}
 
 
+def build_timeseries_report(store: Dict[str, bytes]) -> Dict[str, object]:
+    """The time-series table from a store snapshot: each pushed rank's
+    series (samples as ``[step, value]`` pairs, oldest first) plus a
+    cross-rank summary — per series, every rank's latest value and
+    sample count — so one ``GET /timeseries`` answers both "show me the
+    history" and "which ranks are reporting" (docs/observe.md)."""
+    ranks: Dict[str, object] = {}
+    for k, v in store.items():
+        if not k.startswith(_TIMESERIES_PREFIX):
+            continue
+        rank = k[len(_TIMESERIES_PREFIX):]
+        try:
+            doc = json.loads(v)
+            ranks[rank] = doc if isinstance(doc, dict) \
+                else "<undecodable>"
+        except (ValueError, TypeError):
+            ranks[rank] = "<undecodable>"
+    summary: Dict[str, Dict[str, object]] = {}
+    for rank, doc in ranks.items():
+        if not isinstance(doc, dict):
+            continue
+        for name, entry in (doc.get("series") or {}).items():
+            if not isinstance(entry, dict):
+                continue
+            samples = entry.get("samples") or []
+            s = summary.setdefault(name, {"ranks": {}})
+            last = samples[-1] if samples else None
+            s["ranks"][rank] = {
+                "count": len(samples),
+                "last_step": entry.get("last_step"),
+                "last": last[1] if isinstance(last, (list, tuple))
+                and len(last) == 2 else None,
+            }
+    return {"ranks": ranks, "summary": summary}
+
+
+def build_alerts_report(store: Dict[str, bytes]) -> Dict[str, object]:
+    """The watchdog's alert log from a store snapshot, newest first —
+    ``GET /alerts``'s body.  Each record is the detector-emitted
+    ``{severity, signal, evidence, window}`` dict plus the ids/stamps
+    and any auto-arm / attribution enrichment the watchdog attached
+    (observe/watchdog.py, docs/observe.md)."""
+    alerts = []
+    for k, v in store.items():
+        if not k.startswith(_ALERTS_PREFIX):
+            continue
+        key = k[len(_ALERTS_PREFIX):]
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            rec = {"id": key, "error": "<undecodable>"}
+        if isinstance(rec, dict):
+            rec.setdefault("id", key)
+        alerts.append(rec)
+
+    def _order(rec):
+        try:
+            return int(rec.get("id"))
+        except (ValueError, TypeError, AttributeError):
+            return -1
+
+    alerts.sort(key=_order, reverse=True)
+    counts: Dict[str, int] = {}
+    for rec in alerts:
+        if isinstance(rec, dict) and rec.get("signal"):
+            counts[rec["signal"]] = counts.get(rec["signal"], 0) + 1
+    return {"alerts": alerts, "counts": counts}
+
+
 def build_autotune_report(store: Dict[str, bytes]) -> Dict[str, object]:
     """The profile-guided tuning table from a store snapshot: every
     pushed plan record in sequence order, the latest record as
@@ -412,6 +497,67 @@ def _merge_metrics_delta(store, path: str, delta: dict,
     for name in delta.get("removed") or ():
         fams.pop(name, None)
     cur["ts"] = delta.get("ts", time.time())
+    return json.dumps(cur).encode()
+
+
+def _parse_ts_delta(body: bytes) -> Optional[dict]:
+    """Decode a timeseries-scope PUT body as an append-delta payload,
+    or None for a full snapshot.  Same cheap-prefix contract as
+    :func:`_parse_metrics_delta` (``__tsdelta__`` is written first,
+    metrics/timeseries.py)."""
+    if b'"__tsdelta__"' not in body[:32]:
+        return None
+    try:
+        payload = json.loads(body)
+    except (ValueError, TypeError):
+        return None
+    if isinstance(payload, dict) and payload.get("__tsdelta__"):
+        return payload
+    return None
+
+
+def _merge_ts_delta(store, path: str, delta: dict,
+                    server_id: str) -> bytes:
+    """Append a timeseries delta into the stored per-rank document;
+    raises :class:`_DeltaResync` when the delta's base incarnation is
+    not this server or there is nothing to append into.  Each series is
+    trimmed to ``HVD_TIMESERIES_SERVER_CAP`` samples — the server-side
+    bound that keeps an always-on history from growing a per-rank doc
+    without limit."""
+    from ..utils import env as env_util
+
+    if delta.get("base_id") != server_id:
+        raise _DeltaResync()
+    cur_raw = store.get(path)
+    if cur_raw is None:
+        raise _DeltaResync()
+    try:
+        cur = json.loads(cur_raw)
+    except (ValueError, TypeError):
+        raise _DeltaResync()
+    series = cur.get("series")
+    if not isinstance(series, dict):
+        raise _DeltaResync()
+    cap = env_util.get_int(env_util.HVD_TIMESERIES_SERVER_CAP,
+                           env_util.DEFAULT_TIMESERIES_SERVER_CAP)
+    for name, entry in (delta.get("series") or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        dst = series.setdefault(name, {"samples": []})
+        samples = dst.get("samples")
+        if not isinstance(samples, list):
+            samples = dst["samples"] = []
+        new = [s for s in entry.get("samples") or ()
+               if isinstance(s, (list, tuple)) and len(s) == 2]
+        samples.extend([list(s) for s in new])
+        if len(samples) > cap:
+            del samples[:len(samples) - cap]
+        dst["seq"] = entry.get("seq", dst.get("seq"))
+        if entry.get("dropped"):
+            dst["dropped"] = dst.get("dropped", 0) + int(entry["dropped"])
+        if new:
+            dst["last_step"] = new[-1][0]
+    cur["ts"] = time.time()
     return json.dumps(cur).encode()
 
 
@@ -622,6 +768,16 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             self._reply(200, json.dumps(build_profile_report(store))
                         .encode(), content_type="application/json")
             return
+        if path == "/timeseries":
+            store = self.server.store.items()  # type: ignore
+            self._reply(200, json.dumps(build_timeseries_report(store))
+                        .encode(), content_type="application/json")
+            return
+        if path == "/alerts":
+            store = self.server.store.items()  # type: ignore
+            self._reply(200, json.dumps(build_alerts_report(store))
+                        .encode(), content_type="application/json")
+            return
         val = self.server.store.get(self.path)  # type: ignore
         if val is None:
             self._reply(404)
@@ -710,6 +866,14 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             delta = _parse_metrics_delta(body)
             if delta is not None:
                 body = _merge_metrics_delta(
+                    httpd.store, path, delta,  # type: ignore
+                    httpd.server_id)  # type: ignore[attr-defined]
+            apply_put(httpd, path, body)
+            return {"server_id": httpd.server_id}  # type: ignore
+        if path.startswith(_TIMESERIES_PREFIX):
+            delta = _parse_ts_delta(body)
+            if delta is not None:
+                body = _merge_ts_delta(
                     httpd.store, path, delta,  # type: ignore
                     httpd.server_id)  # type: ignore[attr-defined]
             apply_put(httpd, path, body)
@@ -923,6 +1087,15 @@ class RendezvousServer:
     def profile_report(self) -> Dict[str, object]:
         """In-process equivalent of GET /profile."""
         return build_profile_report(self.store.items())
+
+    def timeseries_report(self) -> Dict[str, object]:
+        """In-process equivalent of GET /timeseries (the watchdog's
+        per-tick read when it runs next to this server)."""
+        return build_timeseries_report(self.store.items())
+
+    def alerts_report(self) -> Dict[str, object]:
+        """In-process equivalent of GET /alerts."""
+        return build_alerts_report(self.store.items())
 
     def projection_report(self) -> Optional[Dict[str, object]]:
         """In-process equivalent of GET /projection (None when no

@@ -55,8 +55,11 @@ log = get_logger(__name__)
 RELAY_SCOPE = "relay"
 
 #: scopes whose PUTs are buffered + batched (last-writer-wins keys with
-#: a single writer per key); everything else passes through
-BATCH_SCOPES = frozenset({"health", "metrics", "sanitizer"})
+#: a single writer per key); everything else passes through.  The
+#: timeseries scope qualifies because relay-routed history pushes are
+#: full self-contained snapshots (metrics/timeseries.py disables the
+#: append-delta protocol behind a relay for exactly this reason).
+BATCH_SCOPES = frozenset({"health", "metrics", "sanitizer", "timeseries"})
 
 
 def host_slug() -> str:

@@ -689,6 +689,19 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
             env[env_util.HVD_METRICS_KV_ADDR], rdv_server.port,
             env[env_util.HVD_METRICS_KV_ADDR], rdv_server.port,
         )
+    # Online anomaly watchdog (observe/watchdog.py, HVD_WATCH=0
+    # disables): detectors over the flushed telemetry history, alerts
+    # on GET /alerts, auto-armed trace+profile windows on confirmed
+    # step-time/straggler regressions.
+    watchdog = None
+    if rdv_server is not None:
+        from ..observe import watchdog as watchdog_mod
+
+        watchdog = watchdog_mod.start_from_env(rdv_server)
+        if watchdog is not None:
+            log.info("watchdog: GET http://%s:%d/alerts is the alert "
+                     "log (docs/observe.md)",
+                     env[env_util.HVD_METRICS_KV_ADDR], rdv_server.port)
     restarts = getattr(args, "restarts", 0) or 0
     backoff_base = env_util.get_float(env_util.HVD_RESTART_BACKOFF_SECONDS,
                                       env_util.DEFAULT_RESTART_BACKOFF_SECONDS)
@@ -716,6 +729,10 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                     controller=controller, controller_host=ctrl_host,
                 )
                 controller_addr = driver.controller_addr
+                if watchdog is not None:
+                    # critical straggler alerts can feed this attempt's
+                    # driver removal path (HVD_WATCH_EVICT=1)
+                    watchdog.attach_driver(driver)
                 if serve_broker is not None:
                     # a lossily-removed replica's in-flight requests go
                     # back to the queue for a survivor (zero-drop-on-
@@ -800,6 +817,11 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
                     log.warning(         # external store: workers' epoch
                         "restart scope reset failed: %s", e)  # filter copes
     finally:
+        if watchdog is not None:
+            watchdog.stop()
+            log.info("watchdog: %d alert(s), %d armed window(s), %d "
+                     "eviction(s)", watchdog.alerts_emitted, watchdog.arms,
+                     watchdog.evictions)
         if rdv_server is not None:
             rdv_server.stop()
 
@@ -940,6 +962,11 @@ def run(fn, args=(), kwargs=None, np: int = 1,
     # uses base64-cloudpickle for the same purpose)
     server.put("job", "fn", cloudpickle.dumps((fn, args, kwargs)))
 
+    # same always-on watchdog as launch_job (HVD_WATCH=0 disables)
+    from ..observe import watchdog as watchdog_mod
+
+    watchdog = watchdog_mod.start_from_env(server)
+
     procs = []
     try:
         for pid in range(np):
@@ -1029,6 +1056,8 @@ def run(fn, args=(), kwargs=None, np: int = 1,
             grace_job = _Job()
             grace_job.procs = procs
             grace_job.kill_all()
+        if watchdog is not None:
+            watchdog.stop()
         if ctrl_server is not None:
             ctrl_server.stop()
         server.stop()

@@ -262,6 +262,11 @@ class ServingAutoscaler:
             if metrics.on():
                 if stats.get("p99_ms") is not None:
                     metrics.SERVE_P99_MS.set(stats["p99_ms"])
+                    from ..metrics import timeseries
+
+                    if timeseries.on():
+                        timeseries.record(timeseries.SERVE_P99_MS_SERIES,
+                                          stats["p99_ms"])
                 metrics.SERVE_REPLICAS.set(len(self.driver.world))
         except Exception:  # noqa: BLE001
             pass

@@ -315,7 +315,55 @@ def straggler_report(trace_dir: str, top: Optional[int] = None) -> dict:
     segments = segment_straggler_report(trace_dir, per_rank.keys())
     if segments:
         report["segments"] = segments
+    report["verdicts"] = straggler_verdicts(report)
     return report
+
+
+def straggler_verdicts(report: dict, *,
+                       skew_threshold: float = 1.3) -> dict:
+    """Machine-readable per-rank verdict block from a straggler report —
+    the shape the watchdog's drift detector consumes
+    (``observe.detectors.straggler_from_verdicts``), so offline trace
+    analysis and the live watchdog agree on who is late.
+
+    Each rank gets ``{"verdict": "straggler" | "ok", "skew", "basis"}``:
+
+    * with profiled compute (``segments``), ``skew`` is the rank's
+      total segment device time over the cross-rank median
+      (basis ``segment_device_us``) — late because *slow*;
+    * otherwise ``skew`` is ``1 + times_straggler / contested_tensors``
+      (basis ``negotiate_wait``) — a rank that arrived last for every
+      contested tensor scores 2.0, one never late scores 1.0.
+    """
+    verdicts: Dict[str, dict] = {}
+    segments = report.get("segments") or {}
+    totals: Dict[str, float] = {}
+    for seg in segments.values():
+        for rank, us in (seg.get("per_rank_device_us") or {}).items():
+            totals[str(rank)] = totals.get(str(rank), 0.0) + float(us)
+    if len(totals) >= 2:
+        ordered = sorted(totals.values())
+        mid = len(ordered) // 2
+        median = ordered[mid] if len(ordered) % 2 \
+            else (ordered[mid - 1] + ordered[mid]) / 2.0
+        for rank, total in totals.items():
+            ratio = total / median if median > 0 else 1.0
+            verdicts[rank] = {
+                "verdict": "straggler" if ratio >= skew_threshold else "ok",
+                "skew": round(ratio, 4),
+                "basis": "segment_device_us",
+            }
+    contested = len(report.get("tensors") or [])
+    for rank, d in (report.get("ranks") or {}).items():
+        if rank in verdicts:
+            continue
+        frac = (d.get("times_straggler", 0) / contested) if contested else 0.0
+        verdicts[rank] = {
+            "verdict": "straggler" if contested and frac >= 0.5 else "ok",
+            "skew": round(1.0 + frac, 4),
+            "basis": "negotiate_wait",
+        }
+    return {"ranks": verdicts, "skew_threshold": skew_threshold}
 
 
 def segment_straggler_report(trace_dir: str, ranks) -> Dict[str, dict]:

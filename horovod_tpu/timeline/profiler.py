@@ -536,6 +536,38 @@ class ComputeProfiler:
                     log.debug("xla trace capture unavailable: %s", e)
         return True
 
+    def arm(self, start_step: int, end_step: int, *,
+            current_step: Optional[int] = None,
+            trace_dir: Optional[str] = None) -> None:
+        """(Re)open the capture window — the watchdog's auto-arm seam
+        (observe/autoarm.py).
+
+        ``start_step``/``end_step`` are *global* training-step numbers
+        when ``current_step`` (the rank's cadence step) is given: the
+        counter is synced to it so a dormant profiler — constructed
+        disabled, never advanced — lands the window on the same steps
+        as every other rank.  A finalized profiler is reset for a
+        fresh capture; its next finalize overwrites compute.json and
+        re-pushes the anatomy.  Called from the telemetry flusher
+        thread, never the step path; the fields are plain ints/bools,
+        so the worst cross-thread interleaving with ``on_step`` is a
+        one-step window shift."""
+        if current_step is not None:
+            self._step = int(current_step)
+        if trace_dir and self.dir is None:
+            self.dir = os.path.join(trace_dir, str(self.rank))
+        self.start_step = max(int(start_step), self._step + 1)
+        self.end_step = int(end_step)
+        self._finalized = False
+        self._finalize_pending = False
+        self._started = False
+        self._events = []
+        self._clock = None
+        self.anatomy = None
+        self.enabled = True
+        log.info("compute profiler armed: steps [%d, %d]",
+                 self.start_step, self.end_step)
+
     # -- recording ----------------------------------------------------------
     @contextlib.contextmanager
     def step_span(self):
@@ -640,10 +672,20 @@ class ComputeProfiler:
 
             if not metrics.on() or self.anatomy is None:
                 return
+            from ..metrics import timeseries
+
             if self.anatomy["mfu"] is not None:
                 metrics.MFU.set(self.anatomy["mfu"])
+                if timeseries.on():
+                    timeseries.record(timeseries.MFU_SERIES,
+                                      self.anatomy["mfu"],
+                                      step=self._step)
             metrics.HOST_GAP_US.set(
                 self.anatomy["host_gap"]["per_step_us"])
+            if timeseries.on():
+                timeseries.record(timeseries.HOST_GAP_US_SERIES,
+                                  self.anatomy["host_gap"]["per_step_us"],
+                                  step=self._step)
             for name, d in self.anatomy["segments"].items():
                 metrics.STEP_PHASE_FRACTION.labels(name).set(d["fraction"])
             metrics.STEP_PHASE_FRACTION.labels("host_gap").set(

@@ -277,6 +277,33 @@ class Timeline:
             self.shutdown()
         return self._step
 
+    def arm(self, start_step: int, end_step: int, *,
+            current_step: Optional[int] = None,
+            directory: Optional[str] = None) -> bool:
+        """Move the trace window and (re)open the writer — the
+        watchdog's auto-arm seam (observe/autoarm.py).
+
+        ``start_step``/``end_step`` are *global* training-step numbers
+        when ``current_step`` (the rank's cadence step) is given; they
+        are translated onto this timeline's own counter (which counts
+        from writer-open), so every rank's window lands on the same
+        training steps regardless of when its writer opened.  Returns
+        False when no writer could be opened (no directory anywhere).
+        Called from the telemetry flusher thread, never the step
+        path."""
+        self.initialize(directory)
+        with self._lock:
+            if self._writer is None:
+                return False
+            offset = (self._step - int(current_step)
+                      if current_step is not None else 0)
+            self._start_step = max(int(start_step) + offset,
+                                   self._step + 1)
+            self._end_step = int(end_step) + offset
+        log.info("timeline armed: local steps [%d, %d]",
+                 self._start_step, self._end_step)
+        return True
+
     # -- events -------------------------------------------------------------
     def _ts_us(self) -> float:
         return (time.perf_counter() - self._origin) * 1e6

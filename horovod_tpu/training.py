@@ -553,6 +553,7 @@ def make_train_step(
         _rebuild(threshold_bytes, hierarchical)
 
     from . import metrics
+    from .metrics import timeseries as _timeseries
     from .timeline.timeline import timeline
 
     import time as _time
@@ -563,6 +564,12 @@ def make_train_step(
         from .timeline import profiler as _profiler_mod
 
         profiler = _profiler_mod.from_env()
+        if profiler is None and env_util.get_bool(env_util.HVD_WATCH_ARM,
+                                                  True):
+            # dormant profiler: disabled (on_step = one bool check per
+            # step) until the watchdog broadcasts an arm record, which
+            # re-enables it with a concrete window (observe/autoarm.py)
+            profiler = _profiler_mod.ComputeProfiler(enabled=False)
     elif profile:
         from .timeline.profiler import ComputeProfiler
 
@@ -570,6 +577,11 @@ def make_train_step(
         profiler = profiler if profiler.enabled else None
     else:
         profiler = None
+
+    if profiler is not None:
+        from .observe import autoarm as _autoarm
+
+        _autoarm.register_profiler(profiler)
 
     def _segment_cost(fn, args):
         """cost_analysis flops/bytes for one decomposed segment, plus
@@ -661,11 +673,19 @@ def make_train_step(
     # the device queue, making dispatch-to-dispatch time the real step
     # time without a single synchronization.
     last_dispatch = [0.0]
+    step_count = [0]
 
     def _record_step_metrics(x):
         now = _time.perf_counter()
+        step_count[0] += 1
         if last_dispatch[0]:
-            metrics.STEP_SECONDS.observe(now - last_dispatch[0])
+            dt = now - last_dispatch[0]
+            metrics.STEP_SECONDS.observe(dt)
+            # always-on cadence history (one ring-buffer append): the
+            # watchdog's step-time and straggler detectors read this
+            if _timeseries.on():
+                _timeseries.record(_timeseries.STEP_SECONDS, dt,
+                                   step=step_count[0])
         last_dispatch[0] = now
         metrics.STEPS_TOTAL.inc(max(in_graph_steps, 1))
         try:
@@ -696,6 +716,9 @@ def make_train_step(
         norm = residual_norm(new_state.residual)
         if metrics.on():
             metrics.COMPRESSION_RESIDUAL_NORM.set(norm)
+        if _timeseries.on():
+            _timeseries.record(_timeseries.RESIDUAL_NORM_SERIES, norm,
+                               step=step_count[0])
         if guard_box["guard"] is None:
             guard_box["guard"] = ErrorFeedbackGuard()
         if not guard_box["guard"].observe(norm):

@@ -197,6 +197,35 @@ HVD_RELAY_FLUSH_MS = "HVD_RELAY_FLUSH_MS"              # relay upstream batch-fl
 HVD_HTTP_KEEPALIVE = "HVD_HTTP_KEEPALIVE"              # 0 disables pooled keep-alive connections (debug)
 HVD_METRICS_DELTA = "HVD_METRICS_DELTA"                # 0 forces full metric snapshots every push (default delta)
 HVD_BENCH_CONTROL = "HVD_BENCH_CONTROL"                # 0 skips bench.py's control-plane churn leg
+# always-on telemetry time-series (metrics/timeseries.py, docs/observe.md):
+# bounded ring-buffer history of cheap signals, flushed through the relay
+# and served on the signed GET /timeseries
+HVD_TIMESERIES = "HVD_TIMESERIES"                      # 0 disables the ring-buffer history
+HVD_TIMESERIES_CAP = "HVD_TIMESERIES_CAP"              # raw-tier ring capacity, samples (default 512)
+HVD_TIMESERIES_TIERS = "HVD_TIMESERIES_TIERS"          # downsampling tiers incl. raw (default 3)
+HVD_TIMESERIES_FACTOR = "HVD_TIMESERIES_FACTOR"        # per-tier downsample factor (default 8)
+HVD_TIMESERIES_FLUSH_SECONDS = "HVD_TIMESERIES_FLUSH_SECONDS"  # flush interval (default HVD_METRICS_PUSH_SECONDS)
+HVD_TIMESERIES_SERVER_CAP = "HVD_TIMESERIES_SERVER_CAP"  # per-series sample cap in the server's per-rank doc (default 2048)
+# online anomaly watchdog (horovod_tpu/observe/, docs/observe.md):
+# detectors over the time-series history, alerts scope, auto-armed
+# trace+profile windows
+HVD_WATCH = "HVD_WATCH"                                # 0 disables the launcher-side watchdog
+HVD_WATCH_WINDOW = "HVD_WATCH_WINDOW"                  # detector trailing window, samples (default 64)
+HVD_WATCH_INTERVAL_SECONDS = "HVD_WATCH_INTERVAL_SECONDS"  # watchdog tick cadence (default 2)
+HVD_WATCH_EWMA_ALPHA = "HVD_WATCH_EWMA_ALPHA"          # step-time EWMA smoothing (default 0.5)
+HVD_WATCH_MAD_K = "HVD_WATCH_MAD_K"                    # regression threshold, robust sigmas above baseline (default 5)
+HVD_WATCH_CONFIRM = "HVD_WATCH_CONFIRM"                # consecutive breaches before an alert (default 3)
+HVD_WATCH_STRAGGLER_SKEW = "HVD_WATCH_STRAGGLER_SKEW"  # rank cadence / world median ratio read as straggling (default 1.3)
+HVD_WATCH_MFU_DROP_PCT = "HVD_WATCH_MFU_DROP_PCT"      # relative MFU drop vs baseline read as regression (default 20)
+HVD_WATCH_BETA_DRIFT = "HVD_WATCH_BETA_DRIFT"          # measured/predicted µs-per-MiB ratio read as comm drift (default 2)
+HVD_WATCH_SLO_BUDGET = "HVD_WATCH_SLO_BUDGET"          # tolerated SLO-breach sample fraction (default 0.01)
+HVD_WATCH_BURN_RATE = "HVD_WATCH_BURN_RATE"            # breach-fraction / budget ratio that alerts (default 2)
+HVD_WATCH_ARM = "HVD_WATCH_ARM"                        # 0 stops alerts from auto-arming trace windows (default 1)
+HVD_WATCH_ARM_STEPS = "HVD_WATCH_ARM_STEPS"            # auto-armed trace+profile window length (default 8)
+HVD_WATCH_ARM_MARGIN_STEPS = "HVD_WATCH_ARM_MARGIN_STEPS"  # arm start = newest observed step + margin (default 16)
+HVD_WATCH_ARM_COOLDOWN_SECONDS = "HVD_WATCH_ARM_COOLDOWN_SECONDS"  # min spacing between auto-arms (default 120)
+HVD_WATCH_EVICT = "HVD_WATCH_EVICT"                    # 1 feeds critical straggler alerts to the elastic removal path
+HVD_BENCH_WATCH = "HVD_BENCH_WATCH"                    # 0 skips bench.py's watchdog detection leg
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -237,6 +266,23 @@ DEFAULT_LOSS_FETCH_STEPS = 16                      # trailing loss-fetch cadence
 DEFAULT_PREFETCH_DEPTH = 2                         # device prefetch queue depth (data/loader.py)
 DEFAULT_CP_SHARDS = 8                              # run/store.py KV shard count
 DEFAULT_RELAY_FLUSH_MS = 500.0                     # run/relay.py upstream batch cadence
+DEFAULT_TIMESERIES_CAP = 512                       # metrics/timeseries.py raw-tier ring capacity
+DEFAULT_TIMESERIES_TIERS = 3                       # downsampling tiers including the raw tier
+DEFAULT_TIMESERIES_FACTOR = 8                      # per-tier downsample factor
+DEFAULT_TIMESERIES_SERVER_CAP = 2048               # per-series cap in the server's per-rank doc
+DEFAULT_WATCH_WINDOW = 64                          # observe/ detector trailing window, samples
+DEFAULT_WATCH_INTERVAL_SECONDS = 2.0               # watchdog tick cadence
+DEFAULT_WATCH_EWMA_ALPHA = 0.5                     # step-time regression EWMA smoothing
+DEFAULT_WATCH_MAD_K = 5.0                          # regression threshold in robust sigmas
+DEFAULT_WATCH_CONFIRM = 3                          # consecutive breaches before an alert
+DEFAULT_WATCH_STRAGGLER_SKEW = 1.3                 # cadence / world-median straggler ratio
+DEFAULT_WATCH_MFU_DROP_PCT = 20.0                  # relative MFU drop threshold, percent
+DEFAULT_WATCH_BETA_DRIFT = 2.0                     # measured/predicted comm-cost drift ratio
+DEFAULT_WATCH_SLO_BUDGET = 0.01                    # tolerated SLO-breach sample fraction
+DEFAULT_WATCH_BURN_RATE = 2.0                      # breach-fraction / budget alert ratio
+DEFAULT_WATCH_ARM_STEPS = 8                        # auto-armed trace+profile window length
+DEFAULT_WATCH_ARM_MARGIN_STEPS = 16                # arm start margin past the newest observed step
+DEFAULT_WATCH_ARM_COOLDOWN_SECONDS = 120.0         # min spacing between auto-arms
 
 
 def get_int(name: str, default: int) -> int:

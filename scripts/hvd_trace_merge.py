@@ -78,6 +78,14 @@ def main(argv=None) -> dict:
                               key=lambda kv: -kv[1]["spread_us"]):
             print(f"  {name:<28} {s['spread_us']:>10.1f}  "
                   f"rank {s['slowest_rank']}")
+    # the machine block the watchdog's drift detector consumes
+    # (observe.detectors.straggler_from_verdicts)
+    verdicts = (report.get("verdicts") or {}).get("ranks") or {}
+    if verdicts:
+        print("verdicts:")
+        for rank, v in sorted(verdicts.items(), key=lambda kv: kv[0]):
+            print(f"  rank {rank}: {v['verdict']} "
+                  f"(skew {v['skew']:.2f}x, basis {v['basis']})")
     return report
 
 

@@ -77,6 +77,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_projection_leg", lambda: {})
     monkeypatch.setattr(bench, "_compute_opt_leg", lambda: {})
     monkeypatch.setattr(bench, "_control_leg", lambda: {})
+    monkeypatch.setattr(bench, "_watch_leg", lambda: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
@@ -420,6 +421,74 @@ def test_control_leg_merged_and_skippable(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert "control_p99_lease_ms" not in out
     assert not any("--child-control" in c for c in calls)
+
+
+def test_watch_leg_merged_and_skippable(monkeypatch, capsys):
+    """The watchdog leg (docs/observe.md) lands watch_detect_steps /
+    watch_false_positives / watch_armed / watch_append_us in the JSON
+    tail, degrades to nulls on a hung child, and HVD_BENCH_WATCH=0
+    skips it."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        def __init__(self, line):
+            self.returncode = 0
+            self.stdout = "RESULT " + line + "\n"
+            self.stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        if "--child-watch" in cmd:
+            return FakeProc(json.dumps(
+                {"watch_detect_steps": 5, "watch_false_positives": 0,
+                 "watch_armed": True, "watch_append_us": 1.6,
+                 "watch_overhead_pct_1ms_step": 0.16}))
+        return FakeProc(json.dumps(payload))
+
+    for leg in ("_autotune_delta", "_compression_delta"):
+        monkeypatch.setattr(bench, leg, lambda v: {})
+    for leg in ("_serving_leg", "_projection_leg", "_compute_opt_leg",
+                "_control_leg"):
+        monkeypatch.setattr(bench, leg, lambda: {})
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_WATCH", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["watch_detect_steps"] == 5
+    assert out["watch_false_positives"] == 0
+    assert out["watch_armed"] is True
+    assert out["watch_append_us"] == 1.6
+    assert any("--child-watch" in c for c in calls)
+
+    # a hung watch child degrades to nulls, never costs the main number
+    def raise_for_leg(cmd, *a, **k):
+        if "--child-watch" in cmd:
+            raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench.subprocess, "run", raise_for_leg)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["watch_detect_steps"] is None
+    assert out["watch_armed"] is None
+    assert "timeout" in out["watch_error"]
+
+    # HVD_BENCH_WATCH=0: no child run, no tail fields
+    calls.clear()
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("HVD_BENCH_WATCH", "0")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "watch_detect_steps" not in out
+    assert not any("--child-watch" in c for c in calls)
 
 
 def test_run_timeout_retries_then_skips(monkeypatch, capsys):
