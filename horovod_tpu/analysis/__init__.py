@@ -5,17 +5,21 @@ runs next" *before* it becomes a hang:
 
 * **hvd_lint** (findings.py / collective_api.py / visitor.py / rules.py /
   cli.py): an AST pass over training code modelling the repo's collective
-  API surface.  Rule catalogue in rules.RULES (HVD001–HVD008), user docs
-  in docs/analysis.md, CLI at scripts/hvd_lint.py.
+  API surface.  Rule catalogue in rules.RULES (HVD001–HVD008 plus the
+  HVD016 ppermute-bijection check), user docs in docs/analysis.md, CLI
+  at scripts/hvd_lint.py.
 * **hvd_verify** (schedule/): the interprocedural schedule model checker
   — call graph + bounded per-rank path enumeration + pairwise per-group
-  sequence compatibility, emitting counterexample traces (HVD009–HVD012,
+  sequence compatibility over world/local/cross/process-set and
+  ``axis:<name>`` mesh-axis groups, with point-to-point (ppermute)
+  schedules first-class, emitting counterexample traces (HVD009–HVD015,
   schedule.SCHEDULE_RULES).  CLI at scripts/hvd_verify.py, also
   reachable as ``hvd_lint --model-check``.
 * **the collective sanitizer** (sanitizer.py): ``HVD_SANITIZER=1`` makes
   every eager dispatch fingerprint itself — group- and membership-epoch-
-  aware, vector-clock ordered — and cross-check against its group peers
-  through the rendezvous KV store, raising a diagnostic that names the
+  aware, vector-clock ordered, permutation identity included for
+  point-to-point ops — and cross-check against its group peers through
+  the rendezvous KV store, raising a diagnostic that names the
   diverging rank and both signatures instead of deadlocking.
 """
 

@@ -4,7 +4,7 @@ scripts/hvd_verify.py are the entry points).
 Exit codes: 0 clean, 1 findings, 2 usage error — the shape CI expects
 from a linter.  ``hvd_lint --model-check`` runs the schedule model
 checker (analysis/schedule/) in the same session and merges its
-HVD009–HVD012 findings into the lint report.
+HVD009–HVD015 findings into the lint report.
 """
 
 from __future__ import annotations
@@ -47,7 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exit 0 when only warning-severity findings remain")
     p.add_argument("--model-check", action="store_true",
                    help="also run the interprocedural schedule model "
-                        "checker (HVD009-HVD012; scripts/hvd_verify.py is "
+                        "checker (HVD009-HVD015; scripts/hvd_verify.py is "
                         "the standalone driver)")
     return p
 

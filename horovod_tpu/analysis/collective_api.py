@@ -87,6 +87,17 @@ TRACE_SAFE_DOTTED = frozenset({
 SIGNATURE_KEYWORDS = ("op", "root_rank", "process_set", "dtype",
                       "compression")
 
+#: point-to-point lax primitives: collective-permutes with an explicit
+#: sender→receiver pairing (the schedule checker lowers these to
+#: SendRecv events; the sanitizer folds their permutation into the
+#: fingerprint so permutation divergence is a signature mismatch)
+P2P_COLLECTIVES = frozenset({"ppermute", "pshuffle"})
+
+#: ``lax.all_to_all`` layout keywords — part of the dispatch identity:
+#: two ranks disagreeing on split/concat axes or tiling exchange
+#: incompatibly-shaped shards
+SHUFFLE_KEYWORDS = ("split_axis", "concat_axis", "tiled")
+
 
 #: tails too generic to match on name alone — only these attribute bases
 #: (or a bare imported name) count.  ``join`` collides with

@@ -36,6 +36,10 @@ RULES: Dict[str, Tuple[str, str]] = {
                           "(not in the utils/env.py inventory)"),
     "HVD008": ("warning", "collective result discarded — the API is "
                           "functional, the reduced value is the return"),
+    "HVD016": ("error",
+               "ppermute permutation literal is not a bijection "
+               "(duplicate source or destination — a duplicated "
+               "destination silently overwrites the earlier send)"),
 }
 
 
@@ -224,8 +228,41 @@ def rule_hvd008(facts: FileFacts) -> List[Finding]:
     ]
 
 
+def rule_hvd016(facts: FileFacts) -> List[Finding]:
+    """A ppermute permutation literal must be a bijection on the pairs
+    it names: each source sends at most once and each destination
+    receives at most once.  A duplicated destination silently
+    overwrites the earlier send (last-writer-wins, no error at
+    dispatch); a duplicated source drops all but one of its sends."""
+    out = []
+    for pc in facts.perm_calls:
+        srcs = [s for s, _ in pc.pairs]
+        dsts = [d for _, d in pc.pairs]
+        dup_src = sorted({s for s in srcs if srcs.count(s) > 1})
+        dup_dst = sorted({d for d in dsts if dsts.count(d) > 1})
+        if not dup_src and not dup_dst:
+            continue
+        bits = []
+        if dup_dst:
+            bits.append(
+                "destination(s) " + ", ".join(map(str, dup_dst))
+                + " receive from multiple sources — the later send "
+                  "silently overwrites the earlier one")
+        if dup_src:
+            bits.append(
+                "source(s) " + ", ".join(map(str, dup_src))
+                + " send more than once — only one send survives")
+        out.append(_finding(
+            "HVD016",
+            f"'{pc.tail}' permutation {pc.pairs} is not a bijection: "
+            + "; ".join(bits),
+            facts.path, pc.line, pc.col,
+        ))
+    return out
+
+
 _FILE_RULES = (rule_hvd001, rule_hvd002, rule_hvd004, rule_hvd005,
-               rule_hvd006, rule_hvd008)
+               rule_hvd006, rule_hvd008, rule_hvd016)
 
 
 # ---------------------------------------------------------------------------

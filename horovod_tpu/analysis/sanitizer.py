@@ -19,11 +19,19 @@ hang.
 * ``group`` names the communication group the dispatch reduces over —
   ``world`` for flat collectives, ``local:<node>`` / ``cross:<chunk>``
   for the two-level stages (parallel/hierarchical.py surfaces the stage
-  plan to dispatch), ``process_set:…`` for restricted communicators.
-  Sequence numbers count **per (group, epoch)** and checks compare only
-  the group's members, so a two_level run no longer cross-matches its
-  intra-host stage on one rank against the cross-host stage on another
-  — the flat-world false mismatch this plane shipped with.
+  plan to dispatch), ``process_set:…`` for restricted communicators, and
+  ``axis:<name>:<instance>`` for one instance of a named mesh axis (the
+  runtime spelling of the model checker's ``axis:<name>`` group labels —
+  a 2×3 tp×pp mesh has three ``axis:tp:<k>`` groups and two
+  ``axis:pp:<k>`` groups).  Sequence numbers count **per (group,
+  epoch)** and checks compare only the group's members, so a two_level
+  run no longer cross-matches its intra-host stage on one rank against
+  the cross-host stage on another — the flat-world false mismatch this
+  plane shipped with.  Point-to-point ops (``ppermute`` /
+  ``all_to_all``) carry their permutation / axis identity in the
+  fingerprint (``perm``), so two stage ranks dispatching the same op
+  with different permutations is a signature divergence naming both
+  permutations, not a silent data swap.
 * ``epoch`` is the elastic membership epoch (elastic/membership.py).
   Under ``HVD_SANITIZER_EPOCH_STRICT`` (default) fingerprints only match
   within one epoch, so a rank still draining epoch N never pairs with a
@@ -34,8 +42,9 @@ hang.
   each peer issued the shared (group, seq) dispatches; two shared
   dispatches issued in opposite clock order on two ranks is a
   **cross-group ordering inversion** (the runtime twin of hvd_verify's
-  HVD011) and raises instead of deadlocking with both ranks blocked in
-  different groups' collectives.
+  HVD011 — named as HVD014 when both streams are ``axis:`` groups, the
+  mesh-shaped inversion) and raises instead of deadlocking with both
+  ranks blocked in different groups' collectives.
 
 This is a debug plane: every check is one KV PUT plus size-1 GET-polls
 per group peer, so it multiplies eager-dispatch latency — leave it off
@@ -64,6 +73,11 @@ DEFAULT_TIMEOUT_SECONDS = 60.0
 
 #: the flat-world group label (every rank participates)
 WORLD_GROUP = "world"
+
+#: runtime mesh-axis group labels: ``axis:<name>:<instance>`` — the
+#: prefix matches the static checker's (schedule/ir.py
+#: GROUP_AXIS_PREFIX); colons are KV-safe (keys split on ``.``)
+AXIS_GROUP_PREFIX = "axis:"
 
 #: how many verified sequence numbers each rank keeps published per
 #: (group, epoch) before garbage-collecting its own old fingerprints.
@@ -97,7 +111,7 @@ def group_key(group: str) -> str:
 
 def fingerprint(seq: int, *, op: str, name: str, shape: Sequence[int],
                 dtype, group: str = WORLD_GROUP, epoch: int = 0,
-                clock: int = 0) -> dict:
+                clock: int = 0, perm: Optional[str] = None) -> dict:
     return {
         "seq": int(seq),
         "op": str(op),
@@ -107,12 +121,24 @@ def fingerprint(seq: int, *, op: str, name: str, shape: Sequence[int],
         "group": str(group),
         "epoch": int(epoch),
         "clock": int(clock),
+        "perm": str(perm) if perm is not None else "",
     }
 
 
 def _sig(fp: dict) -> str:
+    perm = fp.get("perm") or ""
     return (f"{fp['op']}(name={fp['name']!r}, shape={tuple(fp['shape'])}, "
-            f"dtype={fp['dtype']})")
+            f"dtype={fp['dtype']}"
+            + (f", perm={perm}" if perm else "") + ")")
+
+
+def _cmp_view(fp: dict) -> dict:
+    """The fields two peers' fingerprints must agree on.  ``perm``
+    normalizes absent → "" so fingerprints published by an older build
+    (no perm field) compare equal to a perm-less dispatch."""
+    view = {k: fp.get(k) for k in ("op", "name", "shape", "dtype")}
+    view["perm"] = fp.get("perm") or ""
+    return view
 
 
 class OrderIndex:
@@ -326,13 +352,18 @@ class Sanitizer:
     def check(self, *, op: str, name: str, shape: Sequence[int], dtype,
               group: str = WORLD_GROUP,
               peers: Optional[Sequence[int]] = None,
-              epoch: Optional[int] = None) -> int:
+              epoch: Optional[int] = None,
+              perm: Optional[str] = None) -> int:
         """Fingerprint + cross-check one collective dispatch within its
         communication group.  ``peers`` is the group's member ranks
-        (default: all ranks — the flat world).  Returns the per-(group,
-        epoch) sequence number it verified; raises
-        CollectiveDivergenceError on signature divergence, a silent
-        peer, or a cross-group ordering inversion.
+        (default: all ranks — the flat world).  ``perm`` is the
+        permutation / axis identity of a point-to-point dispatch
+        (ppermute pair list, all_to_all split spec) — part of the
+        compared signature, so stage ranks disagreeing on the
+        permutation raise naming both.  Returns the per-(group, epoch)
+        sequence number it verified; raises CollectiveDivergenceError
+        on signature divergence, a silent peer, or a cross-group
+        ordering inversion.
 
         The peer wait is batched (docs/control_plane.md): every poll
         round is ONE cursor-based scope read covering all peers of all
@@ -355,7 +386,7 @@ class Sanitizer:
         if retired_epoch is not None:
             self._gc_epoch(group, retired_epoch)
         mine = fingerprint(seq, op=op, name=name, shape=shape, dtype=dtype,
-                           group=group, epoch=epoch, clock=clock)
+                           group=group, epoch=epoch, clock=clock, perm=perm)
         self._publish(self._kv_key(group, match_epoch, seq, self.rank),
                       mine)
         need = {peer: self._kv_key(group, match_epoch, seq, peer)
@@ -368,10 +399,7 @@ class Sanitizer:
                 theirs = self._scope_cache.get(need[peer])
                 if theirs is None:
                     continue
-                if {k: theirs.get(k) for k in ("op", "name", "shape",
-                                               "dtype")} \
-                        != {k: mine[k] for k in ("op", "name", "shape",
-                                                 "dtype")}:
+                if _cmp_view(theirs) != _cmp_view(mine):
                     self._raise(
                         f"collective sanitizer: divergence at sequence "
                         f"{seq} of group '{group}' (epoch {epoch}) — rank "
@@ -383,13 +411,21 @@ class Sanitizer:
                     int(theirs.get("clock", 0)))
                 if inverted is not None:
                     g2, _, s2 = inverted
+                    both_axes = (str(g2).startswith(AXIS_GROUP_PREFIX)
+                                 and str(group).startswith(
+                                     AXIS_GROUP_PREFIX))
+                    kind = ("cross-axis ordering inversion (runtime "
+                            "HVD014)" if both_axes
+                            else "cross-group ordering inversion")
                     self._raise(
-                        "collective sanitizer: cross-group ordering "
-                        f"inversion — rank {self.rank} issued sequence "
+                        f"collective sanitizer: {kind} — rank "
+                        f"{self.rank} issued sequence "
                         f"{s2} of group '{g2}' before sequence {seq} of "
                         f"group '{group}' ({_sig(mine)}), but rank {peer} "
                         "issued them in the opposite order; each rank "
-                        "blocks in a different group's collective"
+                        "blocks in a different "
+                        + ("axis's" if both_axes else "group's")
+                        + " collective"
                     )
                 del need[peer]
             if not need:
@@ -492,9 +528,10 @@ def reset() -> None:
 
 def maybe_check(*, op: str, name: str, shape: Sequence[int], dtype,
                 group: str = WORLD_GROUP,
-                peers: Optional[Sequence[int]] = None) -> None:
+                peers: Optional[Sequence[int]] = None,
+                perm: Optional[str] = None) -> None:
     """The eager._dispatch_guard hook: no-op unless HVD_SANITIZER=1."""
     s = instance()
     if s is not None:
         s.check(op=op, name=name, shape=shape, dtype=dtype,
-                group=group, peers=peers)
+                group=group, peers=peers, perm=perm)

@@ -5,20 +5,28 @@ The static half of the correctness story the runtime sanitizer
 interprocedural call graph over the training program, enumerate the
 execution paths each rank can take through rank-tainted control flow,
 project every path's collective sequence *per communication group*
-(flat world, intra-host local, cross-host, process sets, per-epoch
-elastic worlds), and prove the sequences pairwise compatible — or emit
-a machine-checkable counterexample naming the diverging rank set, the
-collective, and the exact branch chain (file:line per decision).
+(flat world, intra-host local, cross-host, process sets, named mesh
+axes as ``axis:<name>``, per-epoch elastic worlds), and prove the
+sequences pairwise compatible — or emit a machine-checkable
+counterexample naming the diverging rank set, the collective, and the
+exact branch chain (file:line per decision).  Point-to-point schedules
+(``lax.ppermute``) lower to SendRecv events so pipeline handoffs are
+first-class.
 
-Rules HVD009–HVD012 (SCHEDULE_RULES, docs/analysis.md):
+Rules HVD009–HVD015 (SCHEDULE_RULES, docs/analysis.md):
 
 * HVD009 — schedule divergence within one group;
 * HVD010 — blocking collective reachable on a strict subset of ranks;
 * HVD011 — cross-group ordering inversion (intra vs cross stages);
-* HVD012 — collective on an abort/cleanup path that peers skip.
+* HVD012 — collective on an abort/cleanup path that peers skip;
+* HVD013 — unmatched/cyclic point-to-point schedule (pipeline deadlock);
+* HVD014 — cross-axis ordering inversion (HVD011 over mesh axes);
+* HVD015 — axis-shape contract violation (mesh declaration vs dispatch).
 
 Entry points: ``scripts/hvd_verify.py`` and ``hvd_lint --model-check``.
-Bounds: HVD_VERIFY_MAX_PATHS / HVD_VERIFY_LOOP_BOUND (utils/env.py).
+Bounds: HVD_VERIFY_MAX_PATHS / HVD_VERIFY_LOOP_BOUND (utils/env.py);
+every loop unrolled to the bound is surfaced in the report's
+``loop_bounds`` field (entry, loop kind, file:line, bound).
 """
 
 from .checker import (  # noqa: F401
@@ -29,5 +37,12 @@ from .checker import (  # noqa: F401
     render_result_json,
     render_result_text,
 )
-from .ir import Collective, Entry, FunctionInfo  # noqa: F401
+from .ir import (  # noqa: F401
+    Collective,
+    Entry,
+    FunctionInfo,
+    SendRecv,
+    axis_group,
+    is_axis_group,
+)
 from .paths import Decision, Dispatch, Enumerator, Path  # noqa: F401

@@ -16,11 +16,25 @@ least one rank/data/exception decision) is compared group by group:
   ordering inversion: intra-host vs cross-host stages issued in a
   different relative order deadlock even though each group's own
   schedule matches — the static twin of the sanitizer's vector-clock
-  check).
+  check), or **HVD014** when both groups are mesh axes (two axes'
+  collectives issued in opposite orders on members that share both —
+  HVD011 generalized to the DP×TP×PP mesh);
+* a point-to-point event (SendRecv — ``lax.ppermute``) on one side of a
+  conflict or prefix is **HVD013** (unmatched or cyclic point-to-point
+  schedule: a send whose matching recv is unreachable on the peer's
+  path, or mismatched permutations forming a wait-for cycle across
+  stage ranks — the classic pipeline deadlock);
+* independent of path enumeration, a collective whose literal shape
+  assumption contradicts a literal mesh declaration — a permutation
+  naming a stage rank outside the axis, or an untiled all_to_all whose
+  split dimension differs from the axis size (MoE capacity vs
+  expert-axis size) — is **HVD015** (axis-shape contract violation).
 
 Each finding carries a machine-checkable counterexample: the entry, the
 group, the collective, both projected sequences, and the exact branch
-chain (file:line, condition, arm) that separates the two rank sets.
+chain (file:line, condition, arm) that separates the two rank sets
+(HVD015 substitutes the mesh declaration for the branch chain: its two
+"rank sets" are the declared members vs the assumed participants).
 """
 
 from __future__ import annotations
@@ -29,7 +43,14 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from ..findings import Finding, Suppressions, sort_findings
 from .callgraph import CallGraph
-from .ir import Entry
+from .ir import (
+    Collective,
+    Entry,
+    SendRecv,
+    axis_name,
+    is_axis_group,
+    walk_events,
+)
 from .paths import (
     DEFAULT_LOOP_BOUND,
     DEFAULT_MAX_PATHS,
@@ -54,6 +75,19 @@ SCHEDULE_RULES: Dict[str, Tuple[str, str]] = {
     "HVD012": ("error",
                "collective reachable from an abort/cleanup path that "
                "peers skip"),
+    "HVD013": ("error",
+               "unmatched or cyclic point-to-point schedule: a ppermute "
+               "send whose matching recv is unreachable on the peer's "
+               "path, or mismatched permutations forming a wait-for "
+               "cycle across stage ranks (pipeline deadlock)"),
+    "HVD014": ("error",
+               "cross-axis ordering inversion: two mesh axes' collectives "
+               "issued in opposite orders on members that share both "
+               "axes"),
+    "HVD015": ("error",
+               "axis-shape contract violation: collective assumes an "
+               "axis size/divisibility the mesh declaration cannot "
+               "satisfy"),
 }
 
 
@@ -114,6 +148,19 @@ class _Dedup:
         return True
 
 
+def _wait_cycle(sr: SendRecv) -> str:
+    """The wait-for cycle an unmatched permute produces, named by
+    concrete stage ranks when the permutation is a literal pair list."""
+    if sr.pairs:
+        s, d = sr.pairs[0]
+        return (f"stage rank {s}'s send waits on stage rank {d} entering "
+                f"the permute, and stage rank {d} waits on a dispatch it "
+                f"never reaches: wait-for cycle stage {s} -> stage {d} "
+                f"-> stage {s} (pipeline deadlock)")
+    return ("each sender waits on a peer recv that never pairs up: "
+            "wait-for cycle across stage ranks (pipeline deadlock)")
+
+
 def _finding(rule: str, message: str, dispatch: Dispatch,
              counterexample: dict) -> Finding:
     site = dispatch.collective.site
@@ -169,18 +216,35 @@ def _check_pair(entry: Entry, a: Path, b: Path,
         all_equal = False
         if k < len(sa) and k < len(sb):
             da, db = sa[k], sb[k]
-            if not dedup.fresh("HVD009", group, da.collective.site,
+            p2p = isinstance(da.collective, SendRecv) \
+                or isinstance(db.collective, SendRecv)
+            rule = "HVD013" if p2p else "HVD009"
+            if not dedup.fresh(rule, group, da.collective.site,
                                db.collective.site):
                 continue
+            if p2p:
+                msg = (
+                    f"cyclic point-to-point schedule in group '{group}': "
+                    f"{_rank_set(chain_a)} dispatch "
+                    f"{da.collective.describe()} while "
+                    f"{_rank_set(chain_b)} dispatch "
+                    f"{db.collective.describe()} at "
+                    f"{db.collective.site} — the permutations do not "
+                    "pair up; each stage rank waits for a send its peer "
+                    "never issues (wait-for cycle across stage ranks)"
+                )
+            else:
+                msg = (
+                    f"schedule divergence in group '{group}': "
+                    f"{_rank_set(chain_a)} dispatch "
+                    f"{da.collective.describe()} as collective #{k + 1} "
+                    f"while {_rank_set(chain_b)} dispatch "
+                    f"{db.collective.describe()} at "
+                    f"{db.collective.site} — the group deadlocks at "
+                    "negotiation"
+                )
             out.append(_finding(
-                "HVD009",
-                f"schedule divergence in group '{group}': "
-                f"{_rank_set(chain_a)} dispatch "
-                f"{da.collective.describe()} as collective #{k + 1} while "
-                f"{_rank_set(chain_b)} dispatch "
-                f"{db.collective.describe()} at "
-                f"{db.collective.site} — the group deadlocks at "
-                "negotiation",
+                rule, msg,
                 da, _counterexample(entry, group, da, a, b,
                                     chain_a, chain_b),
             ))
@@ -191,10 +255,24 @@ def _check_pair(entry: Entry, a: Path, b: Path,
         extra = (sa if len(sa) > len(sb) else sb)[k]
         chain_l = chain_a if longer is a else chain_b
         chain_s = chain_b if longer is a else chain_a
-        rule = "HVD012" if extra.collective.cleanup else "HVD010"
+        if isinstance(extra.collective, SendRecv):
+            rule = "HVD013"
+        elif extra.collective.cleanup:
+            rule = "HVD012"
+        else:
+            rule = "HVD010"
         if not dedup.fresh(rule, group, extra.collective.site):
             continue
-        if rule == "HVD012":
+        if rule == "HVD013":
+            msg = (
+                f"unmatched point-to-point send: "
+                f"{extra.collective.describe()} in group '{group}' is "
+                f"reachable only by {_rank_set(chain_l)}; "
+                f"{_rank_set(chain_s) if chain_s else 'the peer stage ranks'}"
+                " never dispatch the matching recv — "
+                + _wait_cycle(extra.collective)
+            )
+        elif rule == "HVD012":
             msg = (
                 f"collective {extra.collective.describe()} runs on an "
                 f"abort/cleanup path ({_rank_set(chain_l)}) that "
@@ -249,21 +327,106 @@ def _check_inversion(entry: Entry, a: Path, b: Path, groups, dedup: _Dedup,
                 continue
             da = a.events[oa[y]]
             db_ev = b.events[ob[x]]
-            if not dedup.fresh("HVD011", x[0], y[0],
+            # HVD011 generalizes to HVD014 when both groups are mesh
+            # axes: members sharing both axes see the two axes' streams
+            # in opposite orders — the mesh-shaped inversion
+            both_axes = is_axis_group(x[0]) and is_axis_group(y[0])
+            rule = "HVD014" if both_axes else "HVD011"
+            if not dedup.fresh(rule, x[0], y[0],
                                da.collective.site):
                 continue
+            if both_axes:
+                msg = (
+                    f"cross-axis ordering inversion: {_rank_set(chain_a)} "
+                    f"issue {da.collective.describe()} "
+                    f"(axis '{axis_name(y[0])}') after axis "
+                    f"'{axis_name(x[0])}', but {_rank_set(chain_b)} issue "
+                    f"{db_ev.collective.describe()} "
+                    f"(axis '{axis_name(x[0])}') after axis "
+                    f"'{axis_name(y[0])}' — members sharing both axes "
+                    "block in different axes' collectives"
+                )
+            else:
+                msg = (
+                    f"cross-group ordering inversion: {_rank_set(chain_a)} "
+                    f"issue {da.collective.describe()} (group '{y[0]}') "
+                    f"after group '{x[0]}', but {_rank_set(chain_b)} issue "
+                    f"{db_ev.collective.describe()} (group '{x[0]}') after "
+                    f"group '{y[0]}' — each rank set blocks in a different "
+                    "group's collective"
+                )
             found.append(_finding(
-                "HVD011",
-                f"cross-group ordering inversion: {_rank_set(chain_a)} "
-                f"issue {da.collective.describe()} (group '{y[0]}') after "
-                f"group '{x[0]}', but {_rank_set(chain_b)} issue "
-                f"{db_ev.collective.describe()} (group '{x[0]}') after "
-                f"group '{y[0]}' — each rank set blocks in a different "
-                "group's collective",
+                rule, msg,
                 da, _counterexample(entry, None, da, a, b,
                                     chain_a, chain_b),
             ))
     return found
+
+
+def _check_contracts(functions, axis_sizes: Dict[str, Tuple],
+                     dedup: _Dedup) -> List[Finding]:
+    """HVD015 — literal shape assumptions vs literal mesh declarations.
+    Needs no path enumeration: the contract is violated on every path
+    that reaches the dispatch.  Two assumption sources: a literal
+    permutation naming a stage rank the axis does not have, and an
+    untiled split-axis-0 all_to_all whose (frame-tracked) literal split
+    dimension differs from the axis size — the MoE dispatch contract
+    (parallel/moe.py reshapes to (ep, …) before its all_to_all)."""
+    out: List[Finding] = []
+    for fn in functions:
+        for ev in walk_events(fn.body):
+            if not isinstance(ev, Collective) or not is_axis_group(ev.group):
+                continue
+            name = axis_name(ev.group)
+            decl = axis_sizes.get(name)
+            if decl is None:
+                continue
+            size, decl_site = decl
+            if isinstance(ev, SendRecv) and ev.pairs:
+                top = max(max(p) for p in ev.pairs)
+                if top < size:
+                    continue
+                msg = (
+                    f"axis-shape contract violation: {ev.describe()} "
+                    f"names stage rank {top}, but axis '{name}' is "
+                    f"declared with {size} member(s) at {decl_site} — "
+                    "the mesh cannot satisfy the permutation"
+                )
+                assumed = f"stage ranks up to {top} named by the permutation"
+            elif ev.assumes_size is not None and ev.assumes_size != size:
+                msg = (
+                    f"axis-shape contract violation: {ev.describe()} "
+                    f"splits a leading dimension of {ev.assumes_size} "
+                    f"over axis '{name}', declared with {size} member(s) "
+                    f"at {decl_site} — an untiled split-axis-0 "
+                    "all_to_all requires the split dimension to equal "
+                    "the axis size (MoE capacity vs expert-axis size)"
+                )
+                assumed = (f"the {ev.assumes_size} participant(s) the "
+                           "split dimension assumes")
+            else:
+                continue
+            if not dedup.fresh("HVD015", ev.site):
+                continue
+            dispatch = Dispatch(collective=ev, stack=())
+            out.append(_finding("HVD015", msg, dispatch, {
+                "entry": fn.qualname,
+                "entry_kind": "contract",
+                "world": "static",
+                "group": ev.group,
+                "collective": {"op": ev.op, "name": ev.name,
+                               "file": ev.site.file, "line": ev.site.line},
+                "rank_set_a": (f"all {size} member(s) of axis '{name}' "
+                               f"(declared at {decl_site})"),
+                "rank_set_b": assumed,
+                "branch_chain_a": [],
+                "branch_chain_b": [],
+                "call_stack": [],
+                "schedule_a": [f"{ev.describe()} @ {ev.site}"],
+                "schedule_b": [f"axis '{name}' = {size} member(s) "
+                               f"@ {decl_site}"],
+            }))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -284,6 +447,11 @@ class CheckResult:
         self.entries: int = 0
         self.paths_explored: int = 0
         self.truncated: bool = False
+        #: the loop bound the enumeration ran under, and every loop it
+        #: unrolled to that bound — per entry, with file:line — so a
+        #: truncated (pipeline micro-batch) deadlock search is visible
+        self.loop_bound: int = DEFAULT_LOOP_BOUND
+        self.loop_bounds: List[dict] = []
 
 
 def check_sources(sources: Sequence[Tuple[str, str]], *,
@@ -303,7 +471,9 @@ def check_sources(sources: Sequence[Tuple[str, str]], *,
     disabled = set(disable) | _disabled_from_env()
 
     result = CheckResult()
+    result.loop_bound = loop_bound
     functions = []
+    axis_sizes: Dict[str, Tuple] = {}
     supp: Dict[str, Suppressions] = {}
     for path, source in sources:
         s = Suppressions.parse(source)
@@ -313,7 +483,8 @@ def check_sources(sources: Sequence[Tuple[str, str]], *,
             from .extract import Extractor
 
             tree = ast.parse(source, filename=path)  # ONE parse per file
-            infos = Extractor(path, tree).extract()
+            extractor = Extractor(path, tree)
+            infos = extractor.extract()
         except SyntaxError as e:
             result.findings.append(Finding(
                 rule="HVD000", message=f"syntax error: {e.msg}", file=path,
@@ -326,16 +497,26 @@ def check_sources(sources: Sequence[Tuple[str, str]], *,
             pass
         supp[path] = s
         functions.extend(infos)
+        for name, decl in extractor.axis_sizes.items():
+            axis_sizes.setdefault(name, decl)
 
     graph = CallGraph(functions)
     enum = Enumerator(graph, max_paths=max_paths, loop_bound=loop_bound)
     dedup = _Dedup()
     findings = list(result.findings)
+    findings.extend(_check_contracts(functions, axis_sizes, dedup))
     for entry in graph.entries(explicit=entries):
         res = enum.enumerate(entry)
         result.entries += 1
         result.paths_explored += len(res.paths)
         result.truncated = result.truncated or res.truncated
+        for loop_site, loop_kind in res.loops:
+            lf, _, lline = loop_site.rpartition(":")
+            result.loop_bounds.append({
+                "entry": entry.fn.qualname, "file": lf,
+                "line": int(lline) if lline.isdigit() else 0,
+                "loop": loop_kind, "bound": loop_bound,
+            })
         by_uniform: Dict[Tuple, List[Path]] = {}
         for p in res.paths:
             by_uniform.setdefault(p.uniform_key(), []).append(p)
@@ -414,7 +595,10 @@ def render_result_text(result: CheckResult) -> str:
     tail += (f"  [{result.entries} entr(ies), "
              f"{result.paths_explored} path(s)"
              + (", BOUNDED — raise HVD_VERIFY_MAX_PATHS for more"
-                if result.truncated else "") + "]")
+                if result.truncated else "")
+             + (f", {len(result.loop_bounds)} loop(s) unrolled to bound "
+                f"{result.loop_bound} — see loop_bounds in --json"
+                if result.loop_bounds else "") + "]")
     lines.append(tail)
     return "\n".join(lines)
 
@@ -428,4 +612,6 @@ def render_result_json(result: CheckResult) -> str:
         "entries": result.entries,
         "paths_explored": result.paths_explored,
         "truncated": result.truncated,
+        "loop_bound": result.loop_bound,
+        "loop_bounds": result.loop_bounds,
     }, indent=1)

@@ -19,7 +19,23 @@ Group assignment for a collective call site:
   three stage dispatches the runtime actually issues — reduce-scatter on
   the local group, the reduction on the cross group, all-gather on the
   local group (parallel/hierarchical.py);
+* a raw ``lax`` primitive's positional/``axis_name=`` mesh-axis argument
+  → ``axis:<name>`` for a string constant, ``axis:<expr>`` for a
+  symbolic axis (two sites share the group iff they spell the same
+  axis — same contract as ``groups:<expr>``);
 * everything else → ``world``.
+
+On top of groups, the mesh-specific lowerings for the ``parallel/``
+islands: ``ppermute``/``pshuffle`` become :class:`~.ir.SendRecv` events
+carrying their permutation (HVD013's input), ``lax.scan(body, …)`` over
+a file-local ``def`` becomes a :class:`~.ir.Loop` of kind ``"scan"``
+inlining the body (the pipeline micro-batch loop, unrolled to the loop
+bound), mesh declarations (``jax.make_mesh((2, 3), ("dp", "pp"))`` and
+``Mesh(mesh_utils.create_device_mesh(…), …)``) record literal axis sizes,
+and an ``all_to_all`` splitting a literal-reshaped leading dimension
+records that size as its axis-shape assumption (HVD015's input).  Branch
+taint is unchanged: ``lax.axis_index(axis)`` is in the rank-call family,
+so a branch on it is per-member of the axis — rank-flavored.
 """
 
 from __future__ import annotations
@@ -45,7 +61,9 @@ from .ir import (
     Loop,
     Raise,
     Return,
+    SendRecv,
     Site,
+    axis_group,
 )
 
 #: direct hierarchical entry points that expand into stage dispatches
@@ -87,14 +105,72 @@ def classify_groups_expr(text: str) -> str:
     return f"groups:{text}"
 
 
+#: point-to-point lax primitives, lowered to SendRecv events
+_P2P_TAILS = frozenset({"ppermute", "pshuffle"})
+
+
+def classify_axis_expr(node) -> str:
+    """Map a lax primitive's mesh-axis argument to an ``axis:`` group
+    label: a string constant names the axis directly; anything else is
+    symbolic and keeps its source text (two sites share the axis iff
+    they spell the same expression — the ``groups:<expr>`` contract)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return axis_group(node.value)
+    return axis_group(_expr_text(node))
+
+
+def _int_tuple(node) -> Optional[tuple]:
+    """A literal tuple/list of ints, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        if not (isinstance(el, ast.Constant) and isinstance(el.value, int)
+                and not isinstance(el.value, bool)):
+            return None
+        out.append(el.value)
+    return tuple(out)
+
+
+def _str_tuple(node) -> Optional[tuple]:
+    """A literal tuple/list of strings, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+            return None
+        out.append(el.value)
+    return tuple(out)
+
+
+def _perm_literal_pairs(node) -> Optional[tuple]:
+    """A literal ppermute permutation — ``[(src, dst), …]`` with int
+    constants — as a tuple of pairs, else None (symbolic perms keep
+    only their source text)."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    pairs = []
+    for el in node.elts:
+        pair = _int_tuple(el)
+        if pair is None or len(pair) != 2:
+            return None
+        pairs.append(pair)
+    return tuple(pairs)
+
+
 class _Frame:
-    __slots__ = ("traced", "params", "rank_tainted", "data_tainted")
+    __slots__ = ("traced", "params", "rank_tainted", "data_tainted",
+                 "leading_dim")
 
     def __init__(self, traced: bool, params: Set[str]):
         self.traced = traced
         self.params = params
         self.rank_tainted: Set[str] = set()
         self.data_tainted: Set[str] = set()
+        #: locals last assigned from ``x.reshape(<int literal>, …)`` —
+        #: the literal leading dimension an all_to_all over them splits
+        self.leading_dim: Dict[str, int] = {}
 
 
 class Extractor:
@@ -114,6 +190,37 @@ class Extractor:
             n.name for n in ast.walk(tree)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         }
+        #: axis name → (declared size, site) from literal mesh
+        #: declarations in this file — HVD015's ground truth
+        self.axis_sizes: Dict[str, tuple] = self._mesh_axis_sizes(tree)
+
+    def _mesh_axis_sizes(self, tree) -> Dict[str, tuple]:
+        """Literal mesh-axis declarations: ``jax.make_mesh((2, 3),
+        ("dp", "pp"))`` directly, or ``Mesh(mesh_utils.
+        create_device_mesh((2, 3)), ("dp", "pp"))`` through the device
+        mesh helper.  Only fully-literal shapes count — a symbolic mesh
+        declares nothing the checker can hold collectives to."""
+        sizes: Dict[str, tuple] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            tail = _tail(node.func)
+            shape = names = None
+            if tail == "make_mesh":
+                shape = _int_tuple(node.args[0])
+                names = _str_tuple(node.args[1])
+            elif tail == "Mesh":
+                names = _str_tuple(node.args[1])
+                dev = node.args[0]
+                if isinstance(dev, ast.Call) \
+                        and _tail(dev.func) == "create_device_mesh" \
+                        and dev.args:
+                    shape = _int_tuple(dev.args[0])
+            if shape and names and len(shape) == len(names):
+                site = self._site(node)
+                for name, n in zip(names, shape):
+                    sizes.setdefault(name, (n, site))
+        return sizes
 
     # -- module-level discovery ---------------------------------------------
     @staticmethod
@@ -194,6 +301,26 @@ class Extractor:
     def _site(self, node) -> Site:
         return Site(self.path, node.lineno, getattr(node, "col_offset", 0))
 
+    def _track_leading_dim(self, targets, value) -> None:
+        """Keep the frame's literal-leading-dimension map current: a
+        single-Name assignment from ``x.reshape(<int>, …)`` records the
+        literal; any other assignment to the name invalidates it."""
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            return
+        name = targets[0].id
+        lead = None
+        if isinstance(value, ast.Call) and _tail(value.func) == "reshape" \
+                and value.args:
+            first = value.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, int) \
+                    and not isinstance(first.value, bool):
+                lead = first.value
+        if lead is None:
+            self._frame.leading_dim.pop(name, None)
+        else:
+            self._frame.leading_dim[name] = lead
+
     # -- collective lowering -------------------------------------------------
     def _collective_events(self, node: ast.Call, cleanup: str) -> List[Event]:
         tail = _tail(node.func)
@@ -202,6 +329,8 @@ class Extractor:
         sig: Dict[str, str] = {}
         group = GROUP_WORLD
         staged = tail in _TWO_LEVEL_TAILS
+        axis_kw = None
+        perm_kw = None
         for kw in node.keywords:
             if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
                     and isinstance(kw.value.value, str):
@@ -219,6 +348,12 @@ class Extractor:
             elif kw.arg in ("two_level", "hierarchical") \
                     and _truthy_const(kw.value):
                 staged = True
+            elif kw.arg == "axis_name":
+                axis_kw = kw.value
+            elif kw.arg == "perm":
+                perm_kw = kw.value
+            elif kw.arg in api.SHUFFLE_KEYWORDS and tail == "all_to_all":
+                sig[kw.arg] = _sig_source(kw.value)
         if staged:
             # the three stage dispatches the runtime issues
             # (parallel/hierarchical.py: local RS → cross AR → local AG)
@@ -231,8 +366,49 @@ class Extractor:
                 Collective(op="allgather", name=name_kw, group=GROUP_LOCAL,
                            signature={}, site=site, cleanup=cleanup),
             ]
+        if tail in api.LAX_COLLECTIVES and group == GROUP_WORLD:
+            # the raw primitives take the mesh axis positionally (or as
+            # axis_name=): lax.psum(x, "pp") communicates on axis:pp, not
+            # on the whole world — a subgroup label like local/cross wins
+            # when axis_index_groups restricts membership further
+            axis = axis_kw if axis_kw is not None else (
+                node.args[1] if len(node.args) >= 2 else None)
+            if axis is not None:
+                group = classify_axis_expr(axis)
+        if tail in _P2P_TAILS:
+            perm = perm_kw if perm_kw is not None else (
+                node.args[2] if len(node.args) >= 3 else None)
+            return [SendRecv(
+                op=tail, name=name_kw, group=group, signature=sig,
+                site=site, cleanup=cleanup,
+                perm=_expr_text(perm) if perm is not None else "",
+                pairs=_perm_literal_pairs(perm),
+            )]
+        assumes = None
+        if tail == "all_to_all" and sig.get("split_axis") == "0" \
+                and sig.get("tiled") not in ("True", "true"):
+            assumes = self._leading_literal(node.args[0]) if node.args \
+                else None
         return [Collective(op=tail, name=name_kw, group=group, signature=sig,
-                           site=site, cleanup=cleanup)]
+                           site=site, cleanup=cleanup, assumes_size=assumes)]
+
+    def _leading_literal(self, operand) -> Optional[int]:
+        """The literal leading dimension of an all_to_all operand, when
+        visible: either a direct ``x.reshape(<int>, …)`` or a local last
+        assigned from one (frame-tracked).  That dimension is the split
+        dimension, which an untiled split-axis-0 all_to_all requires to
+        EQUAL the axis size — the MoE dispatch contract."""
+        if isinstance(operand, ast.Call) and _tail(operand.func) == "reshape" \
+                and operand.args:
+            lead = operand.args[0]
+            if isinstance(lead, ast.Constant) and isinstance(lead.value, int) \
+                    and not isinstance(lead.value, bool):
+                return lead.value
+        if isinstance(operand, ast.Name):
+            for f in reversed(self._frames):
+                if operand.id in f.leading_dim:
+                    return f.leading_dim[operand.id]
+        return None
 
     def _expr_events(self, expr, cleanup: str = "") -> List[Event]:
         """Collective + call events inside one expression, in source
@@ -254,6 +430,16 @@ class Extractor:
                 is_coll = False
             if is_coll:
                 out.extend(self._collective_events(node, cleanup))
+            elif tail == "scan" and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in self._local_defs:
+                # lax.scan over a file-local body — the pipeline
+                # micro-batch loop: trip count symbolic (stage count /
+                # tick count), modelled as a bounded-unrolled Loop over
+                # the body's schedule
+                out.append(Loop(kind="scan", site=self._site(node),
+                                body=[Call(target=node.args[0].id,
+                                           site=self._site(node))]))
             elif tail and tail not in _OPAQUE_TAILS \
                     and not api.is_trace_wrapper(tail):
                 out.append(Call(target=tail, site=self._site(node)))
@@ -289,6 +475,7 @@ class Extractor:
                 else [stmt.target]
             if value is not None:
                 self._taint_targets(targets, value)
+                self._track_leading_dim(targets, value)
             return self._expr_events(value, cleanup)
         if isinstance(stmt, ast.Expr):
             return self._expr_events(stmt.value, cleanup)

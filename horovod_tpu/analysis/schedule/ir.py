@@ -12,10 +12,20 @@ per group.
 Communication groups are symbolic labels, not rank lists: a whole-world
 collective is ``world``, an intra-host stage is ``local``, a cross-host
 stage is ``cross``, a restricted communicator is ``process_set:<expr>``,
-and a raw ``axis_index_groups=`` argument classifies by its source text.
-Two collectives commute in the schedule iff their groups differ — that
-is exactly the property the runtime sanitizer's vector clock enforces
-(analysis/sanitizer.py), and what HVD011 checks statically.
+a named mesh axis is ``axis:<name>`` (the label a ``lax.psum(x, "pp")``
+or any positional/``axis_name=`` mesh-axis argument lowers to — the same
+vocabulary the future DP×TP×PP mesh dispatches under), and a raw
+``axis_index_groups=`` argument classifies by its source text.  Two
+collectives commute in the schedule iff their groups differ — that is
+exactly the property the runtime sanitizer's vector clock enforces
+(analysis/sanitizer.py), and what HVD011/HVD014 check statically.
+
+Point-to-point schedules (``lax.ppermute`` / ``pshuffle``) lower to
+:class:`SendRecv` — a :class:`Collective` subclass carrying the
+permutation expression (symbolic in the stage count when the source
+builds it that way) and, when the permutation is a literal pair list,
+the concrete (source, destination) stage ranks.  The checker's HVD013
+(pipeline deadlock) and HVD015 (axis-shape contract) reason over those.
 """
 
 from __future__ import annotations
@@ -27,6 +37,26 @@ from typing import Dict, List, Optional, Tuple, Union
 GROUP_WORLD = "world"
 GROUP_LOCAL = "local"
 GROUP_CROSS = "cross"
+
+#: prefix of mesh-axis group labels: ``axis:<name>`` for a collective
+#: over one named mesh axis (``lax.psum(x, "pp")`` → ``axis:pp``; a
+#: symbolic axis argument keeps its source text, so two sites agree on
+#: the group iff they spell the same axis expression)
+GROUP_AXIS_PREFIX = "axis:"
+
+
+def axis_group(name: str) -> str:
+    """The ``axis:<name>`` group label for a named mesh axis."""
+    return f"{GROUP_AXIS_PREFIX}{name}"
+
+
+def is_axis_group(group: str) -> bool:
+    return group.startswith(GROUP_AXIS_PREFIX)
+
+
+def axis_name(group: str) -> str:
+    """The axis name of an ``axis:<name>`` label (``""`` otherwise)."""
+    return group[len(GROUP_AXIS_PREFIX):] if is_axis_group(group) else ""
 
 #: branch flavors, by who can take different arms
 FLAVOR_UNIFORM = "uniform"      # all ranks take the same arm (unknown which)
@@ -58,6 +88,10 @@ class Collective:
     signature: Dict[str, str]                # normalized signature kwargs
     site: Site
     cleanup: str = ""                        # "" | "except" — abort-path flag
+    #: literal axis-size assumption this dispatch encodes, if any (the
+    #: leading split dimension of an all_to_all over an axis group);
+    #: checked against mesh declarations by HVD015
+    assumes_size: Optional[int] = None
 
     def key(self) -> Tuple:
         """Schedule-equality key: two dispatches match iff these agree."""
@@ -69,6 +103,34 @@ class Collective:
         bits += [f"{k}={v}" for k, v in sorted(self.signature.items())]
         inner = ", ".join(bits)
         return f"{self.op}({inner})" if inner else f"{self.op}()"
+
+
+@dataclass
+class SendRecv(Collective):
+    """A point-to-point schedule event: one ``lax.ppermute``/``pshuffle``
+    dispatch.  Still a collective at the XLA level — every member of the
+    axis must enter the permute — but the checker additionally knows who
+    sends to whom: ``perm`` keeps the permutation's source text (symbolic
+    when built from stage arithmetic like ``[(i, (i + 1) % s) …]``) and
+    ``pairs`` the concrete (source, destination) stage ranks when the
+    permutation is a literal pair list."""
+
+    perm: str = ""                           # permutation expression text
+    pairs: Optional[Tuple[Tuple[int, int], ...]] = None
+
+    def key(self) -> Tuple:
+        # two permutes only pair up when their permutations agree — a
+        # perm mismatch IS a schedule conflict (HVD013), so perm is part
+        # of schedule equality
+        return (self.op, self.name, self.group, self.perm,
+                tuple(sorted(self.signature.items())))
+
+    def describe(self) -> str:
+        bits = [f"name={self.name!r}"] if self.name else []
+        if self.perm:
+            bits.append(f"perm={self.perm}")
+        bits += [f"{k}={v}" for k, v in sorted(self.signature.items())]
+        return f"{self.op}({', '.join(bits)})"
 
 
 @dataclass
@@ -92,10 +154,12 @@ class Branch:
 
 @dataclass
 class Loop:
-    """A uniform loop (``for``, or ``while`` on an untainted condition):
-    every rank runs the same (unknown) trip count, bounded-unrolled."""
+    """A uniform loop (``for``, a ``while`` on an untainted condition, or
+    a ``lax.scan`` over a local body function — the pipeline micro-batch
+    loop): every rank runs the same (unknown, symbolic-in-stage-count)
+    trip count, bounded-unrolled to HVD_VERIFY_LOOP_BOUND."""
 
-    kind: str                                # "for" | "while"
+    kind: str                                # "for" | "while" | "scan"
     site: Site
     body: List["Event"] = field(default_factory=list)
 

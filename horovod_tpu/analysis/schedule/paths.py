@@ -87,6 +87,11 @@ class Path:
 class EnumerationResult:
     paths: List[Path] = field(default_factory=list)
     truncated: bool = False
+    #: loops this entry's paths unrolled to the loop bound, as
+    #: ("file:line", kind) in first-encounter order — surfaced so a
+    #: truncated (pipeline micro-batch) deadlock search is visible,
+    #: never silent
+    loops: List[Tuple[str, str]] = field(default_factory=list)
 
 
 class Enumerator:
@@ -113,13 +118,18 @@ class Enumerator:
                                inline=(entry.fn.qualname,))
         seen = set()
         paths: List[Path] = []
+        loops: dict = {}
         for p in partials:
             path = Path(entry=entry, decisions=p.decisions, events=p.events)
             key = (path.decisions, tuple(d.key() for d in path.events))
             if key not in seen:
                 seen.add(key)
                 paths.append(path)
-        return EnumerationResult(paths=paths, truncated=self._truncated)
+            for d in p.decisions:
+                if d.kind == "loop":
+                    loops.setdefault(d.site, d.condition)
+        return EnumerationResult(paths=paths, truncated=self._truncated,
+                                 loops=list(loops.items()))
 
     # -- internals -----------------------------------------------------------
     # Call stacks are attached only when a callee summary is spliced into

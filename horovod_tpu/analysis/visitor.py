@@ -100,6 +100,17 @@ class IOCall:
 
 
 @dataclass
+class PermCall:
+    """A point-to-point collective (``lax.ppermute``) whose permutation
+    argument is a literal pair list — checkable for bijectivity."""
+
+    line: int
+    col: int
+    tail: str
+    pairs: List[Tuple[int, int]]
+
+
+@dataclass
 class EnvRead:
     line: int
     col: int
@@ -113,6 +124,7 @@ class FileFacts:
     rank_branches: List[BranchInfo] = field(default_factory=list)
     dynamic_branches: List[DynamicBranch] = field(default_factory=list)
     io_calls: List[IOCall] = field(default_factory=list)
+    perm_calls: List[PermCall] = field(default_factory=list)
     env_reads: List[EnvRead] = field(default_factory=list)
     mutable_defaults: List[Tuple[int, int, str]] = field(default_factory=list)
     bare_excepts: List[Tuple[int, int]] = field(default_factory=list)
@@ -162,6 +174,27 @@ _ENV_GETTERS = frozenset({"get_str", "get_int", "get_bool", "get_float",
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set)
 _MUTABLE_CTORS = frozenset({"list", "dict", "set", "defaultdict",
                             "OrderedDict", "deque"})
+
+
+def _perm_pairs(node) -> Optional[List[Tuple[int, int]]]:
+    """Literal ``[(src, dst), …]`` pairs of a ppermute perm argument,
+    else None — comprehensions and symbolic perms are out of scope here
+    (the schedule model checker reasons about those)."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    pairs: List[Tuple[int, int]] = []
+    for elt in node.elts:
+        if not isinstance(elt, (ast.Tuple, ast.List)) \
+                or len(elt.elts) != 2:
+            return None
+        pair = []
+        for e in elt.elts:
+            if isinstance(e, ast.Constant) and type(e.value) is int:
+                pair.append(e.value)
+            else:
+                return None
+        pairs.append((pair[0], pair[1]))
+    return pairs
 
 
 def _wrapped_function_names(tree: ast.AST) -> Set[str]:
@@ -391,6 +424,18 @@ class FactVisitor(ast.NodeVisitor):
                 name_kw=name_kw, signature=sig,
                 depth=len(self._frames),
             ))
+        if tail in api.P2P_COLLECTIVES and not shadowed:
+            perm = None
+            for kw in node.keywords:
+                if kw.arg == "perm":
+                    perm = kw.value
+            if perm is None and len(node.args) >= 3:
+                perm = node.args[2]
+            pairs = _perm_pairs(perm) if perm is not None else None
+            if pairs is not None:
+                self.facts.perm_calls.append(PermCall(
+                    node.lineno, node.col_offset, tail, pairs,
+                ))
         self._check_blocking(node, tail)
         self._check_env_read(node, tail)
         self.generic_visit(node)

@@ -13,6 +13,14 @@ tokens and ``experts_per_rank`` experts; E = ep_size *
 experts_per_rank.  Top-1 routing with per-expert capacity C — tokens
 beyond capacity are dropped (standard GShard semantics; size C
 generously for tests).
+
+Verification: the dispatch/combine ``all_to_all`` pair is modelled by
+the schedule checker under the ``axis:<ep>`` group; the untiled
+split-axis-0 contract (leading dispatch dimension == ep axis size) is
+HVD015's axis-shape check — a literal capacity reshape that contradicts
+a literal mesh declaration is flagged statically.  This module's
+dispatch tensors are shaped by the symbolic axis size, so the contract
+holds by construction.
 """
 
 from __future__ import annotations

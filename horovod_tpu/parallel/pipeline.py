@@ -18,6 +18,14 @@ flow, no Python loop over ticks — and is differentiable end-to-end
 counter-rotate through the pipeline automatically).
 
 Bubble fraction is the usual (S-1)/(M+S-1); pick M >> S.
+
+Verification: the handoff ``ppermute`` lowers to a SendRecv event in
+the schedule model checker (``hvd_verify``, HVD013) under the
+``axis:<name>`` group of the pp axis; the micro-batch ``lax.scan``
+unrolls to HVD_VERIFY_LOOP_BOUND and is surfaced in the report's
+``loop_bounds`` field.  Repo self-verify (tests/test_hvd_verify.py)
+keeps this module finding-free — the rotation is unconditional on every
+stage rank, so every send has its matching recv.
 """
 
 from __future__ import annotations

@@ -21,6 +21,12 @@ TPU build treats as first-class, on the same collective backend:
 
 Both run inside ``hvd.spmd`` regions on the flat mesh axis and compose
 with the data-parallel dimension by using a 2-D (dp, sp) mesh.
+
+Verification: every K/V rotation ``ppermute`` is a SendRecv event in
+the schedule checker (HVD013) and the Ulysses ``all_to_all`` a
+collective under the ``axis:<name>`` group; the rotations run
+unconditionally on every ring member each scan step, which is exactly
+what keeps repo self-verify finding-free here.
 """
 
 from __future__ import annotations

@@ -23,6 +23,15 @@ Usage::
 
 ``TP_MLP_RULES`` maps parameter path suffixes to PartitionSpecs; extend
 with your model's layer names (attention qkv → column, out-proj → row).
+
+Verification: this island is deliberately INVISIBLE to the schedule
+model checker — GSPMD derives the tp collectives inside the partitioner,
+so there is no ``lax.psum`` in this source for ``hvd_verify`` to lower
+(its ``axis:`` group coverage sees explicit collectives only).  That is
+a feature, not a gap: per-rank schedule divergence cannot be authored
+here because XLA emits one identical program for every mesh member.
+The runtime sanitizer likewise only guards the eager control plane, not
+the compiled step.
 """
 
 from __future__ import annotations

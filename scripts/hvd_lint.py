@@ -14,6 +14,7 @@ otherwise surface as cross-rank hangs:
     HVD006  bare except
     HVD007  undeclared HVD_* env read
     HVD008  collective result discarded
+    HVD016  ppermute permutation literal is not a bijection
 
 Run::
 

@@ -8,12 +8,17 @@ rank can take through rank-tainted branches (loops unrolled up to
 HVD_VERIFY_LOOP_BOUND, at most HVD_VERIFY_MAX_PATHS paths per entry),
 projects every path's collective sequence per communication group
 (world / intra-host local / cross-host / process sets / per-epoch
-elastic worlds), and checks the sequences pairwise:
+elastic worlds / ``axis:<name>`` mesh axes, with ``ppermute`` lowered
+to first-class point-to-point SendRecv events), and checks the
+sequences pairwise:
 
     HVD009  schedule divergence within one group
     HVD010  blocking collective reachable on a strict subset of ranks
     HVD011  cross-group ordering inversion (intra vs cross stages)
     HVD012  collective on an abort/cleanup path that peers skip
+    HVD013  unmatched/cyclic point-to-point schedule (pipeline deadlock)
+    HVD014  cross-AXIS ordering inversion (HVD011 over mesh axes)
+    HVD015  axis-shape contract violation (MoE capacity vs axis size)
 
 A finding prints a counterexample trace — the diverging rank set, the
 collective, and the exact branch chain (file:line per decision) — in

@@ -1,0 +1,12 @@
+"""Known-bad: unmatched point-to-point send (HVD013) — stage rank 0
+sends its activations into the pipeline handoff permute, but the guard
+keeps stage rank 1 from ever entering the ppermute: rank 1 never posts
+the matching recv, rank 0 blocks forever — the 2-stage pipeline
+deadlock."""
+from jax import lax
+
+
+def handoff(acts):
+    if lax.axis_index("pp") == 0:
+        acts = lax.ppermute(acts, "pp", [(0, 1)])  # line 11: HVD013
+    return acts

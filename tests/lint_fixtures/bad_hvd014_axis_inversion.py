@@ -1,0 +1,16 @@
+"""Known-bad: cross-axis ordering inversion (HVD014) — tensor-parallel
+rank 0 reduces over axis 'tp' then axis 'pp' while its peers reduce
+'pp' then 'tp'; each axis's own sequence matches, but a member sharing
+both axes blocks in a different axis's collective on each side —
+HVD011 generalized to the DPxTPxPP mesh."""
+from jax import lax
+
+
+def step(g):
+    if lax.axis_index("tp") == 0:
+        a = lax.psum(g, "tp")
+        b = lax.psum(g, "pp")  # line 12: HVD014
+    else:
+        b = lax.psum(g, "pp")
+        a = lax.psum(g, "tp")
+    return a + b

@@ -1,0 +1,15 @@
+"""Known-bad: axis-shape contract violation (HVD015) — the mesh
+declares the expert axis 'ep' with 3 members, but the MoE dispatch
+reshapes to a leading capacity dimension of 4 before an untiled
+split-axis-0 all_to_all: the split dimension must equal the axis size
+(MoE capacity vs expert-axis size)."""
+import jax
+from jax import lax
+
+mesh = jax.make_mesh((2, 3), ("dp", "ep"))
+
+
+def dispatch(tokens, d):
+    buffers = tokens.reshape(4, 8, d)
+    return lax.all_to_all(buffers, "ep", split_axis=0,
+                          concat_axis=0)  # line 14: HVD015

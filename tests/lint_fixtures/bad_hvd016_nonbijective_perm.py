@@ -1,0 +1,8 @@
+"""Known-bad: non-bijective ppermute permutation (HVD016) —
+destination 1 receives from both source 0 and source 2; dispatch does
+not error, the later send silently overwrites the earlier one."""
+from jax import lax
+
+
+def shift(x):
+    return lax.ppermute(x, "pp", [(0, 1), (2, 1)])  # line 8: HVD016

@@ -1,0 +1,13 @@
+"""Known-good twin of bad_hvd014: both arms issue the two axes'
+collectives in the same relative order (tp stage first)."""
+from jax import lax
+
+
+def step(g):
+    if lax.axis_index("tp") == 0:
+        a = lax.psum(g, "tp")
+        b = lax.psum(g, "pp")
+    else:
+        a = lax.psum(g * 2.0, "tp")
+        b = lax.psum(g, "pp")
+    return a + b

@@ -1,0 +1,12 @@
+"""Known-good twin of bad_hvd015: the dispatch reshapes to a leading
+dimension of exactly the declared expert-axis size (3), so the untiled
+split-axis-0 all_to_all contract holds."""
+import jax
+from jax import lax
+
+mesh = jax.make_mesh((2, 3), ("dp", "ep"))
+
+
+def dispatch(tokens, d):
+    buffers = tokens.reshape(3, 8, d)
+    return lax.all_to_all(buffers, "ep", split_axis=0, concat_axis=0)

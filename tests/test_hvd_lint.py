@@ -47,6 +47,8 @@ CORPUS = {
                "good_hvd007_declared_env.py"),
     "HVD008": ("bad_hvd008_discarded.py", [7],
                "good_hvd008_assigned.py"),
+    "HVD016": ("bad_hvd016_nonbijective_perm.py", [8],
+               "good_hvd016_bijective_perm.py"),
 }
 
 

@@ -43,7 +43,18 @@ CORPUS = {
                "good_hvd011_ordering_inversion.py"),
     "HVD012": ("bad_hvd012_abort_path.py", [16],
                "good_hvd012_abort_path.py"),
+    "HVD013": ("bad_hvd013_pipeline_deadlock.py", [11],
+               "good_hvd013_pipeline_deadlock.py"),
+    "HVD014": ("bad_hvd014_axis_inversion.py", [12],
+               "good_hvd014_axis_inversion.py"),
+    "HVD015": ("bad_hvd015_axis_contract.py", [14],
+               "good_hvd015_axis_contract.py"),
 }
+
+#: rules whose counterexample needs no divergent branch chain — HVD015
+#: is a contract check (mesh declaration vs dispatch), not a two-path
+#: divergence, so both chains are empty by design
+_CHAINLESS = {"HVD015"}
 
 
 def test_corpus_covers_every_schedule_rule():
@@ -69,7 +80,11 @@ def test_known_bad_fixture_fires_exact_rule_and_lines(rule):
     for f in findings:
         ce = f.extra["counterexample"]
         assert ce["entry"] and ce["collective"]["op"]
-        assert ce["branch_chain_a"] or ce["branch_chain_b"]
+        if rule in _CHAINLESS:
+            assert ce["branch_chain_a"] == [] == ce["branch_chain_b"]
+            assert ce["schedule_a"] and ce["schedule_b"]
+        else:
+            assert ce["branch_chain_a"] or ce["branch_chain_b"]
 
 
 @pytest.mark.parametrize("rule", sorted(CORPUS))
@@ -125,8 +140,10 @@ def test_json_output_schema():
     assert proc.returncode == 1, proc.stderr
     payload = json.loads(proc.stdout)
     assert set(payload) == {"findings", "count", "entries",
-                            "paths_explored", "truncated"}
+                            "paths_explored", "truncated",
+                            "loop_bound", "loop_bounds"}
     assert payload["count"] == 1 and not payload["truncated"]
+    assert payload["loop_bound"] == 2 and payload["loop_bounds"] == []
     f = payload["findings"][0]
     assert {"rule", "message", "file", "line", "col", "severity",
             "counterexample"} <= set(f)
@@ -312,6 +329,163 @@ def test_compression_wire_format_is_part_of_the_signature():
     findings = check_sources([("w.py", src)]).findings
     assert [f.rule for f in findings] == ["HVD009"]
     assert "int8" in findings[0].message and "bf16" in findings[0].message
+
+
+def test_pipeline_deadlock_counterexample_pinned():
+    """ACCEPTANCE: a hand-written 2-stage pipeline deadlock emits a
+    counterexample naming both stage ranks, the wait-for cycle, and the
+    branch chain with file:line — pinned exactly."""
+    bad = _fixture("bad_hvd013_pipeline_deadlock.py")
+    result = check_paths([bad])
+    assert [f.rule for f in result.findings] == ["HVD013"]
+    f = result.findings[0]
+    # both stage ranks + the wait-for cycle, by name
+    assert "stage rank 0" in f.message and "stage rank 1" in f.message
+    assert "wait-for cycle stage 0 -> stage 1 -> stage 0" in f.message
+    assert "pipeline deadlock" in f.message
+    ce = f.extra["counterexample"]
+    assert ce["group"] == "axis:pp"
+    assert ce["collective"] == {"op": "ppermute", "name": None,
+                                "file": bad, "line": 11}
+    # the branch chain that separates the two stage rank sets, file:line
+    chain = ce["branch_chain_a"] + ce["branch_chain_b"]
+    assert chain and chain[0]["file"] == bad and chain[0]["line"] == 10
+    assert chain[0]["flavor"] == "rank"
+    assert "axis_index" in chain[0]["condition"]
+    # …and the rendered text carries all of it
+    text = render_result_text(result)
+    assert "wait-for cycle stage 0 -> stage 1 -> stage 0" in text
+    assert f"{bad}:10" in text and "group: axis:pp" in text
+
+
+def test_mismatched_permutations_are_cyclic_hvd013():
+    """Both stage rank sets enter a permute, but with different
+    permutations — the conflict shape of HVD013 (not a prefix)."""
+    src = (
+        "from jax import lax\n"
+        "def handoff(x):\n"
+        "    if lax.axis_index('pp') == 0:\n"
+        "        x = lax.ppermute(x, 'pp', [(0, 1)])\n"
+        "    else:\n"
+        "        x = lax.ppermute(x, 'pp', [(1, 0)])\n"
+        "    return x\n"
+    )
+    findings = check_sources([("p.py", src)]).findings
+    assert [f.rule for f in findings] == ["HVD013"]
+    assert "cyclic point-to-point schedule" in findings[0].message
+    assert "[(0, 1)]" in findings[0].message
+    assert "[(1, 0)]" in findings[0].message
+
+
+def test_axis_group_label_grammar():
+    """Group labels: a string-constant mesh axis lowers to axis:<name>,
+    a symbolic axis to axis:<expr> (two sites agree iff they spell the
+    same expression), and axis_index_groups takes precedence over the
+    positional axis."""
+    src = (
+        "from jax import lax\n"
+        "def f(x, axes, groups):\n"
+        "    a = lax.psum(x, 'tp')\n"
+        "    b = lax.psum(x, axes[0])\n"
+        "    c = lax.psum(x, 'tp', axis_index_groups=groups)\n"
+        "    return a + b + c\n"
+    )
+    from horovod_tpu.analysis.schedule.extract import Extractor
+    import ast
+    tree = ast.parse(src)
+    fns = Extractor("g.py", tree).extract()
+    f = next(fn for fn in fns if fn.qualname.endswith("::f"))
+    from horovod_tpu.analysis.schedule.ir import walk_events, Collective
+    groups = [ev.group for ev in walk_events(f.body)
+              if isinstance(ev, Collective)]
+    assert groups == ["axis:tp", "axis:axes[0]", "groups:groups"]
+
+
+def test_loop_bounds_surfaced_per_entry():
+    """SATELLITE fix: every loop unrolled to the bound is reported
+    per-entry in loop_bounds — which loop, which bound, file:line — in
+    JSON and mentioned in the text tail."""
+    src = (
+        "from jax import lax\n"
+        "def tick(carry, x):\n"
+        "    return carry, lax.psum(x, 'pp')\n"
+        "def pipeline(xs):\n"
+        "    return lax.scan(tick, 0, xs)\n"
+        "def train(xs):\n"
+        "    for _ in range(3):\n"
+        "        xs = pipeline(xs)\n"
+        "    return xs\n"
+    )
+    result = check_sources([("lb.py", src)], loop_bound=2)
+    assert result.findings == []
+    assert result.loop_bound == 2
+    recs = {(r["entry"], r["file"], r["line"], r["loop"], r["bound"])
+            for r in result.loop_bounds}
+    assert ("lb.py::train", "lb.py", 7, "for", 2) in recs
+    # the scan loop inside the inlined callee is attributed to the
+    # calling entry — the report covers the whole unrolled schedule
+    # (pipeline itself is not a separate entry: it is called by train)
+    assert ("lb.py::train", "lb.py", 5, "scan", 2) in recs
+    text = render_result_text(result)
+    assert "unrolled to bound 2" in text and "loop_bounds" in text
+    payload = json.loads(render_result_json(result))
+    assert payload["loop_bound"] == 2
+    assert {"entry", "file", "line", "loop", "bound"} == \
+        set(payload["loop_bounds"][0])
+
+
+def test_parallel_islands_verified_with_pinned_suppressions():
+    """SATELLITE CI: repo self-verify covers horovod_tpu/parallel/ end
+    to end (pipeline scan bodies included) and the known-divergence
+    suppression list is pinned EXACTLY — today it is empty; adding a
+    `hvd-lint: disable=` under parallel/ must update this pin with the
+    documented reason."""
+    pardir = os.path.join(REPO, "horovod_tpu", "parallel")
+    result = check_paths([pardir])
+    assert result.findings == [], render_result_text(result)
+    assert result.entries >= 5          # the islands really are entries
+    # the pipeline micro-batch scan loop is unrolled and surfaced
+    assert any(r["loop"] == "scan" and r["file"].endswith("pipeline.py")
+               for r in result.loop_bounds), result.loop_bounds
+    suppressions = []
+    for root, _dirs, files in os.walk(pardir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(root, fname)) as fh:
+                for lineno, line in enumerate(fh, 1):
+                    if "hvd-lint: disable" in line:
+                        suppressions.append((fname, lineno))
+    assert suppressions == [], \
+        f"undocumented suppression(s) under parallel/: {suppressions}"
+
+
+def test_list_rules_and_model_check_pin_new_rules():
+    """SATELLITE CI: the CLI surfaces pin HVD013-HVD015 (verify) and
+    HVD016 (lint) by literal ID."""
+    proc = subprocess.run(
+        [sys.executable, VERIFY_CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for rule in ("HVD013", "HVD014", "HVD015"):
+        assert rule in proc.stdout
+    assert "pipeline deadlock" in proc.stdout
+    lint = subprocess.run(
+        [sys.executable, LINT_CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert lint.returncode == 0
+    for rule in ("HVD013", "HVD014", "HVD015", "HVD016"):
+        assert rule in lint.stdout   # merged catalogue
+    merged = subprocess.run(
+        [sys.executable, LINT_CLI, "--model-check", "--format", "json",
+         _fixture("bad_hvd013_pipeline_deadlock.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert merged.returncode == 1, merged.stdout + merged.stderr
+    rules = {f["rule"] for f in json.loads(merged.stdout)["findings"]}
+    assert "HVD013" in rules
 
 
 def test_syntax_error_becomes_finding():

@@ -299,7 +299,7 @@ def _publish(server, rank, group, seq, clock, epoch=0, **over):
     fp = san_mod.fingerprint(
         seq, op=over.get("op", "allreduce"), name=over.get("name", "g"),
         shape=over.get("shape", (2,)), dtype=over.get("dtype", "float32"),
-        group=group, epoch=epoch, clock=clock)
+        group=group, epoch=epoch, clock=clock, perm=over.get("perm"))
     put_kv("127.0.0.1", server.port, SANITIZER_SCOPE,
            f"{group}.{epoch}.{seq}.{rank}", _json.dumps(fp).encode(),
            SECRET)
@@ -422,6 +422,114 @@ def test_per_group_sequences_are_independent(server):
                     group="gb", peers=[0]) == 0
     assert s0.check(op="allreduce", name="c", shape=(1,), dtype="f",
                     group="ga", peers=[0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# mesh axes: axis:<name>:<instance> groups, permutation identity
+# ---------------------------------------------------------------------------
+#: the 6-rank world as a 2(tp) x 3(pp) mesh, rank = pp_idx * 2 + tp_idx:
+#: one axis:tp:<pp_idx> group per pipeline stage row, one
+#: axis:pp:<tp_idx> group per tensor-parallel column
+_TP_GROUPS = {0: [0, 1], 1: [2, 3], 2: [4, 5]}
+_PP_GROUPS = {0: [0, 2, 4], 1: [1, 3, 5]}
+_RING = "[(0, 1), (1, 2), (2, 0)]"
+
+
+def test_multi_axis_mesh_no_false_mismatch(server):
+    """SATELLITE: a clean 2-axis run on the real 6-rank harness — every
+    rank reduces over its tp group then rotates over its pp group with
+    one shared permutation — verifies with zero false mismatches, and
+    the table partitions by axis:<name>:<instance>."""
+    sans = _six(server)
+    before = metrics.SANITIZER_MISMATCHES.labels().get()
+
+    def rank(s):
+        tp_idx, pp_idx = s.rank % 2, s.rank // 2
+
+        def go():
+            for step in range(2):
+                s.check(op="psum", name=f"h.{step}", shape=(4,),
+                        dtype="float32", group=f"axis:tp:{pp_idx}",
+                        peers=_TP_GROUPS[pp_idx])
+                s.check(op="ppermute", name=f"acts.{step}", shape=(4,),
+                        dtype="float32", group=f"axis:pp:{tp_idx}",
+                        peers=_PP_GROUPS[tp_idx], perm=_RING)
+            return "ok"
+        return go
+
+    results = _run_ranks(*[rank(s) for s in sans])
+    assert results == ["ok"] * 6, results
+    assert metrics.SANITIZER_MISMATCHES.labels().get() == before
+    table = http_client.get_sanitizer("127.0.0.1", server.port,
+                                      secret=SECRET)
+    assert {"axis:tp:0", "axis:tp:1", "axis:tp:2",
+            "axis:pp:0", "axis:pp:1"} <= set(table)
+    # permutation identity rides the fingerprint
+    assert table["axis:pp:0"]["0.0"]["0"]["perm"] == _RING
+
+
+def test_ppermute_perm_divergence_names_axis_group_and_both_perms(server):
+    """SATELLITE: an injected ppermute permutation divergence — rank 4
+    rotates with a different pair list than its axis:pp:0 peers — is
+    caught naming the axis: group and BOTH permutations; the other
+    column and every tp row stay clean."""
+    sans = _six(server)
+    bad_perm = "[(0, 1), (1, 2), (2, 1)]"
+
+    def rank(s):
+        tp_idx, pp_idx = s.rank % 2, s.rank // 2
+
+        def go():
+            s.check(op="psum", name="h", shape=(4,), dtype="float32",
+                    group=f"axis:tp:{pp_idx}", peers=_TP_GROUPS[pp_idx])
+            perm = bad_perm if s.rank == 4 else _RING  # the injected bug
+            s.check(op="ppermute", name="acts", shape=(4,),
+                    dtype="float32", group=f"axis:pp:{tp_idx}",
+                    peers=_PP_GROUPS[tp_idx], perm=perm)
+            return "ok"
+        return go
+
+    results = _run_ranks(*[rank(s) for s in sans])
+    for r in (1, 3, 5):                       # the clean pp column
+        assert results[r] == "ok", results[r]
+    for r in (0, 2, 4):                       # the diverged pp column
+        assert isinstance(results[r], CollectiveDivergenceError), results[r]
+        msg = str(results[r])
+        assert "axis:pp:0" in msg
+        assert _RING in msg and bad_perm in msg
+
+
+def test_runtime_cross_axis_inversion_names_hvd014(server):
+    """SATELLITE: the runtime twin of HVD014 — the peer issued the two
+    axes' dispatches in the opposite clock order; the raise names the
+    rule and both axis groups."""
+    s0 = Sanitizer(0, 2, "127.0.0.1", server.port, secret=SECRET,
+                   timeout=5.0)
+    _publish(server, 1, "axis:tp:0", 0, clock=2, op="psum")  # peer: pp 1st
+    _publish(server, 1, "axis:pp:0", 0, clock=1, op="psum")
+    s0.check(op="psum", name="g", shape=(2,), dtype="float32",
+             group="axis:tp:0", peers=[0, 1])
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        s0.check(op="psum", name="g", shape=(2,), dtype="float32",
+                 group="axis:pp:0", peers=[0, 1])
+    msg = str(ei.value)
+    assert "cross-axis ordering inversion" in msg
+    assert "HVD014" in msg
+    assert "axis:tp:0" in msg and "axis:pp:0" in msg
+    assert "different axis's collective" in msg
+
+
+def test_perm_absent_compares_equal_to_empty():
+    """Fingerprints published by a build without the perm field compare
+    equal to a perm-less dispatch — no false mismatch mid-upgrade."""
+    fp_new = san_mod.fingerprint(0, op="ppermute", name="g", shape=(2,),
+                                 dtype="f")
+    fp_old = {k: v for k, v in fp_new.items() if k != "perm"}
+    assert san_mod._cmp_view(fp_old) == san_mod._cmp_view(fp_new)
+    # …and a real permutation shows up in the rendered signature
+    fp = san_mod.fingerprint(0, op="ppermute", name="g", shape=(2,),
+                             dtype="f", perm="[(0, 1)]")
+    assert "perm=[(0, 1)]" in san_mod._sig(fp)
 
 
 class _Recorder:
