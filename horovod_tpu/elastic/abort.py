@@ -106,6 +106,29 @@ def publish(flag: dict, *, addr: Optional[str] = None,
                       flag.get("reason"))
             return False
         addr, port, secret = wired
+    # flight-recorder: the abort flag is a chain link — the event id
+    # rides the flag itself, so every rank that OBSERVES it (heartbeat)
+    # can chain its own abort.observe onto this publish, across
+    # processes (observe/events.py)
+    try:
+        from ..observe import events as events_mod
+
+        eid = events_mod.record_event(
+            "abort.publish", severity="critical",
+            payload={"reason": flag.get("reason"),
+                     "source": flag.get("source"),
+                     "rank": flag.get("rank"),
+                     "epoch": flag.get("epoch")},
+            cause_id=flag.get("cause_event_id"),
+            correlation_id=flag.get("correlation_id"),
+            rank=flag.get("rank"))
+        if eid:
+            flag.setdefault("event_id", eid)
+            corr = events_mod.correlation_of(eid)
+            if corr:
+                flag.setdefault("correlation_id", corr)
+    except Exception:  # noqa: BLE001 — recording must not mask the abort
+        pass
     try:
         from ..run.http_client import put_kv
 

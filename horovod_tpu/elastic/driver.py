@@ -109,9 +109,26 @@ class ElasticDriver:
         self.on_remove = None
         self.commit(list(worker_ids), reason="initial world")
 
+    # -- flight recorder (observe/events.py) ---------------------------------
+    def _event(self, kind: str, severity: str = "info",
+               payload: Optional[dict] = None,
+               cause_id: Optional[str] = None,
+               rank: Optional[int] = None) -> Optional[str]:
+        """Record one flight-recorder event; never raises (the recorder
+        must not fail a membership change)."""
+        try:
+            from ..observe import events as events_mod
+
+            return events_mod.record_event(kind, severity=severity,
+                                           payload=payload,
+                                           cause_id=cause_id, rank=rank)
+        except Exception:  # noqa: BLE001
+            return None
+
     # -- epoch commits -------------------------------------------------------
     def commit(self, world: List[str], *, removed: Sequence[str] = (),
-               admitted: Sequence[str] = (), reason: str = "") -> dict:
+               admitted: Sequence[str] = (), reason: str = "",
+               cause_id: Optional[str] = None) -> dict:
         """Commit the next membership epoch: rebuild the per-epoch
         controller server, publish the record, and reset the stability
         barrier.  Single writer — only the driver calls this."""
@@ -138,6 +155,26 @@ class ElasticDriver:
             "reason": reason,
             "time": time.time(),
         }
+        # the commit event rides the epoch record itself, so workers
+        # that observe the new epoch can chain their restart/resume
+        # events onto it across processes
+        eid = self._event(
+            "epoch.commit",
+            severity="warning" if (removed or admitted) else "info",
+            payload={"epoch": self.epoch, "size": len(self.world),
+                     "removed": list(removed), "admitted": list(admitted),
+                     "reason": reason},
+            cause_id=cause_id)
+        if eid:
+            rec["event_id"] = eid
+            try:
+                from ..observe import events as events_mod
+
+                corr = events_mod.correlation_of(eid)
+                if corr:
+                    rec["correlation_id"] = corr
+            except Exception:  # noqa: BLE001
+                pass
         # health first: stale leases keyed by the OLD ranks must not read
         # as deaths in the new epoch (new heartbeats re-populate on ack)
         self.server.clear_scope(HEALTH_SCOPE)
@@ -162,7 +199,8 @@ class ElasticDriver:
 
     # -- membership changes --------------------------------------------------
     def remove(self, worker: str, reason: str, *,
-               drain: bool = False) -> bool:
+               drain: bool = False,
+               cause_id: Optional[str] = None) -> bool:
         """Shrink the world past ``worker``.  Workers that already
         finished cleanly are drained from the roster in the same commit
         (they will never ack or heartbeat again — leaving them in would
@@ -194,9 +232,15 @@ class ElasticDriver:
                 f"{reason}; world would shrink to {len(survivors)} < "
                 f"min_np {self.min_np}")
             return False
+        old_rank = self.world.index(worker)
+        remove_eid = self._event(
+            "epoch.remove", severity="warning",
+            payload={"worker": worker, "rank": old_rank, "reason": reason,
+                     "drain": bool(drain)},
+            cause_id=cause_id, rank=old_rank)
         drained_ok = False
         if drain:
-            drained_ok = self._drain(worker)
+            drained_ok = self._drain(worker, cause_id=remove_eid)
             if not drained_ok:
                 log.warning(
                     "drain handshake with worker %s timed out after "
@@ -206,16 +250,20 @@ class ElasticDriver:
             self.flaps[worker] = self.flaps.get(worker, 0) + 1
             if self.flaps[worker] >= self.max_flaps:
                 self.blocklist.add(worker)
+                self._event("epoch.blocklist", severity="critical",
+                            payload={"worker": worker,
+                                     "flaps": self.flaps[worker]},
+                            cause_id=remove_eid)
                 log.warning("worker %s blocklisted after %d removals",
                             worker, self.flaps[worker])
-        old_rank = self.world.index(worker)
         # the lease itself is revoked by commit()'s HEALTH-scope reset
-        self._publish_abort(reason, rank=old_rank)
+        self._publish_abort(reason, rank=old_rank, cause_id=remove_eid)
         if finished:
             reason = f"{reason} (drained finished worker(s) {finished})"
         if drained_ok:
             reason = f"{reason} (drained: in-flight work completed)"
-        self.commit(survivors, removed=[worker], reason=reason)
+        self.commit(survivors, removed=[worker], reason=reason,
+                    cause_id=remove_eid)
         if self.on_remove is not None:
             try:
                 self.on_remove(worker, drained_ok)
@@ -224,7 +272,8 @@ class ElasticDriver:
                               worker)  # fail the membership change
         return True
 
-    def _drain(self, worker: str) -> bool:
+    def _drain(self, worker: str,
+               cause_id: Optional[str] = None) -> bool:
         """Run the drain handshake with ``worker``: publish the request
         key, wait for the ack, clean both keys up.  True iff the worker
         acked inside the budget.
@@ -236,6 +285,11 @@ class ElasticDriver:
         failure reaction matters more than drain patience."""
         req_key = f"{DRAIN_PREFIX}{worker}"
         ack_key = f"{DRAIN_ACK_PREFIX}{worker}"
+        drain_eid = self._event("epoch.drain",
+                                payload={"worker": worker,
+                                         "epoch": self.epoch,
+                                         "timeout": self._drain_timeout},
+                                cause_id=cause_id)
         # a stale ack from a previous timed-out handshake (acked just
         # past the deadline) must not read as an instant lossless drain
         self.server.delete(MEMBERSHIP_SCOPE, ack_key)
@@ -251,6 +305,10 @@ class ElasticDriver:
             time.sleep(0.02)
         self.server.delete(MEMBERSHIP_SCOPE, req_key)
         self.server.delete(MEMBERSHIP_SCOPE, ack_key)
+        self._event("epoch.drain_ack",
+                    severity="info" if acked else "warning",
+                    payload={"worker": worker, "acked": acked},
+                    cause_id=drain_eid)
         if acked:
             from .. import metrics
 
@@ -259,7 +317,8 @@ class ElasticDriver:
         return acked
 
     def admit(self, workers: Sequence[str],
-              reason: str = "rejoin") -> Optional[dict]:
+              reason: str = "rejoin",
+              cause_id: Optional[str] = None) -> Optional[dict]:
         """Grow the world by ``workers`` at this epoch boundary (the
         running members are interrupted through the same abort seam a
         shrink uses — rejoin is the shrink path in reverse)."""
@@ -267,11 +326,16 @@ class ElasticDriver:
                    if w not in self.blocklist and w not in self.world]
         if not workers:
             return None
+        admit_eid = self._event("epoch.admit",
+                                payload={"workers": list(workers),
+                                         "epoch": self.epoch + 1,
+                                         "reason": reason},
+                                cause_id=cause_id)
         self._publish_abort(
             f"admitting worker(s) {workers} into epoch {self.epoch + 1}",
-            rank=None)
+            rank=None, cause_id=admit_eid)
         return self.commit(self.world + list(workers), admitted=workers,
-                           reason=reason)
+                           reason=reason, cause_id=admit_eid)
 
     # -- serving-plane hooks (serving/autoscaler.py) -------------------------
     def attach_autoscaler(self, autoscaler, *,
@@ -303,11 +367,26 @@ class ElasticDriver:
                 return w
         return None
 
-    def _publish_abort(self, reason: str, rank: Optional[int]) -> None:
+    def _publish_abort(self, reason: str, rank: Optional[int],
+                       cause_id: Optional[str] = None) -> None:
         """Stamp the flag with the epoch being aborted so survivors that
         already rebuilt ignore it (elastic/heartbeat.py epoch filter)."""
         flag = make_flag(reason, rank=rank, source="elastic_driver",
                          epoch=self.epoch)
+        eid = self._event("abort.publish", severity="critical",
+                          payload={"reason": reason, "epoch": self.epoch,
+                                   "source": "elastic_driver"},
+                          cause_id=cause_id, rank=rank)
+        if eid:
+            flag["event_id"] = eid
+            try:
+                from ..observe import events as events_mod
+
+                corr = events_mod.correlation_of(eid)
+                if corr:
+                    flag["correlation_id"] = corr
+            except Exception:  # noqa: BLE001
+                pass
         self.server.put(ABORT_SCOPE, ABORT_KEY, json.dumps(flag).encode())
 
     # -- the periodic poll ---------------------------------------------------
@@ -371,8 +450,15 @@ class ElasticDriver:
                 worker = roster[int(rank_s)]
                 if worker in self.finished or worker not in self.world:
                     continue  # exited 0 / already removed this pass
+                lease_eid = self._event(
+                    "lease.expired", severity="critical",
+                    payload={"rank": int(rank_s), "worker": worker,
+                             "age_seconds": info.get("age_seconds"),
+                             "interval": info.get("interval")},
+                    rank=int(rank_s))
                 self.remove(worker, f"rank {rank_s} (worker {worker}) "
-                            "heartbeat lease expired")
+                            "heartbeat lease expired",
+                            cause_id=lease_eid)
         if self._stable and self.failed_reason is None \
                 and not self.finished:
             # no admissions once any member finished: the job is winding
@@ -491,6 +577,23 @@ class ElasticDriver:
         survivors (including external joiners) stop."""
         flag = make_flag(reason or "elastic driver gave up", rank=None,
                          source="elastic_driver")
+        eid = self._event("epoch.giveup", severity="critical",
+                          payload={"reason": reason,
+                                   "min_np": self.min_np,
+                                   "epoch": self.epoch})
+        if eid:
+            flag["event_id"] = eid
+            try:
+                from ..observe import events as events_mod
+
+                corr = events_mod.correlation_of(eid)
+                if corr:
+                    flag["correlation_id"] = corr
+            except Exception:  # noqa: BLE001
+                pass
+            # the launcher's restart loop chains restart.attempt onto
+            # the give-up that triggered the relaunch (run/run.py)
+            self.last_giveup_event_id = eid
         self.server.put(ABORT_SCOPE, ABORT_KEY, json.dumps(flag).encode())
 
     def shutdown(self) -> None:

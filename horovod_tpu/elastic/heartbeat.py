@@ -160,6 +160,22 @@ class HeartbeatThread(threading.Thread):
 
         if metrics.on():
             metrics.ABORTS.labels("observed").inc()
+        # flight-recorder: chain this rank's observation onto the
+        # publisher's event — the flag carries the publish event's id
+        # across processes (observe/events.py)
+        try:
+            from ..observe import events as events_mod
+
+            events_mod.record_event(
+                "abort.observe", severity="warning",
+                payload={"reason": info.get("reason"),
+                         "source": info.get("source"),
+                         "failed_rank": info.get("rank")},
+                cause_id=info.get("event_id"),
+                correlation_id=info.get("correlation_id"),
+                rank=self.rank)
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
         # Keep renewing the lease: an elastic survivor lives on and
         # rebuilds, and the gap until it reaches the abort seam can
         # be a whole step or checkpoint save — letting the lease die

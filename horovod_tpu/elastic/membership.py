@@ -466,12 +466,32 @@ def run(fn: Callable, state: Any = None, *args: Any,
                 raise
             old_size = world_size()
             apply_epoch(rec)  # raises RemovedFromWorldError when evicted
+            old_step = getattr(state, "step", None)
             if state is not None and hasattr(state, "sync"):
                 state.sync(int(rec["epoch"]))
             ack(int(rec["epoch"]))
             new_size = len(rec.get("world", ()))
             if on_world_change is not None:
                 on_world_change(state, old_size, new_size)
+            # flight recorder: the resume closes the incident chain the
+            # epoch record carries across processes (observe/events.py)
+            try:
+                from ..observe import events as events_mod
+
+                new_step = getattr(state, "step", None)
+                steps_lost = max(int(old_step) - int(new_step), 0) \
+                    if old_step is not None and new_step is not None \
+                    else None
+                events_mod.record_event(
+                    "restart.resume", severity="info",
+                    payload={"epoch": int(rec.get("epoch", 0)),
+                             "old_size": old_size, "new_size": new_size,
+                             "step": new_step, "steps_lost": steps_lost},
+                    cause_id=rec.get("event_id"),
+                    correlation_id=rec.get("correlation_id"),
+                    rank=env_util.get_int(env_util.HVD_PROCESS_ID, 0))
+            except Exception:  # noqa: BLE001 — recording is best-effort
+                pass
             log.info("elastic: resuming in epoch %d (world %d -> %d)",
                      _epoch, old_size, new_size)
 

@@ -114,6 +114,17 @@ class ElasticState:
             return self.state, 0
         self.state = restore_checkpoint(self.path, self.state, step=step)
         self.step = int(step)
+        try:
+            from ..observe import events as events_mod
+
+            events_mod.record_event(
+                "restart.resume", severity="info",
+                payload={"step": self.step,
+                         "incarnation": self.restart_count,
+                         "path": self.path},
+                rank=env_util.get_int(env_util.HVD_PROCESS_ID, 0))
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
         log.info("elastic resume: restored step %d from %s (incarnation %d)",
                  self.step, self.path, self.restart_count)
         return self.state, self.step

@@ -85,6 +85,11 @@ hvd_watch_arms_total            counter    trace+profile windows auto-armed
                                            by a confirmed alert
 hvd_timeseries_flushes_total    counter    time-series history flushes, by
                                            ``mode`` (delta/full/resync)
+hvd_events_total                counter    flight-recorder events emitted,
+                                           by ``kind``/``severity``
+                                           (observe/events.py)
+hvd_events_dropped_total        counter    events dropped on per-process
+                                           ring overflow (oldest evicted)
 ==============================  =========  ==================================
 """
 
@@ -357,6 +362,15 @@ TIMESERIES_FLUSHES = registry.counter(
     "hvd_timeseries_flushes_total",
     "Time-series history flushes shipped to the launcher, by mode "
     "(delta/full/resync) — metrics/timeseries.py.", ("mode",))
+EVENTS_TOTAL = registry.counter(
+    "hvd_events_total",
+    "Control-plane flight-recorder events emitted, by kind "
+    "(epoch.commit/abort.publish/restart.attempt/...) and severity "
+    "(observe/events.py, docs/observe.md).", ("kind", "severity"))
+EVENTS_DROPPED = registry.counter(
+    "hvd_events_dropped_total",
+    "Flight-recorder events dropped on per-process ring overflow "
+    "(oldest evicted; raise HVD_EVENTS_RING_CAP if nonzero).")
 
 COMPRESSION_RESIDUAL_NORM = registry.gauge(
     "hvd_compression_residual_norm",

@@ -22,6 +22,15 @@ on them (thresholds, fire steps, severities), derived by hand:
 
 ``evaluate_fixture()`` runs the detectors on these traces; the tests
 and ``hvd_watch --check`` both compare its output to WATCH_EXPECTED.
+
+``events_fixture()`` is the flight-recorder analog: a hand-written
+incident chain (lease expiry on rank 1 → removal → abort → shrink
+epoch → a survivor's observe → resume) plus one unrelated checkpoint
+event that must stay OUT of the chain.  ``EVENTS_EXPECTED`` pins what
+``extract_chain`` + ``chain_summary`` (observe/events.py) must say
+about it: 6 chained events rooted at ``launcher-1-0``, failed rank 1,
+3 steps lost, 1.5 s from expiry to resume.  The tests and
+``hvd_events --check`` both compare against it.
 """
 
 from __future__ import annotations
@@ -154,3 +163,76 @@ def evaluate_fixture(fixture: Dict[str, Any] = None) -> Dict[str, Any]:
     ]
     out["quiet"] = quiet_alerts
     return out
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder fixture (hvd_events --check, tests/test_events.py)
+# ---------------------------------------------------------------------------
+EVENTS_EXPECTED: Dict[str, Any] = {
+    "correlation_id": "launcher-1-0",
+    "events": 6,
+    "kinds": ["lease.expired", "epoch.remove", "abort.publish",
+              "epoch.commit", "abort.observe", "restart.resume"],
+    "failed_rank": 1,
+    "steps_lost": 3,
+    "duration_seconds": 1.5,
+    "severities": ["critical", "info", "warning"],
+}
+
+
+def events_fixture() -> List[Dict[str, Any]]:
+    """A deterministic incident: rank 1's lease expires at t=100.0; the
+    driver removes it, publishes the abort, and commits the shrink
+    epoch; a survivor (rank 2, its own process) observes the abort via
+    the flag-carried event id and resumes at t=101.5 having replayed 3
+    steps.  The checkpoint.save at t=100.4 is a different correlation
+    and must not appear in the chain."""
+    return [
+        {"id": "launcher-1-0", "ts": 100.0, "host": "launcher", "rank": 1,
+         "kind": "lease.expired", "severity": "critical",
+         "correlation_id": "launcher-1-0", "cause_id": None,
+         "payload": {"rank": 1, "worker": "1", "age_seconds": 6.2}},
+        {"id": "launcher-1-1", "ts": 100.1, "host": "launcher", "rank": None,
+         "kind": "epoch.remove", "severity": "warning",
+         "correlation_id": "launcher-1-0", "cause_id": "launcher-1-0",
+         "payload": {"worker": "1", "rank": 1,
+                     "reason": "lease expired", "drain": False}},
+        {"id": "launcher-1-2", "ts": 100.2, "host": "launcher", "rank": 1,
+         "kind": "abort.publish", "severity": "critical",
+         "correlation_id": "launcher-1-0", "cause_id": "launcher-1-1",
+         "payload": {"reason": "worker 1 removed: lease expired",
+                     "source": "elastic_driver", "rank": 1, "epoch": 1}},
+        {"id": "launcher-1-3", "ts": 100.3, "host": "launcher", "rank": None,
+         "kind": "epoch.commit", "severity": "warning",
+         "correlation_id": "launcher-1-0", "cause_id": "launcher-1-1",
+         "payload": {"epoch": 2, "size": 3, "removed": ["1"],
+                     "admitted": [], "reason": "worker 1 removed"}},
+        {"id": "launcher-1-4", "ts": 100.4, "host": "launcher", "rank": 0,
+         "kind": "checkpoint.save", "severity": "info",
+         "correlation_id": "launcher-1-4", "cause_id": None,
+         "payload": {"path": "/ckpt/step_120", "step": 120}},
+        {"id": "worker2-9-0", "ts": 100.5, "host": "worker2", "rank": 2,
+         "kind": "abort.observe", "severity": "warning",
+         "correlation_id": "launcher-1-0", "cause_id": "launcher-1-2",
+         "payload": {"reason": "worker 1 removed: lease expired",
+                     "source": "elastic_driver", "failed_rank": 1}},
+        {"id": "worker2-9-1", "ts": 101.5, "host": "worker2", "rank": 2,
+         "kind": "restart.resume", "severity": "info",
+         "correlation_id": "launcher-1-0", "cause_id": "launcher-1-3",
+         "payload": {"epoch": 2, "old_size": 4, "new_size": 3,
+                     "step": 120, "steps_lost": 3}},
+    ]
+
+
+def evaluate_events_fixture(
+        events: List[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Chain extraction + summary over the fixture, starting from the
+    LAST chain event (the resume) so the walk crosses every cause
+    link.  Compared against ``EVENTS_EXPECTED`` by the tests and
+    ``hvd_events --check``."""
+    from . import events as events_mod
+
+    evs = events if events is not None else events_fixture()
+    chain = events_mod.extract_chain(evs, "worker2-9-1")
+    summary = events_mod.chain_summary(chain)
+    return summary

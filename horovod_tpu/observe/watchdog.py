@@ -223,6 +223,22 @@ class Watchdog(threading.Thread):
                                             record["severity"]).inc()
         except Exception as e:  # noqa: BLE001
             log.debug("alert counter failed: %s", e)
+        # flight-recorder: the alert event roots a causal chain — the
+        # arm window and any eviction chain onto it (observe/events.py)
+        alert_eid = None
+        try:
+            from . import events as events_mod
+
+            alert_eid = events_mod.record_event(
+                "watchdog.alert",
+                severity=record.get("severity", "warning"),
+                payload={"signal": record["signal"],
+                         "alert_id": record["id"],
+                         "evidence": record.get("evidence")})
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
+        if alert_eid:
+            record["event_id"] = alert_eid
         if self.arm_enabled and record["signal"] in ARMING_SIGNALS:
             self._maybe_arm(record, cadence)
         if record["signal"] == detectors.SIGNAL_STRAGGLER:
@@ -273,6 +289,17 @@ class Watchdog(threading.Thread):
         self.arms += 1
         record["armed"] = {"id": arm_id, "start_step": start,
                            "end_step": end, "trace_dir": trace_dir}
+        try:
+            from . import events as events_mod
+
+            events_mod.record_event(
+                "watchdog.arm", severity="info",
+                payload={"arm_id": arm_id, "start_step": start,
+                         "end_step": end, "signal": record["signal"],
+                         "trace_dir": trace_dir},
+                cause_id=record.get("event_id"))
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
         self._pending_attribution.append(record)
         try:
             from .. import metrics
@@ -337,7 +364,7 @@ class Watchdog(threading.Thread):
             ok = driver.remove(
                 worker, f"watchdog: straggler rank {rank_s} at "
                 f"{record['evidence'].get('ratio', 0):.2f}x world median",
-                drain=True)
+                drain=True, cause_id=record.get("event_id"))
             if ok:
                 self.evictions += 1
                 record["evicted"] = worker

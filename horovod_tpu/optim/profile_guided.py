@@ -303,6 +303,9 @@ class ProfileGuidedTuner:
         self._verified_compute: dict = {}
         self._condemned_compute: set = set()
         self._last_good_plan: Optional[FusionPlanSpec] = None
+        # flight-recorder: the apply event roots the plan's causal
+        # chain — verify/rollback chain onto it (observe/events.py)
+        self._apply_event_id: Optional[str] = None
 
     @property
     def active(self) -> bool:
@@ -560,6 +563,7 @@ class ProfileGuidedTuner:
     def _record(self, rec: dict) -> None:
         rec = dict(rec, plan_id=rec.get("plan_id", self._plan_seq))
         self.history.append(rec)
+        self._record_flight_event(rec)
         if self.push_target is None:
             return
         try:
@@ -570,6 +574,39 @@ class ProfileGuidedTuner:
                               secret=secret)
         except Exception as e:  # noqa: BLE001
             log.debug("autotune push failed: %s", e)
+
+    def _record_flight_event(self, rec: dict) -> None:
+        """Mirror apply/verify/rollback outcomes into the control-plane
+        flight recorder with the predicted-vs-realized numbers; the
+        verify/rollback events chain onto their plan's apply event."""
+        kind = {"applied": "autotune.apply",
+                "verified": "autotune.verify",
+                "rolled_back": "autotune.rollback"}.get(rec.get("outcome"))
+        if kind is None:
+            return
+        try:
+            from ..observe import events as events_mod
+
+            eid = events_mod.record_event(
+                kind,
+                severity="warning" if kind == "autotune.rollback"
+                else "info",
+                payload={
+                    "plan_id": rec.get("plan_id"),
+                    "predicted_speedup_pct":
+                        rec.get("predicted_speedup_pct"),
+                    "realized_speedup_pct":
+                        rec.get("realized_speedup_pct"),
+                    "shortfall_pct": rec.get("shortfall_pct"),
+                    "num_buckets": len(rec.get("buckets") or []),
+                    "compute": rec.get("compute"),
+                },
+                cause_id=None if kind == "autotune.apply"
+                else self._apply_event_id)
+            if kind == "autotune.apply":
+                self._apply_event_id = eid
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
 
     def _metrics_predicted(self, pct: float) -> None:
         try:

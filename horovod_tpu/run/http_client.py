@@ -543,6 +543,28 @@ def get_alerts(addr: str, port: int, secret: Optional[bytes] = None,
         return json.loads(resp.read().decode())
 
 
+def get_events(addr: str, port: int, secret: Optional[bytes] = None,
+               since_ts: Optional[float] = None,
+               kind: Optional[str] = None,
+               timeout: float = 10.0) -> dict:
+    """The control-plane flight-recorder log from ``GET /events``,
+    oldest first (observe/events.py event schema), with the server's
+    incarnation id + scope version for cursor/restart detection.
+    ``since_ts``/``kind`` filter server-side (hvd_events --follow)."""
+    import json
+    from urllib.parse import urlencode
+
+    params = {}
+    if since_ts is not None:
+        params["since_ts"] = repr(float(since_ts))
+    if kind:
+        params["kind"] = kind
+    path = "/events" + (f"?{urlencode(params)}" if params else "")
+    with _request("GET", addr, port, path, secret=secret,
+                  timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
 def get_autotune(addr: str, port: int, secret: Optional[bytes] = None,
                  timeout: float = 10.0) -> dict:
     """The profile-guided tuning table from ``GET /autotune``: every

@@ -131,6 +131,13 @@ _ALERTS_PREFIX = f"/{ALERTS_SCOPE}/"
 OBSERVE_SCOPE = "observe"
 ARM_KEY = "arm"
 
+# control-plane flight recorder (observe/events.py): every lifecycle
+# actor's structured events land under events/<id> (journaled — the
+# audit trail survives warm-standby failover); GET /events renders them
+# oldest-first with the scope version for cursor reads
+EVENTS_SCOPE = "events"
+_EVENTS_PREFIX = f"/{EVENTS_SCOPE}/"
+
 # failure-domain runtime (elastic/heartbeat.py, elastic/abort.py): ranks
 # renew leases under /health/<rank>; the server stamps each PUT on ITS
 # clock and GET /health renders per-rank lease age + live/stale/dead
@@ -365,6 +372,44 @@ def build_alerts_report(store: Dict[str, bytes]) -> Dict[str, object]:
         if isinstance(rec, dict) and rec.get("signal"):
             counts[rec["signal"]] = counts.get(rec["signal"], 0) + 1
     return {"alerts": alerts, "counts": counts}
+
+
+def build_events_report(store: Dict[str, bytes],
+                        since_ts: Optional[float] = None,
+                        kind: Optional[str] = None) -> Dict[str, object]:
+    """The flight-recorder log from a store snapshot, oldest first —
+    ``GET /events``'s body.  Each record is the emitter's ``{id, ts,
+    host, rank, kind, severity, correlation_id, cause_id, payload}``
+    (observe/events.py).  ``since_ts``/``kind`` filter server-side so a
+    following console doesn't re-ship the whole log every poll."""
+    records = []
+    for k, v in store.items():
+        if not k.startswith(_EVENTS_PREFIX):
+            continue
+        key = k[len(_EVENTS_PREFIX):]
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            rec = {"id": key, "error": "<undecodable>"}
+        if isinstance(rec, dict):
+            rec.setdefault("id", key)
+        records.append(rec)
+    if since_ts is not None:
+        records = [r for r in records
+                   if isinstance(r, dict)
+                   and (r.get("ts") or 0.0) > since_ts]
+    if kind:
+        records = [r for r in records if isinstance(r, dict)
+                   and str(r.get("kind", "")).startswith(kind)]
+    records.sort(key=lambda r: ((r.get("ts") or 0.0)
+                                if isinstance(r, dict) else 0.0,
+                                str(r.get("id"))
+                                if isinstance(r, dict) else ""))
+    counts: Dict[str, int] = {}
+    for rec in records:
+        if isinstance(rec, dict) and rec.get("kind"):
+            counts[rec["kind"]] = counts.get(rec["kind"], 0) + 1
+    return {"events": records, "counts": counts}
 
 
 def build_autotune_report(store: Dict[str, bytes]) -> Dict[str, object]:
@@ -775,8 +820,35 @@ class KVStoreHandler(BaseHTTPRequestHandler):
             return
         if path == "/alerts":
             store = self.server.store.items()  # type: ignore
-            self._reply(200, json.dumps(build_alerts_report(store))
-                        .encode(), content_type="application/json")
+            report = build_alerts_report(store)
+            # the report carries the incarnation id so a following
+            # console (hvd_watch --follow) can tell a restarted server
+            # from a quiet one instead of re-printing old alerts
+            report["server_id"] = self.server.server_id  # type: ignore
+            self._reply(200, json.dumps(report).encode(),
+                        content_type="application/json")
+            return
+        if path == "/events":
+            from urllib.parse import parse_qs
+
+            qs = parse_qs(query)
+            since_ts = None
+            vals = qs.get("since_ts")
+            if vals:
+                try:
+                    since_ts = float(vals[0])
+                except ValueError:
+                    since_ts = None
+            kind = (qs.get("kind") or [None])[0]
+            store = self.server.store.items()  # type: ignore
+            report = build_events_report(store, since_ts=since_ts,
+                                         kind=kind)
+            report["server_id"] = self.server.server_id  # type: ignore
+            report["version"] = \
+                self.server.store.scope_since(  # type: ignore
+                    EVENTS_SCOPE, None)["version"]
+            self._reply(200, json.dumps(report).encode(),
+                        content_type="application/json")
             return
         val = self.server.store.get(self.path)  # type: ignore
         if val is None:
@@ -1096,6 +1168,13 @@ class RendezvousServer:
     def alerts_report(self) -> Dict[str, object]:
         """In-process equivalent of GET /alerts."""
         return build_alerts_report(self.store.items())
+
+    def events_report(self, since_ts: Optional[float] = None,
+                      kind: Optional[str] = None) -> Dict[str, object]:
+        """In-process equivalent of GET /events (the flight-recorder
+        log, oldest first — observe/events.py)."""
+        return build_events_report(self.store.items(), since_ts=since_ts,
+                                   kind=kind)
 
     def projection_report(self) -> Optional[Dict[str, object]]:
         """In-process equivalent of GET /projection (None when no

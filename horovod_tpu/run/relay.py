@@ -58,8 +58,12 @@ RELAY_SCOPE = "relay"
 #: a single writer per key); everything else passes through.  The
 #: timeseries scope qualifies because relay-routed history pushes are
 #: full self-contained snapshots (metrics/timeseries.py disables the
-#: append-delta protocol behind a relay for exactly this reason).
-BATCH_SCOPES = frozenset({"health", "metrics", "sanitizer", "timeseries"})
+#: append-delta protocol behind a relay for exactly this reason).  The
+#: events scope qualifies because every flight-recorder event carries a
+#: unique per-process key (observe/events.py), so coalescing to the
+#: latest value per key can never merge two distinct events.
+BATCH_SCOPES = frozenset({"health", "metrics", "sanitizer", "timeseries",
+                          "events"})
 
 
 def host_slug() -> str:
@@ -91,7 +95,15 @@ class _RelayHandler(BaseHTTPRequestHandler):
     disable_nagle_algorithm = True  # same reasoning as KVStoreHandler
 
     def _daemon(self) -> "RelayDaemon":
-        return self.server.relay_daemon  # type: ignore[attr-defined]
+        d = self.server.relay_daemon  # type: ignore[attr-defined]
+        if d._stop_event.is_set():
+            # stop() ran but this keep-alive connection's handler thread
+            # is still alive: a PUT buffered now would never be flushed
+            # (the final drain already ran), so the stopped relay must
+            # look DEAD to pooled clients — connection aborted routes
+            # them through mark_relay_failed to the primary
+            raise ConnectionAbortedError("relay daemon stopped")
+        return d
 
     def _verify(self, body: bytes = b"") -> bool:
         secret = self._daemon().secret
@@ -298,6 +310,10 @@ class RelayDaemon:
         self._httpd.shutdown()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5)
+        # release the port: pooled keep-alive clients must see a dead
+        # relay as connection-refused (→ their permanent direct
+        # fallback), not a silent accept-less bind
+        self._httpd.server_close()
 
 
 # ---------------------------------------------------------------------------

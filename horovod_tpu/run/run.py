@@ -439,13 +439,32 @@ def _supervise(job: _Job, rdv_server: Optional[RendezvousServer],
                 # submodule attribute, so names are imported directly
                 from ..elastic.abort import ABORT_KEY, ABORT_SCOPE, make_flag
 
-                rdv_server.put(
-                    ABORT_SCOPE, ABORT_KEY,
-                    json.dumps(make_flag(
-                        f"worker {pid} exited with code {code}",
-                        rank=pid, source="launcher",
-                    )).encode(),
+                flag = make_flag(
+                    f"worker {pid} exited with code {code}",
+                    rank=pid, source="launcher",
                 )
+                # flight recorder: the publish event rides the flag so
+                # observers chain onto it, and the restart loop chains
+                # restart.attempt onto it too (observe/events.py)
+                try:
+                    from ..observe import events as events_mod
+
+                    eid = events_mod.record_event(
+                        "abort.publish", severity="critical",
+                        payload={"reason": flag["reason"],
+                                 "source": "launcher",
+                                 "exit_code": code},
+                        rank=pid)
+                    if eid:
+                        flag["event_id"] = eid
+                        corr = events_mod.correlation_of(eid)
+                        if corr:
+                            flag["correlation_id"] = corr
+                        job.abort_event_id = eid
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+                rdv_server.put(ABORT_SCOPE, ABORT_KEY,
+                               json.dumps(flag).encode())
                 # survivors poll the flag once per heartbeat interval and
                 # raise at their next step/dispatch seam; the exit budget
                 # is two intervals plus the term grace (a rank mid-save
@@ -516,6 +535,8 @@ def _launch_attempt(args, hosts: List[str], envs: List[Dict[str, str]],
         if job.interrupted and rc == 0:
             rc = 130  # operator interrupt must not read as success
         args._interrupted = job.interrupted  # noqa: SLF001 — restart gate
+        args._abort_event_id = getattr(  # noqa: SLF001 — restart.attempt
+            job, "abort_event_id", None)  # chains onto this publish
         return rc
     finally:
         signal.signal(signal.SIGINT, old_int)
@@ -572,6 +593,11 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
         rdv_server = RendezvousServer(secret=rdv_secret,
                                       journal_path=journal_path)
         rdv_port = rdv_server.start()
+        # flight recorder: launcher-side events land straight in the
+        # journaled `events` scope (observe/events.py, GET /events)
+        from ..observe import events as events_mod
+
+        events_mod.attach_server(rdv_server)
         rdv_host = "127.0.0.1" if all(h in LOCAL_HOSTS for h in hosts) \
             else socket.gethostname()
         env = dict(env)
@@ -789,6 +815,20 @@ def launch_job(args, slots: List[SlotInfo], env: Dict[str, str]) -> int:
 
             if metrics_mod.on():
                 metrics_mod.RESTARTS.inc()
+            # flight recorder: chain the relaunch onto whichever abort
+            # ended the attempt — the launcher's own publish, or the
+            # elastic driver's give-up (observe/events.py)
+            try:
+                from ..observe import events as events_mod
+
+                events_mod.record_event(
+                    "restart.attempt", severity="warning",
+                    payload={"attempt": attempt, "restarts": restarts,
+                             "exit_code": rc},
+                    cause_id=getattr(driver, "last_giveup_event_id", None)
+                    or getattr(args, "_abort_event_id", None))
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
             delay = backoff_base * (2 ** (attempt - 1)) \
                 + random.uniform(0.0, backoff_base)
             log.warning(
@@ -937,6 +977,10 @@ def run(fn, args=(), kwargs=None, np: int = 1,
             env_util.HVD_RENDEZVOUS_JOURNAL,
             os.environ.get(env_util.HVD_RENDEZVOUS_JOURNAL)))
     port = server.start()
+    # same flight-recorder wiring as launch_job (observe/events.py)
+    from ..observe import events as _events_mod
+
+    _events_mod.attach_server(server)
     # Multi-process workers need an eager transport: default to a
     # parent-hosted native controller on loopback (bound to port 0 — no
     # races) unless the caller or environment configured the controller.
@@ -1004,10 +1048,26 @@ def run(fn, args=(), kwargs=None, np: int = 1,
                           "aborting job", bad_pid, code)
                 from ..elastic.abort import ABORT_KEY, ABORT_SCOPE, make_flag
 
-                server.put(ABORT_SCOPE, ABORT_KEY, json.dumps(make_flag(
+                flag = make_flag(
                     f"worker {bad_pid} exited with code {code}",
                     rank=bad_pid, source="launcher",
-                )).encode())
+                )
+                try:
+                    eid = _events_mod.record_event(
+                        "abort.publish", severity="critical",
+                        payload={"reason": flag["reason"],
+                                 "source": "launcher",
+                                 "exit_code": code},
+                        rank=bad_pid)
+                    if eid:
+                        flag["event_id"] = eid
+                        corr = _events_mod.correlation_of(eid)
+                        if corr:
+                            flag["correlation_id"] = corr
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+                server.put(ABORT_SCOPE, ABORT_KEY,
+                           json.dumps(flag).encode())
                 hb_interval = env_util.get_float(
                     env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
                     env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS)

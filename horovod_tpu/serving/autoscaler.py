@@ -212,7 +212,7 @@ class ServingAutoscaler:
                 # world): nothing changed, so no cooldown either
                 self.policy.cancel_last_action()
                 return "hold"
-            self._record_event("grow", worker)
+            self._record_event("grow", worker, stats)
         elif decision == "shrink":
             worker = self.pick_victim(self.driver)
             if worker is None:
@@ -227,7 +227,7 @@ class ServingAutoscaler:
                 self.driver.failed_reason = None
                 self.policy.cancel_last_action()
                 return "hold"
-            self._record_event("shrink", worker)
+            self._record_event("shrink", worker, stats)
         return decision
 
     def _headroom(self, stats: dict, replicas: int) -> dict:
@@ -243,7 +243,8 @@ class ServingAutoscaler:
                 out[key] = None
         return out
 
-    def _record_event(self, direction: str, worker: str) -> None:
+    def _record_event(self, direction: str, worker: str,
+                      stats: Optional[dict] = None) -> None:
         self.events.append((direction, worker, self.driver.epoch))
         log.warning("autoscale %s: worker %s (epoch %d)", direction,
                     worker, self.driver.epoch)
@@ -253,6 +254,21 @@ class ServingAutoscaler:
             if metrics.on():
                 metrics.SERVE_AUTOSCALE_EVENTS.labels(direction).inc()
         except Exception:  # noqa: BLE001
+            pass
+        try:
+            from ..observe import events as events_mod
+
+            events_mod.record_event(
+                f"autoscale.{direction}", severity="info",
+                payload={
+                    "worker": worker,
+                    "epoch": self.driver.epoch,
+                    "replicas": len(self.driver.world),
+                    "queue_depth": (stats or {}).get("queue_depth"),
+                    "p99_ms": (stats or {}).get("p99_ms"),
+                    "slo_headroom_ms": dict(self._last_headroom),
+                })
+        except Exception:  # noqa: BLE001 — recording is best-effort
             pass
 
     def _export_gauges(self, stats: dict) -> None:

@@ -40,6 +40,32 @@ CLOCK_SYNC_FILE = "clock_sync.json"
 #: trace as their own per-rank row group
 COMPUTE_JSON = "compute.json"
 
+#: control-plane flight-recorder dump (``hvd_events --json >
+#: <dir>/events.json``, or a raw ``GET /events`` report); its events
+#: merge as one row of Chrome instant events above the rank rows
+EVENTS_JSON = "events.json"
+
+#: pid of the flight-recorder row — negative so it can never collide
+#: with a rank pid or a COMPUTE_PID_BASE row, sorted above rank 0
+EVENTS_PID = -1
+
+
+def load_events_artifact(trace_dir: str) -> List[dict]:
+    """The flight-recorder events dumped next to the trace (``{}``-
+    tolerant: absent, undecodable, a bare list, or a full ``GET
+    /events`` report all work — a trace without one is normal)."""
+    p = os.path.join(trace_dir, EVENTS_JSON)
+    if not os.path.isfile(p):
+        return []
+    try:
+        with open(p) as f:
+            d = json.load(f)
+    except (ValueError, OSError):
+        return []
+    if isinstance(d, dict):
+        d = d.get("events") or []
+    return [e for e in d if isinstance(e, dict)]
+
 
 def load_profile_artifact(trace_dir: str, rank: int) -> dict:
     """One rank's parsed ``compute.json`` (``{}`` when absent or
@@ -174,6 +200,38 @@ def merge_traces(trace_dir: str, align_clocks: bool = True) -> dict:
                 if aligned and "ts" in ev:
                     ev["ts"] = float(ev["ts"]) + shift[rank]
                 events.append(ev)
+    # Control-plane flight-recorder events (events.json): ONE row of
+    # Chrome instant events above the rank rows, so "epoch.commit" or
+    # "abort.publish" lines up against what the device timelines were
+    # doing.  Recorder timestamps are wall-clock seconds while trace
+    # spans ride the trace clock; with no cross-clock handshake the
+    # merge anchors the EARLIEST recorder event at the earliest trace
+    # timestamp and preserves relative spacing — placement is
+    # indicative, not sample-exact.
+    recorder = [e for e in load_events_artifact(trace_dir)
+                if e.get("ts") is not None]
+    if recorder and events:
+        trace_ts = [float(e["ts"]) for e in events if "ts" in e]
+        origin_us = min(trace_ts) if trace_ts else 0.0
+        ev_origin_us = min(float(e["ts"]) for e in recorder) * 1e6
+        events.append({"name": "process_name", "ph": "M",
+                       "pid": EVENTS_PID,
+                       "args": {"name": "control plane"}})
+        events.append({"name": "process_sort_index", "ph": "M",
+                       "pid": EVENTS_PID, "args": {"sort_index": -1}})
+        for e in sorted(recorder, key=lambda e: float(e["ts"])):
+            events.append({
+                "name": e.get("kind") or "event",
+                "ph": "i", "s": "g",
+                "pid": EVENTS_PID, "tid": 0,
+                "ts": origin_us + float(e["ts"]) * 1e6 - ev_origin_us,
+                "args": {"id": e.get("id"),
+                         "severity": e.get("severity"),
+                         "rank": e.get("rank"),
+                         "correlation_id": e.get("correlation_id"),
+                         "cause_id": e.get("cause_id"),
+                         "payload": e.get("payload")},
+            })
     return {"traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"source": "hvd_trace_merge",

@@ -731,6 +731,16 @@ def make_train_step(
             norm, guard_box["guard"].factor)
         if metrics.on():
             metrics.COMPRESSION_FALLBACKS.inc()
+        try:
+            from .observe import events as events_mod
+
+            events_mod.record_event(
+                "compression.fallback", severity="warning",
+                payload={"residual_norm": float(norm),
+                         "factor": guard_box["guard"].factor,
+                         "step": step_count[0]})
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            pass
         box["guard_tripped"] = True
         box["compression"] = Compression.none
         plan = box.get("plan")

@@ -31,6 +31,19 @@ from .. import core
 COMMIT_MARKER_SUFFIX = ".COMMITTED"
 
 
+def _flight_event(kind: str, payload: dict,
+                  cause_id: Optional[str] = None) -> Optional[str]:
+    """Best-effort flight-recorder emit (observe/events.py) — a
+    telemetry failure must never take down a save or restore."""
+    try:
+        from ..observe import events as events_mod
+
+        return events_mod.record_event(kind, severity="info",
+                                       payload=payload, cause_id=cause_id)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
@@ -109,9 +122,13 @@ def save_checkpoint(path: str, state: Any, *, step: Optional[int] = None,
         # proper commit protocol on overwrite: un-commit first, so a
         # crash while orbax rewrites the dir leaves it uncommitted too
         clear_commit_marker(path, step)
+    save_eid = _flight_event("checkpoint.save",
+                             {"path": target, "step": step})
     _checkpointer().save(target, state, force=force)
     if step is not None:
         write_commit_marker(path, step)
+        _flight_event("checkpoint.commit",
+                      {"path": target, "step": step}, cause_id=save_eid)
     return target
 
 
@@ -182,6 +199,7 @@ def restore_checkpoint(path: str, like: Any, *, step: Optional[int] = None,
 
             step = eager.broadcast_object(step)
     target = os.path.join(path, f"step_{step}") if step is not None else path
+    _flight_event("checkpoint.restore", {"path": target, "step": step})
 
     err: Optional[Exception] = None
     restored = None

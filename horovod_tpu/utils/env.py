@@ -226,6 +226,15 @@ HVD_WATCH_ARM_MARGIN_STEPS = "HVD_WATCH_ARM_MARGIN_STEPS"  # arm start = newest 
 HVD_WATCH_ARM_COOLDOWN_SECONDS = "HVD_WATCH_ARM_COOLDOWN_SECONDS"  # min spacing between auto-arms (default 120)
 HVD_WATCH_EVICT = "HVD_WATCH_EVICT"                    # 1 feeds critical straggler alerts to the elastic removal path
 HVD_BENCH_WATCH = "HVD_BENCH_WATCH"                    # 0 skips bench.py's watchdog detection leg
+# control-plane flight recorder (horovod_tpu/observe/events.py,
+# docs/observe.md): append-only correlation-ID-threaded event log of
+# every lifecycle action, buffered in a per-process ring, flushed
+# through the relay/batch path into the journaled `events` scope, and
+# served on the signed GET /events (scripts/hvd_events.py console)
+HVD_EVENTS = "HVD_EVENTS"                              # 0 disables the recorder (default on)
+HVD_EVENTS_RING_CAP = "HVD_EVENTS_RING_CAP"            # per-process ring capacity, events (default 1024)
+HVD_EVENTS_FLUSH_SECONDS = "HVD_EVENTS_FLUSH_SECONDS"  # worker-side flusher cadence (default HVD_METRICS_PUSH_SECONDS)
+HVD_EVENTS_SERVER_CAP = "HVD_EVENTS_SERVER_CAP"        # server-side retained event cap per source (default 4096)
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -283,6 +292,9 @@ DEFAULT_WATCH_BURN_RATE = 2.0                      # breach-fraction / budget al
 DEFAULT_WATCH_ARM_STEPS = 8                        # auto-armed trace+profile window length
 DEFAULT_WATCH_ARM_MARGIN_STEPS = 16                # arm start margin past the newest observed step
 DEFAULT_WATCH_ARM_COOLDOWN_SECONDS = 120.0         # min spacing between auto-arms
+DEFAULT_EVENTS_RING_CAP = 1024                     # observe/events.py per-process ring capacity
+DEFAULT_EVENTS_FLUSH_SECONDS = 5.0                 # worker-side event flusher cadence
+DEFAULT_EVENTS_SERVER_CAP = 4096                   # server-side retained events per source
 
 
 def get_int(name: str, default: int) -> int:

@@ -1,0 +1,228 @@
+"""Unified status console: one page for a job's whole control plane.
+
+Joins every signed GET surface the rendezvous server exposes
+(docs/api.md) into one text dashboard or JSON document:
+
+* ``/health`` — per-rank lease verdicts;
+* ``/membership`` — the committed elastic epoch and world;
+* ``/metrics`` — the aggregated counter/gauge snapshot;
+* ``/alerts`` — the watchdog's detector verdicts;
+* ``/serving`` — replica fleet, queue window, SLO headroom;
+* ``/autotune`` — profile-guided plans, predicted vs realized;
+* ``/timeseries`` — the flushed telemetry history summary;
+* ``/events`` — the flight recorder's correlated event timeline.
+
+``--incident`` switches to incident-report mode: it finds the causal
+chains in the event timeline (observe/events.py ``extract_chain``),
+summarizes each (failed rank, steps lost, duration), and emits them as
+text or — with ``--json`` — a machine-readable report; ``--incident
+EVENT_ID`` restricts to the chain that event belongs to.
+
+Run::
+
+    python scripts/hvd_dash.py HOST:PORT [--secret HEX] [--json]
+    python scripts/hvd_dash.py HOST:PORT --incident [EVENT_ID] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.observe.events import (  # noqa: E402
+    chain_summary, extract_chain,
+)
+
+#: (section, accessor name) — every surface the dashboard joins; the
+#: route lint (scripts/check_routes.py) keeps this in sync with the
+#: server's route table through docs/api.md
+SECTIONS = (
+    ("health", "get_health"),
+    ("membership", "get_membership"),
+    ("alerts", "get_alerts"),
+    ("serving", "get_serving"),
+    ("autotune", "get_autotune"),
+    ("timeseries", "get_timeseries"),
+    ("events", "get_events"),
+)
+
+
+def fetch_all(addr: str, port: int, secret) -> dict:
+    """Every section's report (None where a plane is off/unpublished —
+    a dashboard must render what exists, not fail on what doesn't)."""
+    from horovod_tpu.run import http_client
+
+    out = {}
+    for name, accessor in SECTIONS:
+        try:
+            out[name] = getattr(http_client, accessor)(addr, port,
+                                                       secret=secret)
+        except Exception as e:  # noqa: BLE001
+            out[name] = None
+            print(f"{name}: fetch failed ({e})", file=sys.stderr)
+    try:
+        out["metrics"] = json.loads(http_client.get_metrics(
+            addr, port, secret=secret, json_form=True))
+    except Exception as e:  # noqa: BLE001
+        out["metrics"] = None
+        print(f"metrics: fetch failed ({e})", file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# incident reports
+# ---------------------------------------------------------------------------
+def incident_reports(events, event_id=None) -> list:
+    """Correlated chains in the timeline, each with its summary digest.
+    ``event_id`` restricts to one chain; otherwise every multi-event
+    correlation (an incident is a chain, not a lone event) is reported,
+    oldest first."""
+    events = [e for e in events or [] if isinstance(e, dict)]
+    if event_id is not None:
+        chain = extract_chain(events, event_id)
+        return [{"summary": chain_summary(chain), "chain": chain}] \
+            if chain else []
+    seen = set()
+    reports = []
+    for e in events:
+        corr = e.get("correlation_id") or e.get("id")
+        if corr in seen:
+            continue
+        seen.add(corr)
+        chain = extract_chain(events, e["id"])
+        if len(chain) >= 2:
+            reports.append({"summary": chain_summary(chain),
+                            "chain": chain})
+    reports.sort(key=lambda r: r["chain"][0].get("ts") or 0.0)
+    return reports
+
+
+def _print_incidents(reports) -> None:
+    if not reports:
+        print("incidents: none (no multi-event causal chains)")
+        return
+    print(f"incidents: {len(reports)}")
+    for rep in reports:
+        s = rep["summary"]
+        extras = []
+        if s.get("failed_rank") is not None:
+            extras.append(f"failed rank {s['failed_rank']}")
+        if s.get("steps_lost") is not None:
+            extras.append(f"{s['steps_lost']} step(s) lost")
+        if s.get("duration_seconds") is not None:
+            extras.append(f"{s['duration_seconds']:.1f}s")
+        tail = f" [{', '.join(extras)}]" if extras else ""
+        print(f"  {s['correlation_id']}: "
+              f"{' -> '.join(k for k in s['kinds'] if k)}{tail}")
+
+
+# ---------------------------------------------------------------------------
+# text rendering
+# ---------------------------------------------------------------------------
+def _print_dash(d: dict) -> None:
+    health = d.get("health") or {}
+    ranks = health.get("ranks") or {}
+    verdicts = {}
+    for info in ranks.values():
+        v = (info or {}).get("verdict", "?")
+        verdicts[v] = verdicts.get(v, 0) + 1
+    print(f"health: {len(ranks)} rank(s) "
+          + (", ".join(f"{v}={n}" for v, n in sorted(verdicts.items()))
+             if verdicts else "(no leases)"))
+
+    mem = d.get("membership") or {}
+    rec = mem.get("record") or mem
+    if rec.get("epoch") is not None:
+        print(f"membership: epoch {rec.get('epoch')} world "
+              f"{rec.get('world')} ({rec.get('reason') or 'n/a'})")
+    else:
+        print("membership: not elastic")
+
+    alerts = (d.get("alerts") or {}).get("alerts") or []
+    counts = (d.get("alerts") or {}).get("counts") or {}
+    print(f"alerts: {len(alerts)}"
+          + (f" ({', '.join(f'{k}={v}' for k, v in sorted(counts.items()))})"
+             if counts else ""))
+
+    serving = d.get("serving") or {}
+    if serving.get("replicas") is not None:
+        win = serving.get("window") or {}
+        print(f"serving: {serving.get('replicas')} replica(s), queue "
+              f"{win.get('queue_depth')}, p99 {win.get('p99_ms')} ms")
+    else:
+        print("serving: off")
+
+    autotune = d.get("autotune") or {}
+    plans = autotune.get("plans") or []
+    latest = autotune.get("latest") or {}
+    print(f"autotune: {len(plans)} plan record(s)"
+          + (f", predicted {latest.get('predicted_speedup_pct')}% / "
+             f"realized {latest.get('realized_speedup_pct')}%"
+             if latest else ""))
+
+    ts = d.get("timeseries") or {}
+    print(f"timeseries: {len(ts.get('ranks') or {})} rank(s), "
+          f"{len(ts.get('summary') or {})} series")
+
+    metrics = d.get("metrics")
+    if isinstance(metrics, dict):
+        print(f"metrics: {len(metrics)} rank snapshot(s)")
+
+    ev = d.get("events") or {}
+    events = ev.get("events") or []
+    ecounts = ev.get("counts") or {}
+    print(f"events: {len(events)}"
+          + (f" ({', '.join(f'{k}={v}' for k, v in sorted(ecounts.items()))})"
+             if ecounts else ""))
+    _print_incidents(incident_reports(events))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="unified control-plane status console "
+                    "(every signed GET surface on one page)")
+    p.add_argument("endpoint", metavar="HOST:PORT",
+                   help="the launcher's rendezvous server")
+    p.add_argument("--secret", default=None,
+                   help="hex HMAC secret (HVD_METRICS_SECRET)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable dump on stdout")
+    p.add_argument("--incident", nargs="?", const="", default=None,
+                   metavar="EVENT_ID",
+                   help="incident-report mode: correlated causal chains "
+                        "from the event timeline (optionally just the "
+                        "chain EVENT_ID belongs to)")
+    args = p.parse_args(argv)
+
+    addr, _, port_s = args.endpoint.partition(":")
+    if not addr or not port_s.isdigit():
+        p.error(f"endpoint wants HOST:PORT, got {args.endpoint!r}")
+    port = int(port_s)
+    secret = bytes.fromhex(args.secret) if args.secret else None
+
+    if args.incident is not None:
+        from horovod_tpu.run.http_client import get_events
+
+        report = get_events(addr, port, secret=secret)
+        reports = incident_reports(report.get("events"),
+                                   event_id=args.incident or None)
+        if args.json:
+            print(json.dumps({"incidents": reports}, indent=2))
+        else:
+            _print_incidents(reports)
+        return {"incidents": reports}
+
+    d = fetch_all(addr, port, secret)
+    if args.json:
+        print(json.dumps(d, indent=2))
+    else:
+        _print_dash(d)
+    return d
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""Tail and dissect the control-plane flight recorder.
+
+The operator console for the correlated event timeline
+(docs/observe.md "Flight recorder"): reads the launcher's signed
+``GET /events`` (observe/events.py — every lifecycle actor's
+``{ts, host, rank, kind, severity, correlation_id, cause_id,
+payload}`` records) and renders it as text or JSON.  ``--chain ID``
+reconstructs the causal chain an event belongs to and summarizes the
+incident (failed rank, steps lost, duration); ``--follow`` tails the
+timeline and marks server restarts; ``--check`` replays the built-in
+hand-written incident fixture (the tier-1 bar).
+
+Run::
+
+    python scripts/hvd_events.py HOST:PORT [--secret HEX] \
+        [--json] [--since TS] [--kind PREFIX] \
+        [--follow [--interval S]] [--chain EVENT_ID]
+    python scripts/hvd_events.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.observe.events import (  # noqa: E402
+    chain_summary, extract_chain,
+)
+from horovod_tpu.observe.fixtures import (  # noqa: E402
+    EVENTS_EXPECTED, evaluate_events_fixture, events_fixture,
+)
+
+
+def run_check() -> int:
+    """Self-test: chain extraction + incident summary must reproduce
+    the fixture's hand-written verdicts exactly — 6 chained events in
+    cause order, the unrelated checkpoint event excluded, failed rank
+    and steps lost named."""
+    errors = []
+    got = evaluate_events_fixture()
+    exp = EVENTS_EXPECTED
+    for field in ("correlation_id", "events", "kinds", "failed_rank",
+                  "steps_lost", "severities"):
+        if got.get(field) != exp[field]:
+            errors.append(f"{field}: {got.get(field)!r} != {exp[field]!r}")
+    if not math.isclose(float(got.get("duration_seconds") or 0.0),
+                        exp["duration_seconds"], rel_tol=0, abs_tol=1e-9):
+        errors.append(f"duration_seconds: {got.get('duration_seconds')} "
+                      f"!= {exp['duration_seconds']}")
+    # a mid-chain entry point must reconstruct the SAME chain as the
+    # tail (the walk reaches the root before collecting)
+    fx = events_fixture()
+    mid = extract_chain(fx, "launcher-1-2")
+    if [e["id"] for e in mid] != \
+            [e["id"] for e in extract_chain(fx, "worker2-9-1")]:
+        errors.append("mid-chain extraction diverged from tail extraction")
+    if errors:
+        print("hvd_events --check FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"hvd_events --check OK: {exp['events']}-event chain "
+          f"{' -> '.join(exp['kinds'])} (failed rank "
+          f"{exp['failed_rank']}, {exp['steps_lost']} steps lost, "
+          f"{exp['duration_seconds']:.1f}s); unrelated checkpoint event "
+          "excluded")
+    return 0
+
+
+def _fetch(addr: str, port: int, secret, since_ts=None, kind=None) -> dict:
+    from horovod_tpu.run.http_client import get_events
+
+    return get_events(addr, port, secret=secret, since_ts=since_ts,
+                      kind=kind)
+
+
+def _print_event(e: dict) -> None:
+    rank = f"r{e['rank']}" if e.get("rank") is not None else "-"
+    payload = e.get("payload") or {}
+    detail = " ".join(f"{k}={v}" for k, v in sorted(payload.items())
+                      if v is not None and not isinstance(v, (dict, list)))
+    cause = f"  <- {e['cause_id']}" if e.get("cause_id") else ""
+    print(f"  {e.get('ts', 0):.3f} {e.get('severity', '?'):<8} "
+          f"{e.get('kind', '?'):<22} {rank:<4} {e.get('id')}"
+          f"{cause}  {detail}")
+
+
+def _print_chain(chain, summary) -> None:
+    if not chain:
+        print("no chain found for that event id", file=sys.stderr)
+        return
+    print(f"incident {summary['correlation_id']}: "
+          f"{summary['events']} event(s)"
+          + (f", failed rank {summary['failed_rank']}"
+             if summary.get("failed_rank") is not None else "")
+          + (f", {summary['steps_lost']} step(s) lost"
+             if summary.get("steps_lost") is not None else "")
+          + (f", {summary['duration_seconds']:.1f}s expiry-to-resume"
+             if summary.get("duration_seconds") is not None else ""))
+    for e in chain:
+        _print_event(e)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="control-plane flight recorder console (GET /events)")
+    p.add_argument("endpoint", nargs="?", metavar="HOST:PORT",
+                   help="the launcher's rendezvous server")
+    p.add_argument("--secret", default=None,
+                   help="hex HMAC secret (HVD_METRICS_SECRET)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable dump on stdout")
+    p.add_argument("--since", type=float, default=None, metavar="TS",
+                   help="only events with ts strictly after this unix "
+                        "time")
+    p.add_argument("--kind", default=None,
+                   help="kind prefix filter, e.g. 'epoch.' or "
+                        "'abort.publish'")
+    p.add_argument("--follow", action="store_true",
+                   help="keep polling, printing events as they appear")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll interval seconds")
+    p.add_argument("--chain", default=None, metavar="EVENT_ID",
+                   help="reconstruct and summarize the causal chain "
+                        "this event belongs to")
+    p.add_argument("--check", action="store_true",
+                   help="self-test chain extraction on the built-in "
+                        "hand-written incident fixture")
+    args = p.parse_args(argv)
+
+    if args.check:
+        sys.exit(run_check())
+    if not args.endpoint:
+        p.error("HOST:PORT is required (or use --check)")
+    addr, _, port_s = args.endpoint.partition(":")
+    if not addr or not port_s.isdigit():
+        p.error(f"endpoint wants HOST:PORT, got {args.endpoint!r}")
+    port = int(port_s)
+    secret = bytes.fromhex(args.secret) if args.secret else None
+
+    if args.follow:
+        since = args.since
+        incarnation = None
+        while True:
+            try:
+                report = _fetch(addr, port, secret, since_ts=since,
+                                kind=args.kind)
+            except Exception as e:  # noqa: BLE001 — keep tailing
+                print(f"poll failed: {e}", file=sys.stderr)
+                time.sleep(args.interval)
+                continue
+            sid = report.get("server_id")
+            if sid is not None and sid != incarnation:
+                if incarnation is not None:
+                    print("--- server restarted ---")
+                    since = None  # the new incarnation's log starts over
+                incarnation = sid
+            for e in report.get("events") or []:
+                if not isinstance(e, dict):
+                    continue
+                if args.json:
+                    print(json.dumps(e))
+                else:
+                    _print_event(e)
+                if e.get("ts") is not None:
+                    since = max(since or 0.0, float(e["ts"]))
+            sys.stdout.flush()
+            time.sleep(args.interval)
+
+    report = _fetch(addr, port, secret, since_ts=args.since,
+                    kind=None if args.chain else args.kind)
+    events = report.get("events") or []
+
+    if args.chain:
+        chain = extract_chain(events, args.chain)
+        summary = chain_summary(chain)
+        if args.json:
+            print(json.dumps({"chain": chain, "summary": summary},
+                             indent=2))
+        else:
+            _print_chain(chain, summary)
+        return {"chain": chain, "summary": summary}
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        counts = report.get("counts") or {}
+        print(f"events: {len(events)} "
+              f"({', '.join(f'{k}={v}' for k, v in sorted(counts.items()))})"
+              if events else "events: none")
+        for e in events:
+            if isinstance(e, dict):
+                _print_event(e)
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,12 @@ reference timeline.cc:205-228); this CLI fuses a whole trace dir into a
 single viewer-loadable file (pid = rank) and answers the dPRO question
 "which rank is late" from the per-tensor negotiation-wait spread.
 
+A flight-recorder dump saved next to the traces (``hvd_events --json >
+<dir>/events.json``, or a raw ``GET /events`` report) merges as a
+"control plane" row of instant events above the rank rows, so lease
+expiries / epoch commits / restarts line up against the device
+timeline (docs/observe.md).
+
 Run::
 
     python scripts/hvd_trace_merge.py <trace_dir> \

@@ -215,6 +215,7 @@ def main(argv=None):
 
     if args.follow:
         seen = set()
+        incarnation = None
         while True:
             try:
                 _, alerts = _fetch(addr, port, secret)
@@ -222,6 +223,17 @@ def main(argv=None):
                 print(f"poll failed: {e}", file=sys.stderr)
                 time.sleep(args.interval)
                 continue
+            # a new server incarnation (launcher restart, or a warm
+            # standby taking over) renumbers alert ids from 0 — the old
+            # `seen` set would either suppress the new alerts or
+            # re-print the dead server's, so mark the boundary and
+            # start over
+            sid = alerts.get("server_id")
+            if sid is not None and sid != incarnation:
+                if incarnation is not None:
+                    print("--- server restarted ---")
+                    seen = set()
+                incarnation = sid
             for rec in reversed(alerts.get("alerts") or []):
                 if isinstance(rec, dict) and rec.get("id") not in seen:
                     seen.add(rec.get("id"))

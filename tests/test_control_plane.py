@@ -37,6 +37,15 @@ from horovod_tpu.utils import env as env_util
 SECRET = b"control-plane-test"
 
 
+def _wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
 @pytest.fixture()
 def server():
     s = RendezvousServer(secret=SECRET)
@@ -652,6 +661,72 @@ def test_relay_routed_heartbeat_observes_abort(server):
     finally:
         hb.stop()
         daemon.stop()
+
+
+def test_events_flush_survives_relay_death_no_loss_no_dup(server,
+                                                          monkeypatch):
+    """Flight-recorder pushes ride the relay batch path (events is a
+    BATCH_SCOPE); when the relay dies mid-run the flusher must fall
+    back to the primary permanently with every event delivered exactly
+    once — an event key is unique, so a duplicate would surface as a
+    second record and a loss as a missing one."""
+    from horovod_tpu.observe import events as events_mod
+
+    monkeypatch.setenv(env_util.HVD_METRICS_KV_ADDR, "127.0.0.1")
+    monkeypatch.setenv(env_util.HVD_METRICS_KV_PORT, str(server.port))
+    daemon = relay_mod.RelayDaemon("127.0.0.1", server.port, secret=SECRET,
+                                   flush_ms=50)
+    rport = daemon.start()
+    relay_mod._endpoint = ("127.0.0.1", rport, True)
+    rec = events_mod.Recorder(cap=64)
+    flusher = events_mod.EventFlusher(rec, "127.0.0.1", server.port,
+                                      secret=SECRET, interval=3600.0)
+    e1 = rec.record("epoch.commit", payload={"epoch": 0})
+    try:
+        assert flusher.flush_now()
+        # e1 went via the relay loopback; its flush thread lands it
+        assert _wait_for(
+            lambda: server.get(events_mod.EVENTS_SCOPE, e1) is not None)
+    finally:
+        daemon.stop()
+    e2 = rec.record("epoch.commit", payload={"epoch": 1}, cause_id=e1)
+    assert flusher.flush_now()                  # silent direct fallback
+    assert relay_mod.control_endpoint()[2] is False
+    report = server.events_report()
+    assert [e["id"] for e in report["events"]] == [e1, e2]
+    assert rec.pending() == 0 and rec.dropped == 0
+    # and the fallback is PERMANENT: the next flush goes direct too
+    e3 = rec.record("epoch.commit", payload={"epoch": 2})
+    assert flusher.flush_now()
+    assert [e["id"] for e in server.events_report()["events"]] == \
+        [e1, e2, e3]
+
+
+def test_alerts_push_survives_relay_death(server, monkeypatch):
+    """The watchdog's alert pushes take the same control_put road: a
+    dead relay must not eat an alert (ids are unique, so loss —
+    not coalescing — is the failure mode)."""
+    monkeypatch.setenv(env_util.HVD_METRICS_KV_ADDR, "127.0.0.1")
+    monkeypatch.setenv(env_util.HVD_METRICS_KV_PORT, str(server.port))
+    daemon = relay_mod.RelayDaemon("127.0.0.1", server.port, secret=SECRET,
+                                   flush_ms=50)
+    rport = daemon.start()
+    relay_mod._endpoint = ("127.0.0.1", rport, True)
+    relay_mod.control_put("127.0.0.1", server.port, "alerts", "0",
+                          json.dumps({"id": "0", "signal": "mfu_drop",
+                                      "severity": "warning"}).encode(),
+                          secret=SECRET)
+    assert _wait_for(lambda: server.get("alerts", "0") is not None)
+    daemon.stop()
+    relay_mod.control_put("127.0.0.1", server.port, "alerts", "1",
+                          json.dumps({"id": "1", "signal": "slo_burn",
+                                      "severity": "critical"}).encode(),
+                          secret=SECRET)
+    assert relay_mod.control_endpoint()[2] is False
+    assert server.get("alerts", "1") is not None  # direct fallback
+    report = http_client.get_alerts("127.0.0.1", server.port,
+                                    secret=SECRET)
+    assert {a["id"] for a in report["alerts"]} == {"0", "1"}
 
 
 # -- metrics delta pushes ----------------------------------------------------

@@ -388,7 +388,7 @@ def test_watchdog_evicts_critical_straggler_via_driver(
         def __init__(self):
             self.removed = []
 
-        def remove(self, worker, reason, *, drain=False):
+        def remove(self, worker, reason, *, drain=False, cause_id=None):
             self.removed.append((worker, drain))
             return True
 
