@@ -67,6 +67,7 @@ PROJECTION_TIMEOUT_S = 240  # digital-twin leg: two traced MLP drives (1 + 8 dev
 COMPUTE_OPT_TIMEOUT_S = 240  # compute-path A/B: two MLP drives + a profiler window
 CONTROL_TIMEOUT_S = 120    # control-plane churn: ~5k loopback HTTP requests
 WATCH_TIMEOUT_S = 90       # watchdog leg: pure host-side detector replay
+RESTORE_TIMEOUT_S = 120    # peer-restore leg: snapshot/restore fixture
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -350,6 +351,122 @@ def _watch_leg() -> dict:
             "watch_overhead_pct_1ms_step": None, "watch_error": reason}
 
 
+def _measure_restore() -> None:
+    """Child-process entry for the peer-state-plane leg: a 3-worker
+    in-process fixture (one rendezvous + three peer shard servers,
+    elastic/peerstate.py) snapshotting a ~4 MB state every 5 steps and
+    then restoring from peers under churn — pure host-side machinery,
+    runs anywhere.  Tracked numbers: the step-path stall of a snapshot
+    enqueue in µs (the ONLY checkpoint cost a step pays on the peer
+    tier), restore-to-training p99 in ms, and steps lost on a failure
+    at the worst point of the snapshot interval."""
+    import json as _json
+    import time as _time
+
+    import numpy as np
+
+    os.environ["HVD_NUM_PROCESSES"] = "3"   # gen committed = all 3 ranks
+    from horovod_tpu.elastic.peerstate import PeerSnapshotManager
+    from horovod_tpu.run.http_server import RendezvousServer
+
+    secret = b"bench-restore"
+    server = RendezvousServer(secret=secret)
+    port = server.start()
+    managers = [
+        PeerSnapshotManager(replicas_k=2, nshards=4, addr="127.0.0.1",
+                            port=port, secret=secret, worker=f"w{r}",
+                            rank=r)
+        for r in range(3)
+    ]
+    try:
+        for m in managers:
+            m.start()
+        rng = np.random.default_rng(0)
+        state = {"params": rng.standard_normal(500_000),  # ~4 MB
+                 "opt": rng.standard_normal(2)}
+        # 59 steps with a 5-step cadence: the last committed generation
+        # is 55, so the measured steps-lost is the honest worst point of
+        # the interval (a crash just before the next snapshot)
+        steps, interval = 59, 5
+        stalls_us = []
+        for step in range(1, steps + 1):
+            _time.sleep(0.001)                    # the fake train step
+            if step % interval == 0:
+                for m in managers:
+                    s = m.snapshot(state, step)
+                    if m is managers[0]:
+                        stalls_us.append(s * 1e6)
+        ok = all(m.drain(30.0) for m in managers)
+        restores_ms = []
+        restored_gen = None
+        for _ in range(20):
+            # a fresh manager each round: the post-crash relaunch shape
+            fresh = PeerSnapshotManager(replicas_k=2, nshards=4,
+                                        addr="127.0.0.1", port=port,
+                                        secret=secret, worker="w0", rank=0)
+            t0 = _time.perf_counter()
+            got = fresh.restore()
+            restores_ms.append((_time.perf_counter() - t0) * 1e3)
+            if got is not None:
+                restored_gen = got[1]
+        restores_ms.sort()
+        got_all = restored_gen is not None and bool(restores_ms)
+        p99_i = min(int(len(restores_ms) * 0.99), len(restores_ms) - 1) \
+            if restores_ms else 0
+        print("RESULT " + _json.dumps({
+            "restore_ckpt_stall_us":
+                round(sum(stalls_us) / len(stalls_us), 3)
+                if stalls_us else None,
+            "restore_p99_ms": round(restores_ms[p99_i], 3)
+                if got_all else None,
+            "restore_p50_ms": round(
+                restores_ms[len(restores_ms) // 2], 3)
+                if got_all else None,
+            # a crash at the worst point loses the steps since the last
+            # committed snapshot — the target is one interval
+            "restore_steps_lost": (steps - restored_gen)
+                if restored_gen is not None else None,
+            "restore_snapshot_interval": interval,
+            "restore_drained": ok,
+        }))
+    finally:
+        for m in managers:
+            m.stop()
+        server.stop()
+
+
+def _restore_leg() -> dict:
+    """The peer-state-plane tail fields, from a separately-timed child
+    so a hung snapshot fixture can never cost the main number
+    (HVD_BENCH_RESTORE=0 skips).  Null-on-failure, same contract as
+    every other leg."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_RESTORE, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-restore", RESTORE_TIMEOUT_S)
+        if payload is not None:
+            return {
+                "restore_ckpt_stall_us":
+                    payload.get("restore_ckpt_stall_us"),
+                "restore_p99_ms": payload.get("restore_p99_ms"),
+                "restore_steps_lost": payload.get("restore_steps_lost"),
+                "restore_snapshot_interval":
+                    payload.get("restore_snapshot_interval"),
+            }
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"restore_ckpt_stall_us": None, "restore_p99_ms": None,
+            "restore_steps_lost": None, "restore_snapshot_interval": None,
+            "restore_error": reason}
+
+
 def _control_leg() -> dict:
     """The control-plane tail fields, from a separately-timed child so
     a hung or failed churn run can never cost the main number
@@ -607,6 +724,10 @@ def main() -> None:
             # latency + false positives on a scripted regression trace,
             # and the ring-buffer append cost the step path pays
             out.update(_watch_leg())
+            # peer-state-plane tail (HVD_BENCH_RESTORE=0 skips):
+            # snapshot enqueue stall µs/step, restore-from-peers p99,
+            # and steps lost to a worst-point failure
+            out.update(_restore_leg())
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -640,6 +761,8 @@ if __name__ == "__main__":
         _measure_control()
     elif "--child-watch" in sys.argv:
         _measure_watch()
+    elif "--child-restore" in sys.argv:
+        _measure_restore()
     elif "--child" in sys.argv:
         _measure()
     else:

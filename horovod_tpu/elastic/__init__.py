@@ -10,6 +10,10 @@ Three layers:
   :class:`HorovodAbortError` at the dispatch/train-step seams,
   :class:`ElasticState` auto-resume under ``tpurun --restarts``, and the
   ``HVD_FAULT_SPEC`` fault-injection harness that tests all of it.
+  The **peer state plane** (peerstate.py, ``HVD_SNAPSHOT=1``) layers
+  async K-peer-replicated snapshots over the storage checkpoints:
+  µs-stall step path, restore-from-peers in sub-seconds, storage tier
+  demoted to a slow durable backstop.
 * **elastic membership** (membership.py worker side, driver.py launcher
   side; ``tpurun --elastic``) — shrink/grow worlds through committed
   membership epochs: survivors rebuild in process (``core.reinit()``),
@@ -25,4 +29,4 @@ from .membership import (  # noqa: F401
     join_world,
     run,
 )
-from . import driver, faults, heartbeat, membership  # noqa: F401
+from . import driver, faults, heartbeat, membership, peerstate  # noqa: F401

@@ -43,6 +43,7 @@ from ..run.http_server import (
     HEALTH_SCOPE,
     MEMBERSHIP_SCOPE,
     READY_PREFIX,
+    SPARE_PREFIX,
     STATE_PREFIX,
 )
 from ..utils import env as env_util
@@ -352,13 +353,14 @@ class ElasticDriver:
         """Admit the longest-held spare (FIFO) into the next epoch;
         returns its worker id, or None when no spare is available.
 
-        Known limitation: held spares carry no liveness signal (they
-        are outside the world, so no heartbeat lease covers them) — a
-        spare that died while held is admitted, stalls the stability
-        barrier for one elastic timeout, and is then removed by lease
-        expiry.  The damage is bounded and one-shot (a dead process
-        cannot re-announce), but giving spares lease renewal is the
-        proper fix when spare pools grow large."""
+        Held spares DO carry a liveness signal: ``join_world`` renews an
+        announce-keyed lease at ``health/spare.<worker>`` the whole time
+        the worker waits, and :meth:`_purge_dead_spares` runs before
+        each admission attempt — a spare that died while held is purged
+        here (and from the stable-epoch poll) instead of being admitted,
+        stalling the stability barrier for an elastic timeout, and only
+        then being removed by rank-lease expiry."""
+        self._purge_dead_spares()
         while self.spares:
             w = self.spares.pop(0)
             if w in self.blocklist or w in self.world:
@@ -366,6 +368,29 @@ class ElasticDriver:
             if self.admit([w], reason=reason) is not None:
                 return w
         return None
+
+    def _purge_dead_spares(self) -> None:
+        """Drop held spares whose ``spare.<worker>`` lease went dead
+        (elastic/membership.renew_spare_lease).  A spare with NO lease
+        entry is left alone — its key may simply have been wiped by the
+        last epoch commit's health-scope clear and not yet re-renewed;
+        the dead verdict is the only affirmative death signal."""
+        if not self.spares:
+            return
+        ranks = self.server.health_report().get("ranks", {})
+        for w in list(self.spares):
+            info = ranks.get(f"{SPARE_PREFIX}{w}")
+            if info is None or info.get("verdict") != "dead":
+                continue
+            self.spares.remove(w)
+            self.server.delete(HEALTH_SCOPE, f"{SPARE_PREFIX}{w}")
+            self._event("spare.purged", severity="warning",
+                        payload={"worker": w,
+                                 "age_seconds": info.get("age_seconds"),
+                                 "held": len(self.spares)})
+            log.warning("purged dead spare %s (lease age %.1fs); %d "
+                        "spare(s) still held", w,
+                        info.get("age_seconds") or -1.0, len(self.spares))
 
     def _publish_abort(self, reason: str, rank: Optional[int],
                        cause_id: Optional[str] = None) -> None:
@@ -463,6 +488,7 @@ class ElasticDriver:
                 and not self.finished:
             # no admissions once any member finished: the job is winding
             # down, and a joiner would inherit a roster of exiting peers
+            self._purge_dead_spares()
             announced = self._announced()
             for w in sorted(announced & self.blocklist):
                 # a blocklisted flapper's announce can never be admitted;

@@ -12,7 +12,13 @@ the full failure-domain loop deterministically:
 * **http** — the rendezvous HTTP client (run/http_client.py), to
   exercise its retry/backoff path;
 * **controller** — the eager-plane negotiation handshake
-  (runtime/eager_controller.negotiate).
+  (runtime/eager_controller.negotiate);
+* **peer_push** / **peer_pull** — the peer state plane's shard upload
+  and restore reads (elastic/peerstate.py).  ``peer_push`` is a
+  *mutating* seam: a ``corrupt`` fault flips bytes in the shard on its
+  way to the replica, so the checksum-reject → storage-fallback path is
+  drivable end to end; ``peer_pull`` fires before each shard fetch, so
+  ``http_drop`` / ``partition`` there model a peer dying mid-restore.
 
 Grammar (specs separated by ``;``, fields by ``:``, ``key=value``)::
 
@@ -20,6 +26,8 @@ Grammar (specs separated by ``;``, fields by ``:``, ``key=value``)::
     HVD_FAULT_SPEC="rank=*:kind=slow=200ms:prob=0.5;rank=0:step=10:kind=hang"
     HVD_FAULT_SPEC="kind=http_drop:prob=0.3:restart=*"
     HVD_FAULT_SPEC="rank=1:step=4:kind=partition"
+    HVD_FAULT_SPEC="kind=corrupt:seam=peer_push:restart=*"
+    HVD_FAULT_SPEC="kind=http_drop:seam=peer_pull:restart=*"
 
 Fields:
 
@@ -30,15 +38,18 @@ Fields:
              ``hang`` (sleep forever, the wedged-collective shape),
              ``slow=<dur>`` (inject ``<dur>`` latency, e.g. ``200ms`` /
              ``1.5s``, then continue), ``http_drop`` (raise
-             ``URLError`` from the HTTP client), or ``partition`` (a
+             ``URLError`` from the HTTP client), ``partition`` (a
              network split: from the firing point on, EVERY rendezvous
              HTTP request raises ``URLError`` and every controller
              negotiation raises ``TimeoutError``, while the process
              itself stays alive — heartbeat leases expire and the
-             elastic driver removes the rank without a process death).
+             elastic driver removes the rank without a process death),
+             or ``corrupt`` (flip bytes in the payload at a mutating
+             seam — only ``peer_push`` today; elsewhere it is a no-op).
 ``prob``     float in [0, 1] (default 1.0).
-``seam``     ``step`` / ``dispatch`` / ``http`` / ``controller``;
-             defaults to ``http`` for ``http_drop`` and ``step``
+``seam``     ``step`` / ``dispatch`` / ``http`` / ``controller`` /
+             ``peer_push`` / ``peer_pull``; defaults to ``http`` for
+             ``http_drop``, ``peer_push`` for ``corrupt``, and ``step``
              otherwise.
 ``restart``  int or ``*`` (default 0): the ``HVD_RESTART_COUNT``
              incarnation the fault applies to.  The default means a
@@ -65,8 +76,9 @@ log = get_logger(__name__)
 #: in launcher logs and test assertions.
 FAULT_EXIT_CODE = 17
 
-KINDS = ("crash", "hang", "slow", "http_drop", "partition")
-SEAMS = ("step", "dispatch", "http", "controller")
+KINDS = ("crash", "hang", "slow", "http_drop", "partition", "corrupt")
+SEAMS = ("step", "dispatch", "http", "controller", "peer_push",
+         "peer_pull")
 
 _DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m)?$")
 _DUR_SCALE = {"ms": 1e-3, "s": 1.0, "m": 60.0, None: 1.0}
@@ -138,7 +150,9 @@ def parse_spec(text: str) -> List[Fault]:
         elif arg:
             raise FaultSpecError(
                 f"kind={kind} takes no argument (got {arg!r}) in {chunk!r}")
-        seam = fields.get("seam", "http" if kind == "http_drop" else "step")
+        default_seam = {"http_drop": "http",
+                        "corrupt": "peer_push"}.get(kind, "step")
+        seam = fields.get("seam", default_seam)
         if seam not in SEAMS:
             raise FaultSpecError(
                 f"unknown seam {seam!r} in {chunk!r} (want one of {SEAMS})")
@@ -187,6 +201,39 @@ class FaultInjector:
                 continue
             self._act(f, seam, n, detail)
 
+    def mutate(self, seam: str, data: bytes) -> bytes:
+        """The mutating variant of :meth:`fire` for seams that carry a
+        payload (``peer_push``): a matching ``corrupt`` fault flips
+        bytes in ``data``; any other matching kind acts as usual.  The
+        seam's invocation counter advances exactly once per call."""
+        with self._lock:
+            n = self._counts[seam]
+            self._counts[seam] = n + 1
+        for f in self.faults:
+            if f.seam != seam:
+                continue
+            if f.rank is not None and f.rank != self.rank:
+                continue
+            if f.restart is not None and f.restart != self.restart:
+                continue
+            if f.step is not None and f.step != n:
+                continue
+            if f.prob < 1.0 and random.random() >= f.prob:
+                continue
+            if f.kind == "corrupt":
+                from .. import metrics
+
+                if metrics.on():
+                    metrics.FAULTS_INJECTED.labels(f.kind).inc()
+                log.warning(
+                    "fault injection: corrupt at %s[%d] rank=%d "
+                    "restart=%d (%d bytes)", seam, n, self.rank,
+                    self.restart, len(data))
+                data = _flip_bytes(data)
+            else:
+                self._act(f, seam, n, f"{len(data)}B")
+        return data
+
     def _act(self, f: Fault, seam: str, n: int, detail: str) -> None:
         from .. import metrics
 
@@ -208,6 +255,20 @@ class FaultInjector:
 
             raise urllib.error.URLError(
                 f"injected http_drop at {seam}[{n}] {detail}")
+        # `corrupt` outside a mutating seam has no payload to flip — the
+        # log line above is its only effect
+
+
+def _flip_bytes(data: bytes) -> bytes:
+    """Deterministic corruption: XOR a stride of bytes so any CRC32
+    content checksum rejects the shard (elastic/peerstate.py)."""
+    if not data:
+        return b"\xff"
+    out = bytearray(data)
+    stride = max(len(out) // 8, 1)
+    for i in range(0, len(out), stride):
+        out[i] ^= 0xFF
+    return bytes(out)
 
 
 # ---------------------------------------------------------------------------
@@ -274,6 +335,31 @@ def on_http(path: str) -> None:
 
             raise urllib.error.URLError(
                 f"injected partition: rendezvous traffic dropped ({path})")
+
+
+def on_peer_push(data: bytes) -> bytes:
+    """The shard-upload seam (elastic/peerstate.py snapshot push).  A
+    ``corrupt`` fault returns flipped bytes — the replica lands with a
+    checksum that can never verify, driving the checksum-reject →
+    next-replica → storage-fallback chain in tier-1."""
+    inj = instance()
+    if inj is None:
+        return data
+    return inj.mutate("peer_push", data)
+
+
+def on_peer_pull(key: str) -> None:
+    """The shard-fetch seam (elastic/peerstate.py restore).  An
+    ``http_drop`` or ``partition`` here is a peer dying mid-restore:
+    the puller falls to the next replica, then to the storage tier."""
+    inj = instance()
+    if inj is not None:
+        inj.fire("peer_pull", detail=key)
+        if inj.partitioned:
+            import urllib.error
+
+            raise urllib.error.URLError(
+                f"injected partition: peer shard traffic dropped ({key})")
 
 
 def on_controller(name: str) -> None:

@@ -346,34 +346,87 @@ def apply_epoch(rec: dict) -> int:
     return new_rank
 
 
+def renew_spare_lease() -> None:
+    """Announce-keyed liveness for a worker the driver may be *holding*
+    as a spare (``--min-np`` satisfied): one lease PUT at
+    ``health/spare.<worker>`` — non-numeric key, so the driver's
+    rank-lease expiry loop ignores it, but the server's STALE/DEAD
+    verdicts apply and :meth:`~horovod_tpu.elastic.driver.ElasticDriver.
+    _purge_dead_spares` drops a dead-while-held spare before trying to
+    admit it.  Best-effort: a failed renewal just ages the lease."""
+    from ..run.http_client import put_kv
+    from ..run.http_server import HEALTH_SCOPE, SPARE_PREFIX
+
+    addr, port, secret = _wiring()
+    interval = env_util.get_float(env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
+                                  env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS)
+    body = json.dumps({"worker": worker_id(), "interval": interval,
+                       "spare": True, "pid": os.getpid()}).encode()
+    try:
+        put_kv(addr, port, HEALTH_SCOPE, f"{SPARE_PREFIX}{worker_id()}",
+               body, secret=secret)
+    except (urllib.error.URLError, OSError) as e:
+        log.debug("spare lease renewal failed: %s", e)
+
+
+def clear_spare_lease() -> None:
+    """Retire the spare lease on admission (the worker now renews a
+    rank-keyed heartbeat lease instead)."""
+    from ..run.http_client import delete_kv
+    from ..run.http_server import HEALTH_SCOPE, SPARE_PREFIX
+
+    addr, port, secret = _wiring()
+    try:
+        delete_kv(addr, port, HEALTH_SCOPE, f"{SPARE_PREFIX}{worker_id()}",
+                  secret=secret)
+    except (urllib.error.URLError, OSError):
+        pass
+
+
 def join_world(state: Any = None,
                timeout: Optional[float] = None) -> dict:
     """Spare-host entry: announce this worker at the rendezvous, wait for
     the driver to admit it into a committed epoch, rebuild into that
     epoch, and (when ``state`` is an ElasticState) receive the live
     training state from rank 0's in-memory broadcast.  Returns the epoch
-    record; raises TimeoutError when no admitting epoch arrives."""
+    record; raises TimeoutError when no admitting epoch arrives.
+
+    The wait is chunked at the heartbeat interval so the worker renews
+    its **spare lease** (:func:`renew_spare_lease`) the whole time it
+    may be sitting in ``driver.spares`` — a spare that dies while held
+    stops renewing and is purged instead of being admitted into an
+    epoch it can never ack."""
     timeout = elastic_timeout() if timeout is None else timeout
     announce()
     me = worker_id()
     deadline = time.monotonic() + timeout
     floor = -1
+    interval = env_util.get_float(env_util.HVD_HEARTBEAT_INTERVAL_SECONDS,
+                                  env_util.DEFAULT_HEARTBEAT_INTERVAL_SECONDS)
     while True:
-        rec = wait_for_epoch(floor + 1,
-                             timeout=max(deadline - time.monotonic(), 0.0))
+        renew_spare_lease()
+        rec = wait_for_epoch(
+            floor + 1,
+            timeout=min(interval, max(deadline - time.monotonic(), 0.0)))
         if rec is None:
-            raise TimeoutError(
-                f"worker {me} announced itself but no epoch admitted it "
-                f"within {timeout:.0f}s (blocklisted, or the driver is "
-                "not elastic)"
-            )
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"worker {me} announced itself but no epoch admitted "
+                    f"it within {timeout:.0f}s (blocklisted, or the driver "
+                    "is not elastic)"
+                )
+            continue  # chunk elapsed: renew the lease, keep waiting
         floor = int(rec.get("epoch", 0))
         if me in rec.get("world", ()):
             break
+    clear_spare_lease()
     apply_epoch(rec)
     if state is not None and hasattr(state, "sync"):
         state.sync(int(rec["epoch"]))
     ack(int(rec["epoch"]))
+    from . import peerstate
+
+    peerstate.on_epoch(rec)  # re-register + reprotect (no-op when off)
     log.info("worker %s joined the world at epoch %s", me, rec.get("epoch"))
     return rec
 
@@ -470,6 +523,12 @@ def run(fn: Callable, state: Any = None, *args: Any,
             if state is not None and hasattr(state, "sync"):
                 state.sync(int(rec["epoch"]))
             ack(int(rec["epoch"]))
+            from . import peerstate
+
+            # shrink re-replication: shards whose replicas left the
+            # world are re-pushed at the epoch boundary (no-op when the
+            # peer state plane is off)
+            peerstate.on_epoch(rec)
             new_size = len(rec.get("world", ()))
             if on_world_change is not None:
                 on_world_change(state, old_size, new_size)

@@ -18,6 +18,15 @@ On restart every rank restores the newest ``step_N`` checkpoint through
 restore), so the job loses at most one checkpoint interval — the
 reference's broadcast-on-start resume contract (SURVEY §5), now driven
 automatically by the failure-domain runtime.
+
+With the peer state plane on (``HVD_SNAPSHOT=1``,
+elastic/peerstate.py) the tiers invert: every ``save(step)`` becomes a
+microsecond async snapshot to K peer hosts, the orbax storage save is
+demoted to every ``HVD_SNAPSHOT_STORAGE_EVERY``-th call as the durable
+backstop, and ``resume()`` pulls from live peers first — checksum-
+verified, falling back wholesale to the storage tier when peers are
+dead or corrupt.  Either way the flight recorder logs which tier won
+(``restore.source`` — docs/fault_tolerance.md#the-peer-state-plane).
 """
 
 from __future__ import annotations
@@ -35,10 +44,27 @@ log = get_logger(__name__)
 class ElasticState:
     """A checkpoint directory paired with the live training state."""
 
-    def __init__(self, path: str, state: Any):
+    def __init__(self, path: str, state: Any,
+                 peer: Optional[bool] = None):
         self.path = path
         self.state = state
         self.step = 0
+        self._saves = 0
+        self._peer = None
+        if peer is None:
+            from . import peerstate
+
+            peer = peerstate.enabled()
+        if peer:
+            from . import peerstate
+
+            try:
+                self._peer = peerstate.manager()
+            except Exception as e:  # noqa: BLE001 — a broken peer tier
+                # degrades to the storage-only contract, never to a
+                # training job that cannot start
+                log.warning("peer state plane unavailable (%s); falling "
+                            "back to storage-tier checkpoints only", e)
 
     @property
     def restart_count(self) -> int:
@@ -53,13 +79,28 @@ class ElasticState:
         Elastic jobs fence first: a partitioned ex-rank-0 that cannot
         reach the rendezvous — or whose membership epoch was superseded —
         must not keep writing checkpoints into the same directory as the
-        re-assigned rank 0 (split-brain double-writer)."""
+        re-assigned rank 0 (split-brain double-writer).
+
+        Peer tier on: EVERY call is an async peer snapshot (µs of stall
+        — the upload happens off the step path), and only every
+        ``HVD_SNAPSHOT_STORAGE_EVERY``-th call still pays the
+        synchronous orbax storage save, the durable backstop."""
         if env_util.get_bool(env_util.HVD_ELASTIC) \
                 and env_util.get_int(env_util.HVD_PROCESS_ID, 0) == 0:
             from . import membership
 
             membership.check_fence()
-        out = save_checkpoint(self.path, self.state, step=step)
+        out = None
+        if self._peer is not None:
+            self._peer.snapshot(self.state, step)
+            every = max(env_util.get_int(
+                env_util.HVD_SNAPSHOT_STORAGE_EVERY,
+                env_util.DEFAULT_SNAPSHOT_STORAGE_EVERY), 1)
+            if self._saves % every == 0:
+                out = save_checkpoint(self.path, self.state, step=step)
+        else:
+            out = save_checkpoint(self.path, self.state, step=step)
+        self._saves += 1
         self.step = int(step)
         return out
 
@@ -95,13 +136,49 @@ class ElasticState:
         return self.state, self.step
 
     def resume(self) -> Tuple[Any, int]:
-        """Restore the newest checkpoint under ``path`` and return
-        ``(state, step)``; a fresh run returns the initial state and 0.
+        """Restore the newest checkpoint and return ``(state, step)``;
+        a fresh run returns the initial state and 0.
+
+        Peer tier on: the newest fully-committed peer generation is
+        tried first — shards pulled from live peers, checksum-verified
+        (sub-second, no storage round trip) — and the storage tier is
+        the wholesale fallback when no peer generation is restorable.
+        Which tier won is recorded as a ``restore.source`` flight event
+        chained onto the abort/epoch incident.
 
         Multi-process: the step choice is broadcast from rank 0 so every
         rank restores the same checkpoint even when only root can list
         the directory; the restore itself rides ``restore_checkpoint``'s
-        agreement round (root failures surface on every rank)."""
+        agreement round (root failures surface on every rank).  The peer
+        path needs neither: every rank resolves the same committed
+        generation from the same rendezvous KV and pulls its OWN shards."""
+        fallback_reason = None
+        if self._peer is not None:
+            got = None
+            try:
+                got = self._peer.restore(self.state)
+            except Exception as e:  # noqa: BLE001 — peer restore must
+                # degrade to storage, never strand the relaunch
+                self._peer.last_failure = f"{type(e).__name__}: {e}"
+            if got is not None:
+                self.state, self.step = got[0], int(got[1])
+                self._record_restore("peer", {"gen": self.step})
+                try:
+                    from ..observe import events as events_mod
+
+                    events_mod.record_event(
+                        "restart.resume", severity="info",
+                        payload={"step": self.step, "source": "peer",
+                                 "incarnation": self.restart_count},
+                        rank=env_util.get_int(env_util.HVD_PROCESS_ID, 0))
+                except Exception:  # noqa: BLE001
+                    pass
+                log.info("elastic resume: restored step %d from peers "
+                         "(incarnation %d)", self.step, self.restart_count)
+                return self.state, self.step
+            fallback_reason = self._peer.last_failure or "peer tier empty"
+            log.warning("elastic resume: peer tier unrestorable (%s); "
+                        "falling back to storage", fallback_reason)
         step = latest_step(self.path)
         if core.is_initialized() and core.process_size() > 1:
             from .. import eager
@@ -114,6 +191,9 @@ class ElasticState:
             return self.state, 0
         self.state = restore_checkpoint(self.path, self.state, step=step)
         self.step = int(step)
+        if self._peer is not None:
+            self._record_restore("storage", {"path": self.path,
+                                             "reason": fallback_reason})
         try:
             from ..observe import events as events_mod
 
@@ -128,3 +208,31 @@ class ElasticState:
         log.info("elastic resume: restored step %d from %s (incarnation %d)",
                  self.step, self.path, self.restart_count)
         return self.state, self.step
+
+    def _record_restore(self, source: str, extra: dict) -> None:
+        """Emit ``restore.source`` (flight recorder) + the
+        ``hvd_restores_total`` tick — chained onto the current epoch
+        record's event ids so the restore shows up inside the
+        abort→epoch incident it resolves (observe/events.py)."""
+        from . import peerstate
+
+        payload = {"source": source, "step": self.step,
+                   "incarnation": self.restart_count}
+        payload.update({k: v for k, v in extra.items() if v is not None})
+        cause_id, correlation_id = peerstate._epoch_chain()
+        try:
+            from ..observe import events as events_mod
+
+            events_mod.record_event(
+                "restore.source", severity="info", payload=payload,
+                cause_id=cause_id, correlation_id=correlation_id,
+                rank=env_util.get_int(env_util.HVD_PROCESS_ID, 0))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from .. import metrics
+
+            if metrics.on():
+                metrics.RESTORES.labels(source).inc()
+        except Exception:  # noqa: BLE001
+            pass

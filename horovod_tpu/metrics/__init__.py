@@ -90,6 +90,20 @@ hvd_events_total                counter    flight-recorder events emitted,
                                            (observe/events.py)
 hvd_events_dropped_total        counter    events dropped on per-process
                                            ring overflow (oldest evicted)
+hvd_snapshots_total             counter    peer-tier snapshot generations
+                                           committed (elastic/peerstate.py)
+hvd_snapshot_bytes_total        counter    serialized snapshot bytes pushed
+                                           to peers
+hvd_snapshot_failures_total     counter    async snapshot attempts that died
+                                           before their commit marker
+hvd_snapshot_stall_us           gauge      step-path stall of the last
+                                           snapshot enqueue, microseconds
+hvd_snapshot_gen                gauge      newest own generation committed
+                                           to the peer tier
+hvd_snapshot_reprotected_total  counter    shards re-pushed to restore
+                                           K-redundancy after a shrink
+hvd_restores_total              counter    state restores completed, by
+                                           ``source`` (peer/storage)
 ==============================  =========  ==================================
 """
 
@@ -259,6 +273,33 @@ RANKS_ADMITTED = registry.counter(
     "hvd_ranks_admitted_total",
     "Workers admitted into the elastic world at epoch boundaries "
     "(rejoins and spare hosts).")
+
+SNAPSHOTS_TOTAL = registry.counter(
+    "hvd_snapshots_total",
+    "Peer-tier snapshot generations committed by this rank "
+    "(elastic/peerstate.py).")
+SNAPSHOT_BYTES = registry.counter(
+    "hvd_snapshot_bytes_total",
+    "Serialized snapshot bytes this rank pushed to its replica peers.")
+SNAPSHOT_FAILURES = registry.counter(
+    "hvd_snapshot_failures_total",
+    "Async snapshot attempts that failed before writing their commit "
+    "marker (the generation stays unrestorable; storage tier covers).")
+SNAPSHOT_STALL_US = registry.gauge(
+    "hvd_snapshot_stall_us",
+    "Step-path stall of the last snapshot enqueue in microseconds — "
+    "the ONLY checkpoint cost the training step pays on the peer tier.")
+SNAPSHOT_GEN = registry.gauge(
+    "hvd_snapshot_gen",
+    "Newest generation (= step) this rank committed to the peer tier.")
+SNAPSHOT_REPROTECTED = registry.counter(
+    "hvd_snapshot_reprotected_total",
+    "Shards re-pushed to new peers to restore K-redundancy after a "
+    "world shrink orphaned their replicas.")
+RESTORES = registry.counter(
+    "hvd_restores_total",
+    "State restores completed, by source tier (peer/storage).",
+    ("source",))
 
 AUTOTUNE_PREDICTED_SPEEDUP = registry.gauge(
     "hvd_autotune_predicted_speedup",

@@ -72,6 +72,8 @@ KNOWN_KINDS = (
     "autotune.apply", "autotune.verify", "autotune.rollback",
     "compression.fallback",
     "checkpoint.save", "checkpoint.commit", "checkpoint.restore",
+    "snapshot.begin", "snapshot.commit", "snapshot.reprotect",
+    "restore.source", "spare.purged",
     "watchdog.alert", "watchdog.arm",
 )
 

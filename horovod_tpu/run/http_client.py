@@ -395,6 +395,42 @@ def delete_kv(addr: str, port: int, scope: str, key: str,
         pass
 
 
+def push_shard(addr: str, port: int, key: str, data: bytes,
+               secret: Optional[bytes] = None,
+               timeout: float = 30.0) -> None:
+    """Upload one snapshot shard to a peer worker's shard server
+    (``PUT /shard/<gen>.<src_rank>.<idx>``) — the replication write of
+    the peer state plane (elastic/peerstate.py).  Retries ride the
+    standard transient-failure policy; shard writes are idempotent
+    (same bytes, content-checksummed at restore)."""
+    put_kv(addr, port, "shard", key, data, secret=secret, retry=True,
+           timeout=timeout)
+
+
+def pull_shard(addr: str, port: int, key: str,
+               secret: Optional[bytes] = None,
+               timeout: float = 30.0) -> Optional[bytes]:
+    """Fetch one snapshot shard from a peer worker's shard server
+    (``GET /shard/<gen>.<src_rank>.<idx>``); None when the peer does not
+    hold it.  The caller verifies the manifest checksum and tries the
+    next replica on mismatch (elastic/peerstate.py)."""
+    return get_kv(addr, port, "shard", key, secret=secret, wait=False,
+                  timeout=timeout)
+
+
+def get_peerstate(addr: str, port: int, secret: Optional[bytes] = None,
+                  timeout: float = 10.0) -> dict:
+    """The peer-state-plane table from ``GET /peerstate``: registered
+    shard-server endpoints, per-generation manifest/commit coverage, and
+    the newest fully-committed generation restore would target
+    (docs/fault_tolerance.md#the-peer-state-plane)."""
+    import json
+
+    with _request("GET", addr, port, "/peerstate", secret=secret,
+                  timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
 def get_sanitizer(addr: str, port: int,
                   secret: Optional[bytes] = None) -> dict:
     """The collective-sanitizer fingerprint table from ``GET /sanitizer``:

@@ -146,6 +146,13 @@ HEALTH_SCOPE = "health"
 _HEALTH_PREFIX = f"/{HEALTH_SCOPE}/"
 ABORT_SCOPE = "abort"
 ABORT_KEY = "flag"
+# Spare-side liveness (elastic/membership.join_world ↔ driver.spares):
+# a worker the driver HOLDS as a spare renews an announce-keyed lease at
+# health/spare.<worker> between epoch waits.  The key is non-numeric on
+# purpose — the driver's rank-lease expiry loop skips it — but the same
+# STALE/DEAD verdict machinery applies, so a spare that dies while held
+# is purged before admission instead of stalling a stability timeout.
+SPARE_PREFIX = "spare."
 
 # elastic membership (elastic/membership.py, elastic/driver.py): the
 # committed epoch record lives at /membership/epoch; workers announce
@@ -167,6 +174,22 @@ DRAIN_PREFIX = "drain."
 DRAIN_ACK_PREFIX = "drain_ack."
 
 EPOCH_PATH = f"/{MEMBERSHIP_SCOPE}/{EPOCH_KEY}"
+
+# peer-replicated state plane (elastic/peerstate.py,
+# docs/fault_tolerance.md#the-peer-state-plane): each worker registers
+# its shard-server endpoint under peerstate/addr.<worker>; per-rank
+# snapshot manifests land at manifest.<gen>.<rank> with PR 5-style
+# commit markers at commit.<gen>.<rank> gating which generation restore
+# may target.  The scope is journaled, so the warm-standby/fencing
+# machinery is the consistency story.  Raw shard BYTES never touch this
+# server — they live on the peer workers' own shard servers under
+# shard/<gen>.<src_rank>.<idx>.  GET /peerstate renders the table.
+PEERSTATE_SCOPE = "peerstate"
+_PEERSTATE_PREFIX = f"/{PEERSTATE_SCOPE}/"
+PEER_ADDR_PREFIX = "addr."
+SNAPSHOT_MANIFEST_PREFIX = "manifest."
+SNAPSHOT_COMMIT_PREFIX = "commit."
+SHARD_SCOPE = "shard"
 
 # serving plane (horovod_tpu/serving/, docs/inference.md): tpurun
 # --serve attaches a ServingFrontend to this server — signed POST
@@ -279,6 +302,59 @@ def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
         "blocklist": _load(keys.get(BLOCKLIST_KEY)) or [],
         "drains": drains,
         "drain_acks": drain_acks,
+    }
+
+
+def build_peerstate_report(store: Dict[str, bytes]) -> Dict[str, object]:
+    """The peer-state-plane table from a store snapshot (GET
+    /peerstate): registered shard-server endpoints, per-generation
+    manifest/commit coverage, and the newest fully-committed generation
+    — the one :meth:`~horovod_tpu.elastic.peerstate.PeerSnapshotManager.
+    restore` would target.  A generation counts as committed only when
+    every rank of its recorded world wrote both manifest and marker."""
+
+    def _load(raw):
+        try:
+            return json.loads(raw)
+        except (ValueError, TypeError):
+            return "<undecodable>"
+
+    keys = {k[len(_PEERSTATE_PREFIX):]: v for k, v in store.items()
+            if k.startswith(_PEERSTATE_PREFIX)}
+    addrs = {k[len(PEER_ADDR_PREFIX):]: _load(v)
+             for k, v in keys.items() if k.startswith(PEER_ADDR_PREFIX)}
+    gens: Dict[int, Dict[str, object]] = {}
+    for k, v in keys.items():
+        for prefix, field in ((SNAPSHOT_MANIFEST_PREFIX, "manifests"),
+                              (SNAPSHOT_COMMIT_PREFIX, "commits")):
+            if not k.startswith(prefix):
+                continue
+            gen_s, _, rank_s = k[len(prefix):].partition(".")
+            if not (gen_s.isdigit() and rank_s.isdigit()):
+                continue
+            rec = gens.setdefault(int(gen_s),
+                                  {"manifests": {}, "commits": []})
+            if field == "manifests":
+                rec["manifests"][rank_s] = _load(v)
+            else:
+                rec["commits"].append(int(rank_s))
+    newest_committed = None
+    for gen, rec in sorted(gens.items(), reverse=True):
+        rec["commits"] = sorted(rec["commits"])
+        root = rec["manifests"].get("0")
+        world = (root or {}).get("world_size") if isinstance(root, dict) \
+            else None
+        world = int(world) if world else len(rec["manifests"])
+        rec["world_size"] = world
+        rec["committed"] = bool(world) and all(
+            str(r) in rec["manifests"] and r in rec["commits"]
+            for r in range(world))
+        if rec["committed"] and newest_committed is None:
+            newest_committed = gen
+    return {
+        "addrs": addrs,
+        "generations": {str(g): r for g, r in sorted(gens.items())},
+        "newest_committed": newest_committed,
     }
 
 
@@ -745,6 +821,11 @@ class KVStoreHandler(BaseHTTPRequestHandler):
         if path == "/membership":
             store = self.server.store.items()  # type: ignore
             self._reply(200, json.dumps(build_membership_report(store))
+                        .encode(), content_type="application/json")
+            return
+        if path == "/peerstate":
+            store = self.server.store.items()  # type: ignore
+            self._reply(200, json.dumps(build_peerstate_report(store))
                         .encode(), content_type="application/json")
             return
         if path == "/serving":

@@ -49,9 +49,13 @@ from .store import split_path
 log = get_logger(__name__)
 
 #: scopes whose traffic is high-churn and reconstructible after a
-#: failover (leases re-renew, snapshots re-push, fingerprints re-check)
+#: failover (leases re-renew, snapshots re-push, fingerprints re-check).
+#: ``shard`` is raw peer-snapshot bytes (elastic/peerstate.py): they
+#: live on the PEER workers' shard servers, are re-pushed by the next
+#: snapshot, and must never bloat a journal — only their manifests
+#: (the journaled ``peerstate`` scope) need to survive a failover.
 JOURNAL_EXCLUDED_SCOPES = frozenset(
-    {"metrics", "sanitizer", "profile", "health"})
+    {"metrics", "sanitizer", "profile", "health", "shard"})
 
 
 class Journal:

@@ -235,6 +235,16 @@ HVD_EVENTS = "HVD_EVENTS"                              # 0 disables the recorder
 HVD_EVENTS_RING_CAP = "HVD_EVENTS_RING_CAP"            # per-process ring capacity, events (default 1024)
 HVD_EVENTS_FLUSH_SECONDS = "HVD_EVENTS_FLUSH_SECONDS"  # worker-side flusher cadence (default HVD_METRICS_PUSH_SECONDS)
 HVD_EVENTS_SERVER_CAP = "HVD_EVENTS_SERVER_CAP"        # server-side retained event cap per source (default 4096)
+# peer-replicated state plane (elastic/peerstate.py,
+# docs/fault_tolerance.md#the-peer-state-plane): async snapshots sharded
+# to K peer hosts, restore-from-peers with storage-tier fallback
+HVD_SNAPSHOT = "HVD_SNAPSHOT"                          # 1 enables the peer checkpoint tier (default off)
+HVD_SNAPSHOT_SHARDS = "HVD_SNAPSHOT_SHARDS"            # shards one rank's snapshot splits into (default 4)
+HVD_SNAPSHOT_KEEP = "HVD_SNAPSHOT_KEEP"                # own committed generations retained before GC (default 2)
+HVD_SNAPSHOT_STORAGE_EVERY = "HVD_SNAPSHOT_STORAGE_EVERY"  # Nth save still hits the orbax storage tier (default 10)
+HVD_SNAPSHOT_TIMEOUT_SECONDS = "HVD_SNAPSHOT_TIMEOUT_SECONDS"  # per shard push/pull HTTP budget (default 30)
+HVD_PEER_REPLICAS = "HVD_PEER_REPLICAS"                # peer hosts holding each rank's shards, K (default 2)
+HVD_BENCH_RESTORE = "HVD_BENCH_RESTORE"                # 0 skips bench.py's peer-restore leg
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -295,6 +305,11 @@ DEFAULT_WATCH_ARM_COOLDOWN_SECONDS = 120.0         # min spacing between auto-ar
 DEFAULT_EVENTS_RING_CAP = 1024                     # observe/events.py per-process ring capacity
 DEFAULT_EVENTS_FLUSH_SECONDS = 5.0                 # worker-side event flusher cadence
 DEFAULT_EVENTS_SERVER_CAP = 4096                   # server-side retained events per source
+DEFAULT_SNAPSHOT_SHARDS = 4                        # elastic/peerstate.py shards per rank snapshot
+DEFAULT_SNAPSHOT_KEEP = 2                          # own committed generations kept before GC
+DEFAULT_SNAPSHOT_STORAGE_EVERY = 10                # storage-tier save demotion cadence
+DEFAULT_SNAPSHOT_TIMEOUT_SECONDS = 30.0            # per shard push/pull HTTP budget
+DEFAULT_PEER_REPLICAS = 2                          # peer hosts holding each rank's shards
 
 
 def get_int(name: str, default: int) -> int:

@@ -78,6 +78,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_compute_opt_leg", lambda: {})
     monkeypatch.setattr(bench, "_control_leg", lambda: {})
     monkeypatch.setattr(bench, "_watch_leg", lambda: {})
+    monkeypatch.setattr(bench, "_restore_leg", lambda: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()
@@ -506,3 +507,71 @@ def test_run_timeout_retries_then_skips(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["error"] == "tpu_unavailable"
     assert all("timeout" in a for a in out["attempts"])
+
+
+def test_restore_leg_merged_and_skippable(monkeypatch, capsys):
+    """The peer-state-plane leg (docs/fault_tolerance.md) lands
+    restore_ckpt_stall_us / restore_p99_ms / restore_steps_lost in the
+    JSON tail, degrades to nulls on a dead child, and
+    HVD_BENCH_RESTORE=0 skips it."""
+    bench = _load_bench()
+    payload = {"metric": "resnet50_synthetic_img_sec_per_chip",
+               "value": 2700.0, "unit": "images/sec/chip",
+               "vs_baseline": 26.07}
+
+    class FakeProc:
+        def __init__(self, line):
+            self.returncode = 0
+            self.stdout = "RESULT " + line + "\n"
+            self.stderr = ""
+
+    calls = []
+
+    def fake_run(cmd, *a, **k):
+        calls.append(cmd)
+        if "--child-restore" in cmd:
+            return FakeProc(json.dumps(
+                {"restore_ckpt_stall_us": 8.4, "restore_p99_ms": 312.0,
+                 "restore_p50_ms": 120.0, "restore_steps_lost": 4,
+                 "restore_snapshot_interval": 5,
+                 "restore_drained": True}))
+        return FakeProc(json.dumps(payload))
+
+    for leg in ("_autotune_delta", "_compression_delta"):
+        monkeypatch.setattr(bench, leg, lambda v: {})
+    for leg in ("_serving_leg", "_projection_leg", "_compute_opt_leg",
+                "_control_leg", "_watch_leg"):
+        monkeypatch.setattr(bench, leg, lambda: {})
+    monkeypatch.setattr(bench, "_probe", lambda: "ok")
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("HVD_BENCH_RESTORE", raising=False)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["restore_ckpt_stall_us"] == 8.4
+    assert out["restore_p99_ms"] == 312.0
+    assert out["restore_steps_lost"] == 4
+    assert any("--child-restore" in c for c in calls)
+
+    # a hung restore child degrades to nulls, never costs the number
+    def raise_for_leg(cmd, *a, **k):
+        if "--child-restore" in cmd:
+            raise bench.subprocess.TimeoutExpired(cmd="x", timeout=1)
+        return FakeProc(json.dumps(payload))
+
+    monkeypatch.setattr(bench.subprocess, "run", raise_for_leg)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 2700.0
+    assert out["restore_p99_ms"] is None
+    assert out["restore_ckpt_stall_us"] is None
+    assert "timeout" in out["restore_error"]
+
+    # HVD_BENCH_RESTORE=0: no child run, no tail fields
+    calls.clear()
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("HVD_BENCH_RESTORE", "0")
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "restore_p99_ms" not in out
+    assert not any("--child-restore" in c for c in calls)
