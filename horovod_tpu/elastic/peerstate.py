@@ -53,7 +53,8 @@ abort/epoch chain via the epoch record's embedded event ids.  Metrics:
 the ``hvd_snapshot_*`` family.  Knobs: ``HVD_SNAPSHOT`` /
 ``HVD_SNAPSHOT_SHARDS`` / ``HVD_SNAPSHOT_KEEP`` /
 ``HVD_SNAPSHOT_STORAGE_EVERY`` / ``HVD_SNAPSHOT_TIMEOUT_SECONDS`` /
-``HVD_PEER_REPLICAS`` (docs/fault_tolerance.md#the-peer-state-plane).
+``HVD_SNAPSHOT_COPY`` / ``HVD_PEER_REPLICAS``
+(docs/fault_tolerance.md#the-peer-state-plane).
 """
 
 from __future__ import annotations
@@ -104,6 +105,38 @@ def shard_payload(payload: bytes, nshards: int) -> List[bytes]:
         return [b""]
     size = max((len(payload) + nshards - 1) // nshards, 1)
     return [payload[i:i + size] for i in range(0, len(payload), size)]
+
+
+def _detach(state: Any, copy_arrays: bool) -> Any:
+    """Detach an enqueued snapshot from later caller mutation.
+
+    Containers (dict / list / tuple / namedtuple) are rebuilt, so an
+    in-place container update (``state["step"] = ...``) between the
+    enqueue and the background serialize cannot tear the parked
+    snapshot or advance it past its generation label.  Leaves are
+    shared by default: ``jax.Array`` leaves are immutable and host
+    leaves ride the JAX functional-update contract (replace, don't
+    mutate).  ``copy_arrays`` (``HVD_SNAPSHOT_COPY=1``) additionally
+    copies numpy ndarray leaves — a bounded host memcpy per enqueue —
+    for training loops that DO mutate arrays in place."""
+    if isinstance(state, dict):
+        return {k: _detach(v, copy_arrays) for k, v in state.items()}
+    if isinstance(state, (list, tuple)):
+        vals = [_detach(v, copy_arrays) for v in state]
+        if isinstance(state, list):
+            return vals
+        if hasattr(state, "_fields"):  # namedtuple
+            return type(state)(*vals)
+        return tuple(vals)
+    if copy_arrays:
+        try:
+            import numpy as np
+
+            if isinstance(state, np.ndarray):
+                return np.array(state, copy=True)
+        except Exception:  # noqa: BLE001 — a leaf that cannot be
+            pass           # copied is shared, same as the default
+    return state
 
 
 def choose_peers(me: str, addrs: Dict[str, dict], k: int,
@@ -207,6 +240,7 @@ class PeerSnapshotManager:
         self.timeout = env_util.get_float(
             env_util.HVD_SNAPSHOT_TIMEOUT_SECONDS,
             env_util.DEFAULT_SNAPSHOT_TIMEOUT_SECONDS)
+        self.copy_arrays = env_util.get_bool(env_util.HVD_SNAPSHOT_COPY)
         if addr is None or port is None:
             from .abort import _rendezvous_from_env
 
@@ -345,7 +379,10 @@ class PeerSnapshotManager:
     # -- the step-path call ------------------------------------------------
     def snapshot(self, state: Any, step: int) -> float:
         """Enqueue an async snapshot of ``state`` as generation
-        ``step``.  This is the ONLY thing the step path pays: a slot
+        ``step``.  This is the ONLY thing the step path pays: a
+        container rebuild (so later in-place dict/list updates cannot
+        reach the parked snapshot — see :func:`_detach`; numpy leaves
+        are also copied under ``HVD_SNAPSHOT_COPY=1``) plus a slot
         write + event set (µs — pinned under 1% of a 1 ms step in
         tier-1).  Latest-wins: a slow upload skips intermediate
         generations rather than queueing them."""
@@ -354,9 +391,13 @@ class PeerSnapshotManager:
             self._thread = threading.Thread(
                 target=self._drain_loop, daemon=True, name="hvd-snapshot")
             self._thread.start()
+        item = (_detach(state, self.copy_arrays), int(step))
         with self._slot_lock:
-            self._slot = (state, int(step))
-        self._idle.clear()
+            # _idle transitions pair with the slot under one lock, so
+            # the drain loop's idle re-check can never race a fresh
+            # enqueue into a stale "drained" verdict
+            self._slot = item
+            self._idle.clear()
         self._wake.set()
         stall = time.perf_counter() - t0
         self.last_stall_us = stall * 1e6
@@ -390,8 +431,9 @@ class PeerSnapshotManager:
                     _metric("SNAPSHOT_FAILURES")
                     log.warning("async snapshot of step %s failed: %s",
                                 step, self.last_failure)
-            if self._slot is None:
-                self._idle.set()
+            with self._slot_lock:
+                if self._slot is None:
+                    self._idle.set()
 
     # -- the snapshot body (also callable synchronously in tests) ----------
     def snapshot_sync(self, state: Any, step: int) -> dict:
@@ -563,7 +605,14 @@ class PeerSnapshotManager:
             by_rank = gens[gen]
             if 0 not in by_rank:
                 continue
-            world = int(by_rank[0].get("world_size") or len(by_rank))
+            # the world this gen must cover is the LARGEST any of its
+            # manifests recorded — rank 0's view alone can be stale
+            # across a concurrent grow (ranks >= its world_size
+            # committed the same gen with a larger world), and trusting
+            # it would deem the gen whole with those ranks unchecked
+            world = max((int(m.get("world_size") or 0)
+                         for m in by_rank.values()), default=0) \
+                or len(by_rank)
             if all(r in by_rank and by_rank[r].get("_committed")
                    for r in range(world)):
                 return gen
@@ -662,18 +711,28 @@ class PeerSnapshotManager:
         live = set(addrs)
         repushed = 0
         changed = False
+        short: List[str] = []
         for shard in manifest.get("shards", ()):
             holders = [p for p in shard.get("peers", ()) if p in live]
+            if holders != list(shard.get("peers", ())):
+                changed = True  # prune dead holders from the manifest
             lost = self.k - len(holders)
             if lost <= 0:
+                shard["peers"] = holders
                 continue
-            candidates = [p for p in choose_peers(self.worker, addrs, self.k + len(holders))
-                          if p not in holders]
             key = f"{gen}.{self.rank}.{shard['idx']}"
             data = local.get(key)
             if data is None:
+                shard["peers"] = holders
+                short.append(key)
                 continue
-            for peer in candidates[:lost]:
+            # candidate pool: the live world with surviving holders
+            # excluded UP FRONT — filtering choose_peers' ring prefix
+            # after the fact can return fewer than `lost` fresh peers
+            # when host labels shifted across the shrink
+            pool = {w: a for w, a in addrs.items()
+                    if w == self.worker or w not in holders}
+            for peer in choose_peers(self.worker, pool, lost):
                 rec = addrs.get(peer) or {}
                 try:
                     push_shard(rec.get("addr", "127.0.0.1"),
@@ -687,16 +746,29 @@ class PeerSnapshotManager:
                 repushed += 1
                 changed = True
             shard["peers"] = holders
+            if len(holders) < self.k:
+                short.append(key)
+        if short:
+            # partial reprotection is NOT silent: redundancy stays
+            # below K until more peers join (the next epoch hook
+            # retries) — the storage tier remains the durable backstop
+            log.warning("reprotect of gen %s left %d shard(s) under-"
+                        "replicated (< %d replicas): %s — not enough "
+                        "live peers outside the surviving holders",
+                        gen, len(short), self.k, ", ".join(short))
         if changed:
             put_kv(self.addr, self.port, PEERSTATE_SCOPE,
                    f"{SNAPSHOT_MANIFEST_PREFIX}{gen}.{self.rank}",
                    json.dumps({k: v for k, v in manifest.items()
                                if k != "_committed"}).encode(),
                    secret=self.secret, retry=True)
+        if changed or short:
             _metric("SNAPSHOT_REPROTECTED", n=repushed)
             _flight_event("snapshot.reprotect",
                           {"gen": gen, "rank": self.rank,
-                           "shards": repushed}, severity="warning")
+                           "shards": repushed,
+                           "under_replicated": len(short)},
+                          severity="warning")
         return repushed
 
     def on_epoch(self, rec: Optional[dict] = None) -> None:

@@ -146,20 +146,19 @@ class ElasticState:
         Which tier won is recorded as a ``restore.source`` flight event
         chained onto the abort/epoch incident.
 
-        Multi-process: the step choice is broadcast from rank 0 so every
-        rank restores the same checkpoint even when only root can list
-        the directory; the restore itself rides ``restore_checkpoint``'s
-        agreement round (root failures surface on every rank).  The peer
-        path needs neither: every rank resolves the same committed
-        generation from the same rendezvous KV and pulls its OWN shards."""
+        Multi-process, BOTH tiers are collective decisions.  The peer
+        path broadcasts rank 0's resolved generation so every rank
+        targets the same snapshot, then all-gathers per-rank success
+        before committing it — if ANY rank cannot restore that
+        generation, every rank falls back wholesale to the storage
+        tier (see :meth:`_restore_from_peers`).  The storage path
+        broadcasts the step choice from rank 0 so every rank restores
+        the same checkpoint even when only root can list the
+        directory; the restore itself rides ``restore_checkpoint``'s
+        agreement round (root failures surface on every rank)."""
         fallback_reason = None
         if self._peer is not None:
-            got = None
-            try:
-                got = self._peer.restore(self.state)
-            except Exception as e:  # noqa: BLE001 — peer restore must
-                # degrade to storage, never strand the relaunch
-                self._peer.last_failure = f"{type(e).__name__}: {e}"
+            got, fallback_reason = self._restore_from_peers()
             if got is not None:
                 self.state, self.step = got[0], int(got[1])
                 self._record_restore("peer", {"gen": self.step})
@@ -176,7 +175,6 @@ class ElasticState:
                 log.info("elastic resume: restored step %d from peers "
                          "(incarnation %d)", self.step, self.restart_count)
                 return self.state, self.step
-            fallback_reason = self._peer.last_failure or "peer tier empty"
             log.warning("elastic resume: peer tier unrestorable (%s); "
                         "falling back to storage", fallback_reason)
         step = latest_step(self.path)
@@ -208,6 +206,56 @@ class ElasticState:
         log.info("elastic resume: restored step %d from %s (incarnation %d)",
                  self.step, self.path, self.restart_count)
         return self.state, self.step
+
+    def _restore_from_peers(self) -> Tuple[Optional[Tuple[Any, int]],
+                                           Optional[str]]:
+        """Peer-tier restore with cross-rank agreement; returns
+        ``(result, fallback_reason)``.
+
+        Multi-process, the peer-vs-storage decision must be collective:
+        rank 0's resolved generation is broadcast so every rank targets
+        the SAME snapshot, and an agreement round (allgather of
+        per-rank success) gates the result — if ANY rank cannot restore
+        that generation (a transient manifest read, dead replicas, a
+        corrupt shard), EVERY rank discards its peer result and the
+        world falls back wholesale to the storage tier, whose step
+        choice rank 0 already broadcasts.  Without the agreement round,
+        one rank's private fallback to the storage checkpoint (step M)
+        while the others restore a newer peer generation (step N > M)
+        would silently diverge state/step across the world."""
+        multi = core.is_initialized() and core.process_size() > 1
+        gen = None
+        if multi:
+            from .. import eager
+
+            if core.process_rank() == 0:
+                try:
+                    gen = self._peer.resolve_committed()
+                except Exception as e:  # noqa: BLE001
+                    self._peer.last_failure = f"{type(e).__name__}: {e}"
+            gen = eager.broadcast_object(gen)
+            if gen is None:
+                return None, (self._peer.last_failure
+                              or "no fully-committed generation")
+        got = None
+        try:
+            got = self._peer.restore(self.state, gen=gen)
+        except Exception as e:  # noqa: BLE001 — peer restore must
+            # degrade to storage, never strand the relaunch
+            self._peer.last_failure = f"{type(e).__name__}: {e}"
+        if multi:
+            from .. import eager
+
+            oks = eager.allgather_object(got is not None)
+            if not all(oks):
+                bad = [r for r, ok in enumerate(oks) if not ok]
+                reason = (self._peer.last_failure if got is None
+                          else f"rank(s) {bad} could not restore peer "
+                               f"gen {gen}")
+                return None, reason or f"rank(s) {bad} failed peer restore"
+        if got is None:
+            return None, self._peer.last_failure or "peer tier empty"
+        return got, None
 
     def _record_restore(self, source: str, extra: dict) -> None:
         """Emit ``restore.source`` (flight recorder) + the

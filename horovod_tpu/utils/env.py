@@ -243,6 +243,7 @@ HVD_SNAPSHOT_SHARDS = "HVD_SNAPSHOT_SHARDS"            # shards one rank's snaps
 HVD_SNAPSHOT_KEEP = "HVD_SNAPSHOT_KEEP"                # own committed generations retained before GC (default 2)
 HVD_SNAPSHOT_STORAGE_EVERY = "HVD_SNAPSHOT_STORAGE_EVERY"  # Nth save still hits the orbax storage tier (default 10)
 HVD_SNAPSHOT_TIMEOUT_SECONDS = "HVD_SNAPSHOT_TIMEOUT_SECONDS"  # per shard push/pull HTTP budget (default 30)
+HVD_SNAPSHOT_COPY = "HVD_SNAPSHOT_COPY"                # 1 also copies numpy leaves at enqueue — for loops that mutate arrays in place (default off)
 HVD_PEER_REPLICAS = "HVD_PEER_REPLICAS"                # peer hosts holding each rank's shards, K (default 2)
 HVD_BENCH_RESTORE = "HVD_BENCH_RESTORE"                # 0 skips bench.py's peer-restore leg
 

@@ -223,6 +223,72 @@ def test_snapshot_latest_wins_skips_intermediate_generations(rdv,
         m1.stop()
 
 
+def test_snapshot_detaches_from_container_mutation(rdv, monkeypatch):
+    """The parked slot must not alias the caller's containers: a
+    training loop that mutates the state dict in place after
+    ``snapshot()`` returns cannot tear the serialized generation or
+    advance it past its label — restore returns the state AS OF the
+    enqueued step."""
+    server, addr, port = rdv
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "1")
+    m0 = _manager(server, "w0", 0)
+    m1 = _manager(server, "w1", 1)
+    try:
+        gate = threading.Event()
+        real = m0.snapshot_sync
+
+        def slow_sync(state, step):
+            gate.wait(10.0)
+            return real(state, step)
+
+        m0.snapshot_sync = slow_sync
+        state = {"step": 3, "inner": {"tag": "at-3"}, "history": [3]}
+        m0.snapshot(state, 3)
+        state["step"] = 4                   # the loop advances in place,
+        state["inner"]["tag"] = "at-4"      # racing the background
+        state["history"].append(4)          # serialize
+        gate.set()
+        assert m0.drain(10.0)
+        got, step = m0.restore()
+        assert step == 3
+        assert got == {"step": 3, "inner": {"tag": "at-3"}, "history": [3]}
+    finally:
+        m0.stop()
+        m1.stop()
+
+
+def test_snapshot_copy_knob_detaches_in_place_array_mutation(rdv,
+                                                             monkeypatch):
+    """HVD_SNAPSHOT_COPY=1: numpy leaves are copied at enqueue, so even
+    in-place array mutation (`params += 1`) between the enqueue and the
+    background pickle cannot reach the parked snapshot."""
+    server, addr, port = rdv
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "1")
+    monkeypatch.setenv("HVD_SNAPSHOT_COPY", "1")
+    m0 = _manager(server, "w0", 0)
+    m1 = _manager(server, "w1", 1)
+    try:
+        gate = threading.Event()
+        real = m0.snapshot_sync
+
+        def slow_sync(state, step):
+            gate.wait(10.0)
+            return real(state, step)
+
+        m0.snapshot_sync = slow_sync
+        params = np.zeros(16)
+        m0.snapshot({"params": params}, 2)
+        params += 1.0                       # in-place, non-functional
+        gate.set()
+        assert m0.drain(10.0)
+        got, step = m0.restore()
+        assert step == 2
+        np.testing.assert_array_equal(got["params"], np.zeros(16))
+    finally:
+        m0.stop()
+        m1.stop()
+
+
 # -- the step-path stall pin -------------------------------------------------
 def test_snapshot_enqueue_stall_under_one_percent_of_1ms_step(rdv,
                                                               monkeypatch):
@@ -319,6 +385,33 @@ def test_save_racing_abort_leaves_generation_uncommitted(rdv, monkeypatch):
         m1.stop()
 
 
+def test_resolve_committed_validates_against_max_world_size(rdv,
+                                                            monkeypatch):
+    """A stale rank-0 manifest world_size (written before a concurrent
+    grow) must not deem a generation fully committed while the grown
+    ranks — whose own manifests record the larger world — are
+    unchecked: the gen is whole only when the LARGEST recorded world
+    all committed."""
+    server, addr, port = rdv
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "1")
+    m0 = _manager(server, "w0", 0)
+    m1 = _manager(server, "w1", 1)
+    try:
+        server.put("peerstate", "manifest.5.0", json.dumps(
+            {"gen": 5, "step": 5, "rank": 0, "world_size": 1,
+             "shards": []}).encode())
+        server.put("peerstate", "commit.5.0", b"{}")
+        server.put("peerstate", "manifest.5.1", json.dumps(
+            {"gen": 5, "step": 5, "rank": 1, "world_size": 2,
+             "shards": []}).encode())
+        assert m0.resolve_committed() is None   # rank 1 not committed
+        server.put("peerstate", "commit.5.1", b"{}")
+        assert m0.resolve_committed() == 5      # now the full world is
+    finally:
+        m0.stop()
+        m1.stop()
+
+
 def test_gc_clears_commit_marker_first_then_shards_then_manifest(
         rdv, monkeypatch):
     """Cleared-before-overwrite on the peer tier: GC deletes the commit
@@ -387,6 +480,41 @@ def test_reprotect_repushes_orphaned_shards_after_shrink(rdv, monkeypatch):
             try:
                 m.stop()
             except Exception:  # noqa: BLE001 — one was stopped above
+                pass
+
+
+def test_reprotect_reports_partial_redundancy(rdv, monkeypatch):
+    """Fewer live candidates than lost replicas: reprotect prunes the
+    dead holder from the manifest and REPORTS the shortfall (warning +
+    flight event under_replicated count) instead of silently leaving
+    K-redundancy unrestored."""
+    server, addr, port = rdv
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "1")
+    events_mod.attach_server(server)
+    m0 = _manager(server, "w0", 0, k=2, nshards=1)
+    m1 = _manager(server, "w1", 1)
+    m2 = _manager(server, "w2", 2)
+    try:
+        man = m0.snapshot_sync({"s": 1}, 4)
+        assert set(man["shards"][0]["peers"]) == {"w1", "w2"}
+        m2.stop()
+        server.delete("peerstate", "addr.w2")
+        # only w1 survives: no fresh candidate exists for the lost
+        # replica (w0 is the source, w1 already holds one)
+        assert m0.reprotect() == 0
+        (ev,) = _events_of(addr, port, "snapshot.reprotect")
+        assert ev["payload"]["under_replicated"] == 1
+        assert ev["payload"]["shards"] == 0
+        man2 = m0._manifests()[4][0]
+        assert man2["shards"][0]["peers"] == ["w1"]  # dead holder pruned
+        got, step = m0.restore()                     # still restorable
+        assert step == 4 and got == {"s": 1}
+    finally:
+        m0.stop()
+        for m in (m1, m2):
+            try:
+                m.stop()
+            except Exception:  # noqa: BLE001 — m2 was stopped above
                 pass
 
 
@@ -541,6 +669,104 @@ def test_elastic_state_peer_empty_falls_back_fresh(rdv, monkeypatch,
         assert step == 0 and state == {"x": 1}
     finally:
         m1.stop()
+
+
+# -- resume(): the cross-rank agreement round ---------------------------------
+def test_resume_agreement_forces_storage_when_any_rank_fails(
+        rdv, monkeypatch, tmp_path):
+    """The peer-vs-storage decision is COLLECTIVE: this rank's peer
+    pull succeeds (gen 15), but a simulated peer votes failure in the
+    agreement round — every rank must fall back to the storage tier
+    (step 9) instead of silently diverging state/step across the
+    world."""
+    server, addr, port = rdv
+    _peer_env(monkeypatch, port, storage_every="100")
+    events_mod.attach_server(server)
+    from horovod_tpu import core as core_mod
+    from horovod_tpu import eager as eager_mod
+    m1 = _manager(server, "w1", 1, k=2)
+    m2 = _manager(server, "w2", 2, k=2)
+    try:
+        es = ElasticState(str(tmp_path / "ckpt"),
+                          {"params": np.zeros(8), "tag": "init"})
+        es.state = {"params": np.full(8, 9.0), "tag": "at-9"}
+        es.save(9)                       # save #0: storage + peer gen 9
+        es.state = {"params": np.full(8, 15.0), "tag": "at-15"}
+        es.save(15)                      # save #1: peer tier only
+        for m in (m1, m2):
+            m.snapshot_sync({"r": m.rank}, 9)
+            m.snapshot_sync({"r": m.rank}, 15)
+        assert peerstate.instance().drain(30.0)
+        assert peerstate.instance().resolve_committed() == 15
+        assert latest_step(str(tmp_path / "ckpt")) == 9
+
+        peerstate.reset()
+        monkeypatch.setattr(core_mod, "is_initialized", lambda: True)
+        monkeypatch.setattr(core_mod, "process_size", lambda: 3)
+        monkeypatch.setattr(core_mod, "process_rank", lambda: 0)
+        monkeypatch.setattr(eager_mod, "broadcast_object",
+                            lambda obj, *a, **k: obj)
+
+        def fake_allgather(obj, **k):
+            if isinstance(obj, bool):
+                return [obj, False, obj]     # rank 1 fails the vote
+            return [obj, "unreadable", obj]  # restore_checkpoint round:
+        monkeypatch.setattr(                 # ship root's tree whole
+            eager_mod, "allgather_object", fake_allgather)
+        es2 = ElasticState(str(tmp_path / "ckpt"),
+                           {"params": np.zeros(8), "tag": "init"})
+        state, step = es2.resume()
+        assert step == 9 and state["tag"] == "at-9"   # NOT peer gen 15
+        (ev,) = _events_of(addr, port, "restore.source")
+        assert ev["payload"]["source"] == "storage"
+        assert "could not restore peer gen 15" in ev["payload"]["reason"]
+    finally:
+        m1.stop()
+        m2.stop()
+
+
+def test_resume_agreement_nonroot_restores_broadcast_generation(
+        rdv, monkeypatch, tmp_path):
+    """Rank != 0 never resolves the generation itself: it restores the
+    gen rank 0 broadcast, so a commit racing the relaunch cannot split
+    the world across two generations."""
+    server, addr, port = rdv
+    _peer_env(monkeypatch, port, storage_every="100")
+    events_mod.attach_server(server)
+    from horovod_tpu import core as core_mod
+    from horovod_tpu import eager as eager_mod
+    m0 = _manager(server, "w0", 0, k=2)
+    m1 = _manager(server, "w1", 1, k=2)
+    m2 = _manager(server, "w2", 2, k=2)
+    try:
+        for m in (m0, m1, m2):
+            m.snapshot_sync({"r": m.rank, "gen": 15}, 15)
+            m.snapshot_sync({"r": m.rank, "gen": 20}, 20)
+        assert m0.resolve_committed() == 20
+
+        # rank 1 relaunches while rank 0's broadcast pins gen 15 (its
+        # manifest read predated the gen-20 commit)
+        monkeypatch.setenv("HVD_PROCESS_ID", "1")
+        monkeypatch.setenv("HVD_ELASTIC_WORKER_ID", "w1")
+        monkeypatch.setattr(core_mod, "is_initialized", lambda: True)
+        monkeypatch.setattr(core_mod, "process_size", lambda: 3)
+        monkeypatch.setattr(core_mod, "process_rank", lambda: 1)
+        monkeypatch.setattr(
+            eager_mod, "broadcast_object",
+            lambda obj, *a, **k: 15 if obj is None else obj)
+        monkeypatch.setattr(eager_mod, "allgather_object",
+                            lambda obj, **k: [True, obj, True])
+        es = ElasticState(str(tmp_path / "ckpt"),
+                          {"r": 0, "gen": 0})
+        state, step = es.resume()
+        assert step == 15                   # the broadcast gen, not 20
+        assert state == {"r": 1, "gen": 15}  # rank 1's own shards
+        (ev,) = _events_of(addr, port, "restore.source")
+        assert ev["payload"]["source"] == "peer"
+    finally:
+        m0.stop()
+        m1.stop()
+        m2.stop()
 
 
 # -- spare-side liveness (satellite) -----------------------------------------
