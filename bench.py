@@ -68,6 +68,7 @@ COMPUTE_OPT_TIMEOUT_S = 240  # compute-path A/B: two MLP drives + a profiler win
 CONTROL_TIMEOUT_S = 120    # control-plane churn: ~5k loopback HTTP requests
 WATCH_TIMEOUT_S = 90       # watchdog leg: pure host-side detector replay
 RESTORE_TIMEOUT_S = 120    # peer-restore leg: snapshot/restore fixture
+CHAOS_TIMEOUT_S = 240      # chaos leg: 8-scenario in-process campaign
 ATTEMPTS = 3
 RETRY_DELAY_S = 75         # 3 probes spread over ~5 minutes
 
@@ -467,6 +468,72 @@ def _restore_leg() -> dict:
             "restore_error": reason}
 
 
+def _measure_chaos() -> None:
+    """Child-process entry for the chaos-campaign leg: a fixed-seed
+    8-scenario campaign (elastic/chaos.py) against the in-process
+    elastic control plane — crashes, hangs, partitions, preemptions,
+    a primary kill, and a relay kill, all invariant-checked.  Tracked
+    numbers: MTTR p50/p99 across every recovery (trigger evidence to
+    the last survivor resume), the worst steps-lost of any resume, and
+    the violation count (which must be 0 for the leg to report)."""
+    import json as _json
+
+    import logging as _logging
+
+    _logging.disable(_logging.ERROR)   # scenario churn is all expected
+    from horovod_tpu.elastic import chaos
+
+    scenarios = chaos.generate_campaign(1234, count=8)
+    campaign = chaos.run_campaign(scenarios, seed=1234)
+    mttrs = sorted(r["mttr_ms"] for res in campaign.results
+                   for r in res.recoveries if r["mttr_ms"] is not None)
+    losses = [lost for res in campaign.results
+              for r in res.recoveries for lost in r["steps_lost"]]
+    n_viol = sum(len(res.violations) for res in campaign.results)
+    ok = campaign.ok and bool(mttrs)
+    p99_i = min(int(len(mttrs) * 0.99), len(mttrs) - 1) if mttrs else 0
+    print("RESULT " + _json.dumps({
+        "chaos_mttr_p50_ms": round(mttrs[len(mttrs) // 2], 1)
+            if ok else None,
+        "chaos_mttr_p99_ms": round(mttrs[p99_i], 1) if ok else None,
+        "chaos_steps_lost_max": max(losses) if ok and losses else None,
+        "chaos_scenarios": len(campaign.results),
+        "chaos_recoveries": len(mttrs),
+        "chaos_violations": n_viol,
+    }))
+
+
+def _chaos_leg() -> dict:
+    """The chaos-campaign tail fields, from a separately-timed child so
+    a wedged scenario can never cost the main number
+    (HVD_BENCH_CHAOS=0 skips).  Null-on-failure, same contract as
+    every other leg."""
+    try:
+        from horovod_tpu.utils import env as env_util
+
+        enabled = env_util.get_bool(env_util.HVD_BENCH_CHAOS, True)
+    except Exception:  # noqa: BLE001
+        enabled = True
+    if not enabled:
+        return {}
+    reason = None
+    try:
+        payload, reason = _run_child("--child-chaos", CHAOS_TIMEOUT_S)
+        if payload is not None:
+            return {
+                "chaos_mttr_p50_ms": payload.get("chaos_mttr_p50_ms"),
+                "chaos_mttr_p99_ms": payload.get("chaos_mttr_p99_ms"),
+                "chaos_steps_lost_max":
+                    payload.get("chaos_steps_lost_max"),
+                "chaos_scenarios": payload.get("chaos_scenarios"),
+                "chaos_violations": payload.get("chaos_violations"),
+            }
+    except Exception as e:  # noqa: BLE001 — the leg can never cost the main number
+        reason = f"{type(e).__name__}: {e}"
+    return {"chaos_mttr_p50_ms": None, "chaos_mttr_p99_ms": None,
+            "chaos_steps_lost_max": None, "chaos_error": reason}
+
+
 def _control_leg() -> dict:
     """The control-plane tail fields, from a separately-timed child so
     a hung or failed churn run can never cost the main number
@@ -728,6 +795,10 @@ def main() -> None:
             # snapshot enqueue stall µs/step, restore-from-peers p99,
             # and steps lost to a worst-point failure
             out.update(_restore_leg())
+            # chaos-campaign tail (HVD_BENCH_CHAOS=0 skips): MTTR
+            # p50/p99 and worst steps-lost across a fixed-seed
+            # composed-fault campaign, invariant-checked
+            out.update(_chaos_leg())
             print(json.dumps(out))
             return
         errors.append(f"run {attempt + 1}: {reason}")
@@ -763,6 +834,8 @@ if __name__ == "__main__":
         _measure_watch()
     elif "--child-restore" in sys.argv:
         _measure_restore()
+    elif "--child-chaos" in sys.argv:
+        _measure_chaos()
     elif "--child" in sys.argv:
         _measure()
     else:

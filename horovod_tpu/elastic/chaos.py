@@ -1,0 +1,1106 @@
+"""Chaos campaign engine: scripted multi-fault scenarios, certified.
+
+The fault seams grown across PRs 9-19 — `HVD_FAULT_SPEC` process
+faults, lease-expiry detection, drain handshakes, relay failover, the
+journaled warm-standby primary, the peer state plane — were each pinned
+by unit tests that exercise ONE failure at a time.  This module turns
+them into **scenarios**: timed, composed fault schedules executed
+against a real elastic control plane (a live :class:`RendezvousServer`
+plus a live :class:`ElasticDriver`, in process), with every recovery
+promise machine-checked by the invariant monitors
+(observe/invariants.py) over the flight-recorder event stream.
+
+Three layers:
+
+**Scenario DSL** — ``;``-separated timed entries, each
+``:``-separated ``key=value`` fields (the `HVD_FAULT_SPEC` grammar,
+plus a clock and control-plane targets)::
+
+    at=250ms:rank=1:kind=crash; at=600ms:rank=2:kind=preempt=2s;
+    at=900ms:target=primary:kind=kill=250ms
+
+``target=worker`` (default) faults one worker: ``crash`` (process
+exit, reaped like a child exit), ``hang`` (silent stop — lease expiry
+must find it), ``partition`` (alive but unreachable), ``slow=<dur>``
+(one step stretched), ``preempt[=<grace>]`` (a preemption notice the
+driver must turn into a planned drain + snapshot, not a crash), and
+``skew`` (test-only: corrupts the worker's restore bookkeeping so its
+next lossy resume over-reports ``steps_lost`` — the deliberately
+catchable violation the shrinker demos on).  ``target=primary:
+kind=kill[=<outage>]`` kills the rendezvous primary and promotes a
+journal-replay standby after the outage; ``target=relay:kind=kill``
+kills the metrics relay (workers must fall back to the direct path
+transparently).
+
+**Campaign runner** — :func:`run_scenario` stands up the world,
+injects the schedule from a side thread (so a fault can land while the
+driver is blocked in a drain handshake), records outcomes, and hands
+the evidence bundle to :func:`~..observe.invariants.check_all`.
+:func:`generate_campaign` derives N scenarios from one integer seed
+(``random.Random(seed)``, millisecond-rounded offsets) — the same seed
+always renders the identical schedule, so every red campaign is
+replayable.
+
+**Shrinker** — :func:`ddmin` delta-debugs a failing scenario down to
+the minimal fault subset that still trips an invariant; :func:`shrink`
+wraps it with scenario re-execution and returns the minimal scenario
+plus its violation report.
+
+Modelling notes (why the runner is trustworthy evidence): workers are
+threads speaking the real wire protocol — health leases, abort-flag
+polling with the epoch filter, ready acks, the drain-request/ack
+handshake — against the real server and driver, in lockstep (a soft
+barrier over the current epoch roster, so a dead peer stalls survivors
+exactly like a collective would until the abort propagates).  Aborts
+are observed from a background tick independent of step latency,
+mirroring the real heartbeat thread.  The snapshot plane commits every
+``snapshot_every`` steps and pins one ``(gen, step)`` restore source
+per epoch, so the steps-lost and source-agreement invariants check the
+same arithmetic the peer state plane promises.  During a primary
+outage the driver is not ticked and driver writes are assumed
+retried — keep ``kill`` outages shorter than the drain budget (the
+generator does).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..observe import events as events_mod
+from ..observe import invariants as invariants_mod
+from ..run.http_server import (
+    ABORT_KEY,
+    ABORT_SCOPE,
+    DRAIN_ACK_PREFIX,
+    DRAIN_PREFIX,
+    EPOCH_KEY,
+    EVENTS_SCOPE,
+    HEALTH_SCOPE,
+    MEMBERSHIP_SCOPE,
+    PREEMPT_PREFIX,
+    READY_PREFIX,
+    RendezvousServer,
+)
+from ..utils import env as env_util
+from ..utils.logging import get_logger
+from .driver import ElasticDriver
+from .faults import FAULT_EXIT_CODE, parse_duration
+
+log = get_logger(__name__)
+
+
+class ChaosSpecError(ValueError):
+    """Malformed scenario text (mirrors faults.FaultSpecError)."""
+
+
+#: worker-targeted fault kinds (rank required)
+WORKER_KINDS = ("crash", "hang", "partition", "slow", "preempt", "skew")
+#: control-plane targets and their only kind
+CONTROL_TARGETS = ("primary", "relay")
+#: the reason suffix remove() appends on a completed drain handshake —
+#: workers classify an epoch change as lossless by it
+_DRAINED_MARK = "drained: in-flight work completed"
+
+
+def _render_duration(seconds: float) -> str:
+    ms = int(round(seconds * 1000))
+    return f"{ms}ms"
+
+
+@dataclass(frozen=True)
+class ChaosEntry:
+    """One timed fault: WHEN (``at``, seconds into the scenario), WHAT
+    (``kind`` + optional ``duration`` argument), WHERE (``target`` and,
+    for worker faults, the initial ``rank``)."""
+
+    at: float
+    kind: str
+    target: str = "worker"
+    rank: Optional[int] = None
+    duration: float = 0.0
+
+    def render(self) -> str:
+        parts = [f"at={_render_duration(self.at)}"]
+        if self.target != "worker":
+            parts.append(f"target={self.target}")
+        if self.rank is not None:
+            parts.append(f"rank={self.rank}")
+        kind = self.kind
+        if self.duration:
+            kind = f"{kind}={_render_duration(self.duration)}"
+        parts.append(f"kind={kind}")
+        return ":".join(parts)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A named, ordered fault schedule."""
+
+    name: str
+    entries: Tuple[ChaosEntry, ...]
+
+    def render(self) -> str:
+        """Canonical text — byte-identical across runs of the same
+        seed (the determinism contract tests pin)."""
+        ordered = sorted(self.entries,
+                         key=lambda e: (e.at, e.target, e.kind,
+                                        -1 if e.rank is None else e.rank))
+        return "; ".join(e.render() for e in ordered)
+
+
+def _parse_entry(text: str) -> ChaosEntry:
+    at: Optional[float] = None
+    kind: Optional[str] = None
+    target = "worker"
+    rank: Optional[int] = None
+    duration = 0.0
+    for part in text.strip().split(":"):
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key = key.strip()
+        if not sep:
+            raise ChaosSpecError(f"bad field {part!r} in {text!r} "
+                                 "(want key=value)")
+        if key == "at":
+            at = parse_duration(value)
+        elif key == "target":
+            target = value.strip()
+        elif key == "rank":
+            try:
+                rank = int(value)
+            except ValueError:
+                raise ChaosSpecError(f"bad rank {value!r} in {text!r}")
+        elif key == "kind":
+            kind, _, arg = value.partition("=")
+            kind = kind.strip()
+            if arg:
+                duration = parse_duration(arg)
+        else:
+            raise ChaosSpecError(f"unknown field {key!r} in {text!r}")
+    if at is None:
+        raise ChaosSpecError(f"entry {text!r} has no at=<time>")
+    if kind is None:
+        raise ChaosSpecError(f"entry {text!r} has no kind=")
+    if target == "worker":
+        if kind not in WORKER_KINDS:
+            raise ChaosSpecError(
+                f"unknown worker fault kind {kind!r} in {text!r} "
+                f"(want one of {', '.join(WORKER_KINDS)})")
+        if rank is None:
+            raise ChaosSpecError(f"worker fault {text!r} needs rank=")
+        if kind == "slow" and duration <= 0:
+            raise ChaosSpecError(f"slow fault {text!r} needs a "
+                                 "duration (kind=slow=150ms)")
+    elif target in CONTROL_TARGETS:
+        if kind != "kill":
+            raise ChaosSpecError(
+                f"target={target} supports only kind=kill, got {kind!r}")
+        if rank is not None:
+            raise ChaosSpecError(
+                f"target={target} entry {text!r} must not set rank=")
+    else:
+        raise ChaosSpecError(f"unknown target {target!r} in {text!r}")
+    return ChaosEntry(at=at, kind=kind, target=target, rank=rank,
+                      duration=duration)
+
+
+def parse_scenario(text: str, name: str = "scenario") -> Scenario:
+    """Parse the DSL text into a :class:`Scenario`; raises
+    :class:`ChaosSpecError` with the offending entry on any error."""
+    entries = []
+    for chunk in text.split(";"):
+        if chunk.strip():
+            entries.append(_parse_entry(chunk))
+    if not entries:
+        raise ChaosSpecError("empty scenario")
+    return Scenario(name=name, entries=tuple(
+        sorted(entries, key=lambda e: e.at)))
+
+
+# ---------------------------------------------------------------------------
+# seeded campaign generation
+# ---------------------------------------------------------------------------
+
+def generate_campaign(seed: int, count: int = 8, world_size: int = 3,
+                      min_np: int = 1) -> List[Scenario]:
+    """Derive ``count`` scenarios from one integer seed.  Every draw
+    comes from one ``random.Random(seed)`` in a fixed order and every
+    offset is millisecond-rounded, so the same seed always renders the
+    identical campaign (replay contract).  Coverage guarantees: every
+    scenario composes >= 2 fault kinds, the campaign includes a
+    ``preempt`` and a primary kill, and destructive faults never
+    outnumber ``world_size - min_np`` in one scenario."""
+    if world_size - min_np < 1:
+        raise ChaosSpecError("campaign needs world_size - min_np >= 1 "
+                             "(destructive faults must leave a quorum)")
+    rng = random.Random(int(seed))
+    destructive = ("crash", "hang", "partition", "preempt")
+    scenarios: List[Scenario] = []
+    for i in range(count):
+        kinds: List[str] = []
+        budget = world_size - min_np
+        # rotate the special coverage through the campaign so every
+        # 8-scenario window exercises preempt + both control-plane kills
+        if i % 8 == 0:
+            kinds.append("preempt")
+            budget -= 1
+        elif i % 8 == 1:
+            kinds.append("primary-kill")
+        elif i % 8 == 2:
+            kinds.append("relay-kill")
+        want = 2 + (1 if rng.random() < 0.4 else 0)
+        pool = list(destructive) + ["slow"]
+        guard = 0
+        while len(kinds) < want and guard < 32:
+            guard += 1
+            k = pool[rng.randrange(len(pool))]
+            if k in destructive and budget <= 0:
+                k = "slow"
+            if k in kinds and k != "slow":
+                continue
+            if k in destructive:
+                budget -= 1
+            kinds.append(k)
+        if len(set(kinds)) < 2:  # e.g. slow+slow — force a composition
+            kinds[-1] = "crash" if "crash" not in kinds else "partition"
+        # distinct initial ranks for every worker fault
+        avail = list(range(world_size))
+        rng.shuffle(avail)
+        t = round(0.15 + 0.2 * rng.random(), 3)
+        entries: List[ChaosEntry] = []
+        # primary kill goes first with a settle gap after the outage so
+        # abort propagation is never measured across a dead primary
+        ordered = sorted(kinds, key=lambda k: k != "primary-kill")
+        for k in ordered:
+            if k == "primary-kill":
+                entries.append(ChaosEntry(at=t, kind="kill",
+                                          target="primary", duration=0.25))
+                t = round(t + 0.25 + 0.8, 3)
+            elif k == "relay-kill":
+                entries.append(ChaosEntry(at=t, kind="kill",
+                                          target="relay"))
+                t = round(t + 0.15, 3)
+            elif k == "slow":
+                entries.append(ChaosEntry(
+                    at=t, kind="slow", rank=avail.pop(),
+                    duration=round(0.05 + 0.1 * rng.random(), 3)))
+                t = round(t + 0.1, 3)
+            else:
+                grace = 1.0 if (k == "preempt"
+                                and rng.random() < 0.5) else 0.0
+                entries.append(ChaosEntry(at=t, kind=k, rank=avail.pop(),
+                                          duration=grace))
+                t = round(t + 0.45 + round(0.3 * rng.random(), 3), 3)
+        scenarios.append(Scenario(name=f"s{seed}-{i:02d}",
+                                  entries=tuple(entries)))
+    return scenarios
+
+
+# ---------------------------------------------------------------------------
+# the in-process world
+# ---------------------------------------------------------------------------
+
+class _SnapshotPlane:
+    """The runner's stand-in for the peer state plane: one committed
+    ``(gen, step)`` the fleet advances every ``snapshot_every`` steps,
+    plus one pinned restore source per epoch (the collective-agreement
+    rule from PR 19: first restorer pins, the rest reuse)."""
+
+    def __init__(self, every: int):
+        self.every = max(int(every), 1)
+        self.gen = 0
+        self.step = 0
+        self._pins: Dict[int, Tuple[int, int]] = {}
+        self._lock = threading.Lock()
+
+    def commit(self, step: int, rank: Optional[int] = None,
+               forced: bool = False) -> bool:
+        with self._lock:
+            if step > self.step or (forced and step >= self.step):
+                self.gen += 1
+                self.step = step
+                events_mod.record_event(
+                    "snapshot.commit",
+                    payload={"gen": self.gen, "step": step,
+                             "forced": forced},
+                    rank=rank)
+                return True
+            return False
+
+    def pin_restore(self, epoch: int) -> Tuple[int, int]:
+        with self._lock:
+            if epoch not in self._pins:
+                self._pins[epoch] = (self.gen, self.step)
+            return self._pins[epoch]
+
+
+class _World:
+    """Shared scenario state: the (swappable) primary server, the step
+    counters the lockstep barrier reads, and the global stop flag."""
+
+    def __init__(self, worker_ids: Sequence[str], *, hb_interval: float,
+                 step_seconds: float, snapshot_every: int,
+                 journal_path: Optional[str]):
+        self.worker_ids = list(worker_ids)
+        self.hb_interval = hb_interval
+        self.step_seconds = step_seconds
+        self.snapshot_every = snapshot_every
+        self.journal_path = journal_path
+        self.plane = _SnapshotPlane(snapshot_every)
+        self.steps: Dict[str, int] = {w: 0 for w in self.worker_ids}
+        self.stop = False
+        self.relay_dead = False
+        self.primary: Optional[RendezvousServer] = None
+        self._plock = threading.Lock()
+
+    # tolerant KV access: a dead primary reads as unreachable, not as a
+    # crash of the caller (workers retry on their next tick)
+    def kv_put(self, scope: str, key: str, obj: dict) -> bool:
+        with self._plock:
+            server = self.primary
+        if server is None:
+            return False
+        try:
+            server.put(scope, key, json.dumps(obj).encode())
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def kv_get_json(self, scope: str, key: str) -> Optional[dict]:
+        with self._plock:
+            server = self.primary
+        if server is None:
+            return None
+        try:
+            raw = server.get(scope, key)
+            return json.loads(raw) if raw is not None else None
+        except Exception:  # noqa: BLE001
+            return None
+
+
+class _ChaosWorker(threading.Thread):
+    """One roster member as a thread speaking the real wire protocol:
+    health leases, abort-flag polling (epoch filter + event-id dedupe),
+    ready acks, the drain handshake, lockstep stepping."""
+
+    def __init__(self, world: _World, wid: str):
+        super().__init__(daemon=True, name=f"chaos-worker-{wid}")
+        self.world = world
+        self.wid = wid
+        self.rank: int = -1
+        self.epoch: int = -1
+        self.members: List[str] = []
+        self.step = 0
+        self.status = "running"
+        # injection surface (written by the injector thread)
+        self.fault: Optional[str] = None       # crash | hang | partition
+        self.slow_pending = 0.0
+        self.skewed = False
+        self.preempt_expected = False
+        self.draining = False
+        self._relay_fallback = False
+        self._last_hb = 0.0
+        self._hb_count = 0
+        self._seen_abort: set = set()
+        self._pending_abort: Optional[dict] = None
+
+    # -- wire protocol ----------------------------------------------------
+    def _put(self, scope: str, key: str, obj: dict) -> bool:
+        w = self.world
+        if w.relay_dead and not self._relay_fallback:
+            # the push in flight with the relay is lost exactly once;
+            # the worker falls back to the direct path for good
+            # (elastic/relay.py mark_relay_failed semantics)
+            self._relay_fallback = True
+            return False
+        return w.kv_put(scope, key, obj)
+
+    def _tick_background(self) -> None:
+        """Lease renewal + abort observation — runs from every sleep
+        chunk, independent of step latency, like the real heartbeat
+        thread (a slow step must not delay abort observation)."""
+        w = self.world
+        now = time.monotonic()
+        if now - self._last_hb >= w.hb_interval and self.rank >= 0:
+            ok = self._put(HEALTH_SCOPE, str(self.rank),
+                           {"rank": self.rank, "count": self._hb_count,
+                            "interval": w.hb_interval, "pid": os.getpid()})
+            if ok:
+                self._last_hb = now
+                self._hb_count += 1
+        flag = w.kv_get_json(ABORT_SCOPE, ABORT_KEY)
+        if flag:
+            eid = flag.get("event_id") or f"t{flag.get('time')}"
+            flag_epoch = flag.get("epoch")
+            if eid not in self._seen_abort and (
+                    flag_epoch is None or flag_epoch >= self.epoch):
+                self._seen_abort.add(eid)
+                events_mod.record_event(
+                    "abort.observe", severity="warning",
+                    payload={"epoch": flag_epoch, "worker": self.wid,
+                             "reason": flag.get("reason")},
+                    cause_id=flag.get("event_id"),
+                    correlation_id=flag.get("correlation_id"),
+                    rank=self.rank)
+                self._pending_abort = flag
+
+    def _observant_sleep(self, duration: float) -> None:
+        end = time.monotonic() + duration
+        while True:
+            self._tick_background()
+            rem = end - time.monotonic()
+            if rem <= 0 or self.world.stop or self.fault is not None:
+                return
+            time.sleep(min(0.02, rem))
+
+    def _ack_ready(self) -> None:
+        self._put(MEMBERSHIP_SCOPE,
+                  f"{READY_PREFIX}{self.epoch}.{self.wid}",
+                  {"worker": self.wid, "time": time.time()})
+        self._last_hb = 0.0  # re-establish the lease the commit cleared
+
+    def _wait_epoch(self, after: int,
+                    timeout: float = 3.0) -> Optional[dict]:
+        w = self.world
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not w.stop:
+            rec = w.kv_get_json(MEMBERSHIP_SCOPE, EPOCH_KEY)
+            if rec is not None and int(rec.get("epoch", -1)) > after:
+                return rec
+            time.sleep(0.008)
+        return None
+
+    def _check_drain(self) -> None:
+        w = self.world
+        req = w.kv_get_json(MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}{self.wid}")
+        if req is None:
+            return
+        self.draining = True
+        # the planned departure snapshot: nothing this worker computed
+        # is lost (the preempt -> 0 steps promise)
+        w.plane.commit(self.step, rank=self.rank, forced=True)
+        self._put(MEMBERSHIP_SCOPE, f"{DRAIN_ACK_PREFIX}{self.wid}",
+                  {"worker": self.wid, "epoch": req.get("epoch"),
+                   "step": self.step, "time": time.time()})
+
+    def _rebuild(self, flag: dict) -> bool:
+        """React to an observed abort: wait for the next epoch, roll
+        back to the pinned snapshot if the change was lossy, resume (or
+        exit, if this worker is no longer in the world)."""
+        w = self.world
+        if flag.get("epoch") is None:
+            self.status = "aborted"  # job-level give-up, no next epoch
+            return False
+        rec = self._wait_epoch(self.epoch)
+        if rec is None:
+            if not w.stop:
+                self.status = "stuck"
+                return False
+            return True
+        if self.wid not in rec.get("world", []):
+            self.status = "removed"
+            return False
+        removed = rec.get("removed") or []
+        reason = rec.get("reason") or ""
+        lossy = bool(removed) and _DRAINED_MARK not in reason
+        self.epoch = int(rec["epoch"])
+        self.members = list(rec["world"])
+        self.rank = self.members.index(self.wid)
+        lost = 0
+        if lossy:
+            gen, rstep = w.plane.pin_restore(self.epoch)
+            lost = max(0, self.step - rstep)
+            if self.step > rstep:
+                self.step = rstep
+                w.steps[self.wid] = self.step
+            events_mod.record_event(
+                "restore.source",
+                payload={"epoch": self.epoch, "gen": gen, "step": rstep,
+                         "worker": self.wid, "source": "peer"},
+                cause_id=rec.get("event_id"),
+                correlation_id=rec.get("correlation_id"), rank=self.rank)
+            if self.skewed:
+                # the injected bookkeeping corruption (kind=skew): the
+                # reported loss no longer matches the snapshot cadence
+                lost += w.snapshot_every * 3
+        events_mod.record_event(
+            "restart.resume",
+            payload={"epoch": self.epoch, "steps_lost": lost,
+                     "worker": self.wid},
+            cause_id=rec.get("event_id"),
+            correlation_id=rec.get("correlation_id"), rank=self.rank)
+        self._ack_ready()
+        return True
+
+    def _can_step(self) -> bool:
+        w = self.world
+        return all(w.steps.get(m, 0) >= self.step
+                   for m in self.members if m != self.wid)
+
+    # -- the life of a worker ---------------------------------------------
+    def run(self) -> None:  # noqa: D102
+        try:
+            self._run()
+        except Exception:  # noqa: BLE001
+            log.exception("chaos worker %s died unexpectedly", self.wid)
+            self.status = "error"
+
+    def _run(self) -> None:
+        w = self.world
+        rec = self._wait_epoch(-1)
+        if rec is None:
+            self.status = "stuck"
+            return
+        self.epoch = int(rec["epoch"])
+        self.members = list(rec.get("world", []))
+        if self.wid not in self.members:
+            self.status = "removed"
+            return
+        self.rank = self.members.index(self.wid)
+        self._ack_ready()
+        while True:
+            if w.stop:
+                if self.status == "running":
+                    self.status = "finished"
+                return
+            if self.fault in ("crash", "hang"):
+                # the thread just stops: a crash is reaped by the
+                # runner's child-exit emulation, a hang only by the
+                # lease; either way no more heartbeats from here
+                self.status = "crashed" if self.fault == "crash" \
+                    else "hung"
+                return
+            if self.fault == "partition":
+                # alive but unreachable: no comm, no steps, no exit
+                self.status = "partitioned"
+                time.sleep(0.01)
+                continue
+            self._tick_background()
+            if self._pending_abort is not None and not self.draining:
+                flag, self._pending_abort = self._pending_abort, None
+                if not self._rebuild(flag):
+                    return
+                continue
+            if self.draining:
+                rec = w.kv_get_json(MEMBERSHIP_SCOPE, EPOCH_KEY)
+                if rec is not None \
+                        and int(rec.get("epoch", -1)) > self.epoch \
+                        and self.wid not in rec.get("world", []):
+                    self.status = ("preempted" if self.preempt_expected
+                                   else "drained")
+                    return
+                time.sleep(0.01)
+                continue
+            self._check_drain()
+            if self.draining:
+                continue
+            if self._can_step():
+                self._observant_sleep(w.step_seconds + self.slow_pending)
+                self.slow_pending = 0.0
+                self.step += 1
+                w.steps[self.wid] = self.step
+                if self.step % w.snapshot_every == 0:
+                    w.plane.commit(self.step, rank=self.rank)
+            else:
+                self._observant_sleep(0.005)
+
+
+class _Injector(threading.Thread):
+    """Fires the schedule from outside the supervision loop — so a
+    primary kill can land while the driver is blocked in a drain
+    handshake, which is exactly the composition the journaled-standby
+    design must survive."""
+
+    def __init__(self, world: _World, driver: ElasticDriver,
+                 workers: Dict[str, _ChaosWorker],
+                 entries: Sequence[ChaosEntry], t0: float):
+        super().__init__(daemon=True, name="chaos-injector")
+        self.world = world
+        self.driver = driver
+        self.workers = workers
+        self.pending = sorted(entries, key=lambda e: e.at)
+        self.t0 = t0
+        self.outage_until: Optional[float] = None
+        self.resume_poll_at = 0.0   # runner: no driver ticks before this
+        self.done = False
+        self.fired: List[ChaosEntry] = []
+
+    def run(self) -> None:  # noqa: D102
+        while not self.world.stop:
+            now = time.monotonic() - self.t0
+            if self.outage_until is not None and now >= self.outage_until:
+                self._takeover()
+            if self.pending and self.pending[0].at <= now:
+                entry = self.pending.pop(0)
+                try:
+                    self._fire(entry)
+                except Exception:  # noqa: BLE001
+                    log.exception("chaos injection failed: %s",
+                                  entry.render())
+                continue
+            self.done = not self.pending and self.outage_until is None
+            time.sleep(0.005)
+        if self.outage_until is not None:
+            # never leave the scenario headless: the evidence (events
+            # scope) must be collectable after the horizon
+            self._takeover()
+
+    def _fire(self, entry: ChaosEntry) -> None:
+        events_mod.record_event(
+            "chaos.inject", severity="warning",
+            payload={"kind": entry.kind, "target": entry.target,
+                     "rank": entry.rank, "at": entry.at,
+                     "duration": entry.duration})
+        self.fired.append(entry)
+        if entry.target == "primary":
+            self._kill_primary(entry)
+            return
+        if entry.target == "relay":
+            self.world.relay_dead = True
+            return
+        wid = self.world.worker_ids[entry.rank]
+        worker = self.workers[wid]
+        if entry.kind in ("crash", "hang", "partition"):
+            worker.fault = entry.kind
+        elif entry.kind == "slow":
+            worker.slow_pending += entry.duration or 0.1
+        elif entry.kind == "skew":
+            worker.skewed = True
+        elif entry.kind == "preempt":
+            worker.preempt_expected = True
+            self.world.kv_put(
+                MEMBERSHIP_SCOPE, f"{PREEMPT_PREFIX}{wid}",
+                {"worker": wid, "grace": entry.duration or None,
+                 "pid": os.getpid(), "time": time.time()})
+
+    def _kill_primary(self, entry: ChaosEntry) -> None:
+        w = self.world
+        events_mod.flush()
+        events_mod.attach_server(None)  # ring-buffer until takeover
+        with w._plock:
+            old, w.primary = w.primary, None
+        self.outage_until = ((time.monotonic() - self.t0)
+                             + (entry.duration or 0.25))
+        try:
+            old.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _takeover(self) -> None:
+        w = self.world
+        new = RendezvousServer(port=0, journal_path=w.journal_path)
+        new.start()
+        with w._plock:
+            w.primary = new
+        self.driver.server = new
+        events_mod.attach_server(new)
+        events_mod.record_event(
+            "primary.takeover", severity="warning",
+            payload={"port": new.port,
+                     "journal": bool(w.journal_path)})
+        self.outage_until = None
+        # let workers re-establish leases on the standby before the
+        # driver's lease/silent sweeps may run again
+        self.resume_poll_at = time.monotonic() + 2 * w.hb_interval
+
+
+# ---------------------------------------------------------------------------
+# scenario execution
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ScenarioResult:
+    """One scenario's evidence bundle and verdict."""
+
+    scenario: Scenario
+    ok: bool
+    violations: List[invariants_mod.Violation]
+    events: List[dict]
+    workers: Dict[str, dict]
+    final_world: List[str]
+    final_epoch: int
+    failed_reason: Optional[str]
+    recoveries: List[dict]
+    duration_s: float
+    skipped_entries: List[ChaosEntry] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario.name,
+            "schedule": self.scenario.render(),
+            "ok": self.ok,
+            "violations": [v.to_dict() for v in self.violations],
+            "workers": self.workers,
+            "final_world": self.final_world,
+            "final_epoch": self.final_epoch,
+            "failed_reason": self.failed_reason,
+            "recoveries": self.recoveries,
+            "events_recorded": len(self.events),
+            "duration_s": round(self.duration_s, 3),
+            "skipped": [e.render() for e in self.skipped_entries],
+        }
+
+
+@contextlib.contextmanager
+def _scoped_env(overrides: Dict[str, str]):
+    old = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _needs_journal(scenario: Scenario) -> bool:
+    return any(e.target == "primary" for e in scenario.entries)
+
+
+def run_scenario(scenario: Scenario, *, world_size: Optional[int] = None,
+                 min_np: int = 1, hb_interval: float = 0.06,
+                 step_seconds: Optional[float] = None,
+                 snapshot_every: Optional[int] = None,
+                 settle_seconds: Optional[float] = None,
+                 timeout: Optional[float] = None,
+                 drain_timeout: float = 1.2) -> ScenarioResult:
+    """Execute one scenario against a live control plane and check
+    every invariant over the recorded evidence.  Self-contained: stands
+    up (and tears down) its own server, driver, and worker threads;
+    resets the process flight recorder on entry and exit."""
+    world_size = int(world_size if world_size is not None else
+                     env_util.get_int(env_util.HVD_CHAOS_WORLD,
+                                      env_util.DEFAULT_CHAOS_WORLD))
+    step_seconds = float(
+        step_seconds if step_seconds is not None else
+        env_util.get_float(env_util.HVD_CHAOS_STEP_SECONDS,
+                           env_util.DEFAULT_CHAOS_STEP_SECONDS))
+    snapshot_every = int(
+        snapshot_every if snapshot_every is not None else
+        env_util.get_int(env_util.HVD_CHAOS_SNAPSHOT_EVERY,
+                         env_util.DEFAULT_CHAOS_SNAPSHOT_EVERY))
+    timeout = float(timeout if timeout is not None else env_util.get_float(
+        env_util.HVD_CHAOS_TIMEOUT_SECONDS,
+        env_util.DEFAULT_CHAOS_TIMEOUT_SECONDS))
+    for e in scenario.entries:
+        if e.target == "worker" and not 0 <= e.rank < world_size:
+            raise ChaosSpecError(
+                f"entry {e.render()!r} targets rank {e.rank} outside "
+                f"the world of {world_size}")
+    journal = None
+    if _needs_journal(scenario):
+        fd, journal = tempfile.mkstemp(prefix="hvd-chaos-journal-",
+                                       suffix=".jsonl")
+        os.close(fd)
+        os.unlink(journal)  # the server creates it; replay needs absence
+    events_mod._reset_for_tests()
+    world = _World([str(i) for i in range(world_size)],
+                   hb_interval=hb_interval, step_seconds=step_seconds,
+                   snapshot_every=snapshot_every, journal_path=journal)
+    server = RendezvousServer(port=0, journal_path=journal)
+    server.start()
+    world.primary = server
+    events_mod.attach_server(server)
+    overrides = {
+        env_util.HVD_HEARTBEAT_INTERVAL_SECONDS: str(hb_interval),
+        env_util.HVD_ELASTIC_TIMEOUT_SECONDS: "1.2",
+        env_util.HVD_ELASTIC_SILENT_GRACE_SECONDS: "0.5",
+        env_util.HVD_EVENTS: "1",
+        # never let a worker-side flusher spin up against unrelated
+        # rendezvous wiring from an enclosing test/launcher
+        env_util.HVD_METRICS_KV_ADDR: "",
+    }
+    t_start = time.monotonic()
+    workers: Dict[str, _ChaosWorker] = {}
+    injector: Optional[_Injector] = None
+    driver: Optional[ElasticDriver] = None
+    try:
+        with _scoped_env(overrides):
+            driver = ElasticDriver(server, world.worker_ids,
+                                   min_np=min_np,
+                                   drain_timeout=drain_timeout)
+            for wid in world.worker_ids:
+                workers[wid] = _ChaosWorker(world, wid)
+                workers[wid].start()
+            injector = _Injector(world, driver, workers,
+                                 scenario.entries, t_start)
+            injector.start()
+            destructive_silent = any(
+                e.kind in ("hang", "partition") for e in scenario.entries)
+            last_at = max((e.at for e in scenario.entries), default=0.0)
+            settle = float(settle_seconds if settle_seconds is not None
+                           else (3.2 if destructive_silent else 2.2))
+            horizon = min(last_at + settle, timeout)
+            reaped: set = set()
+            quiet_since: Optional[float] = None
+            while time.monotonic() - t_start < horizon:
+                now = time.monotonic()
+                if world.primary is not None \
+                        and now >= injector.resume_poll_at \
+                        and injector.outage_until is None:
+                    for wkr in workers.values():
+                        if wkr.status == "crashed" \
+                                and wkr.wid not in reaped:
+                            # supervise()'s child-exit reaping: the
+                            # fault exit code names the cause
+                            reaped.add(wkr.wid)
+                            if wkr.wid in driver.world:
+                                driver.remove(
+                                    wkr.wid,
+                                    f"worker {wkr.wid} exited with code "
+                                    f"{FAULT_EXIT_CODE}")
+                    try:
+                        driver.poll()
+                    except Exception:  # noqa: BLE001
+                        log.exception("driver poll failed mid-scenario")
+                if driver.failed_reason is not None:
+                    break
+                disrupted_clear = all(
+                    wkr.wid not in driver.world
+                    for wkr in workers.values()
+                    if wkr.status in ("crashed", "hung", "partitioned")
+                    or wkr.fault is not None or wkr.draining)
+                quiesced = (injector.done and disrupted_clear
+                            and driver._stable
+                            and world.primary is not None
+                            and set(driver.world)
+                            <= driver._ready_workers(driver.epoch))
+                if quiesced:
+                    if quiet_since is None:
+                        quiet_since = now
+                    elif now - quiet_since > 0.35:
+                        break
+                else:
+                    quiet_since = None
+                time.sleep(0.01)
+    finally:
+        world.stop = True
+        for wkr in workers.values():
+            wkr.join(timeout=2.0)
+        if injector is not None:
+            injector.join(timeout=3.0)
+    events_mod.flush()
+    evs: List[dict] = []
+    if world.primary is not None:
+        for raw in world.primary.scope_items(EVENTS_SCOPE).values():
+            try:
+                evs.append(json.loads(raw))
+            except (ValueError, TypeError):
+                continue
+    evs.sort(key=lambda e: (e.get("ts") or 0.0, str(e.get("id"))))
+    evidence = {wid: {"status": wkr.status, "step": wkr.step,
+                      "epoch": wkr.epoch}
+                for wid, wkr in workers.items()}
+    final_world = list(driver.world) if driver is not None else []
+    violations = invariants_mod.check_all(
+        evs, hb_interval=hb_interval, snapshot_every=snapshot_every,
+        workers=evidence, final_world=final_world)
+    recoveries = measure_recoveries(evs)
+    result = ScenarioResult(
+        scenario=scenario,
+        ok=(not violations
+            and (driver is None or driver.failed_reason is None)),
+        violations=violations, events=evs, workers=evidence,
+        final_world=final_world,
+        final_epoch=driver.epoch if driver is not None else -1,
+        failed_reason=driver.failed_reason if driver is not None else None,
+        recoveries=recoveries,
+        duration_s=time.monotonic() - t_start,
+        skipped_entries=list(injector.pending) if injector else [])
+    try:
+        if driver is not None:
+            driver.shutdown()
+        if world.primary is not None:
+            world.primary.stop()
+    except Exception:  # noqa: BLE001
+        pass
+    if journal is not None:
+        try:
+            os.unlink(journal)
+        except OSError:
+            pass
+    events_mod._reset_for_tests()
+    return result
+
+
+def measure_recoveries(events: List[dict]) -> List[dict]:
+    """Per removal commit: time from the triggering evidence (lease
+    expiry, preemption notice, or the remove decision) to the LAST
+    survivor resume of that epoch — the MTTR the bench distils to
+    p50/p99 — plus the per-rank steps lost."""
+    evs = sorted((e for e in events if isinstance(e, dict)),
+                 key=lambda e: (e.get("ts") or 0.0, str(e.get("id"))))
+    out: List[dict] = []
+    for c in evs:
+        if c.get("kind") != "epoch.commit":
+            continue
+        payload = c.get("payload") or {}
+        if not payload.get("removed"):
+            continue
+        epoch = payload.get("epoch")
+        chain = events_mod.extract_chain(evs, c.get("id"))
+        trigger = next(
+            (e for e in chain if e.get("kind") in
+             ("lease.expired", "preempt.notice", "epoch.remove")), c)
+        resumes = [e for e in chain
+                   if e.get("kind") == "restart.resume"
+                   and (e.get("payload") or {}).get("epoch") == epoch]
+        rec = {
+            "epoch": epoch,
+            "removed": payload.get("removed"),
+            "drained": _DRAINED_MARK in (payload.get("reason") or ""),
+            "trigger": trigger.get("kind"),
+            "steps_lost": [
+                (e.get("payload") or {}).get("steps_lost", 0)
+                for e in resumes],
+            "mttr_ms": None,
+        }
+        if resumes:
+            rec["mttr_ms"] = round(
+                (max(e.get("ts") or 0.0 for e in resumes)
+                 - (trigger.get("ts") or 0.0)) * 1000, 1)
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# campaigns
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CampaignResult:
+    """One campaign run: every scenario's verdict plus the shrink
+    output for whatever failed (when shrinking was requested)."""
+
+    seed: Optional[int]
+    results: List[ScenarioResult]
+    shrunk: Dict[str, "ShrinkResult"] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "ok": self.ok,
+            "scenarios": [r.to_dict() for r in self.results],
+            "shrunk": {k: s.to_dict() for k, s in self.shrunk.items()},
+        }
+
+
+def run_campaign(scenarios: Sequence[Scenario],
+                 seed: Optional[int] = None,
+                 shrink_failures: bool = False,
+                 **run_kwargs) -> CampaignResult:
+    """Run every scenario in order; optionally ddmin-shrink each red
+    one to its minimal failing fault subset."""
+    results = []
+    for s in scenarios:
+        log.info("chaos scenario %s: %s", s.name, s.render())
+        results.append(run_scenario(s, **run_kwargs))
+        log.info("chaos scenario %s: %s", s.name,
+                 "OK" if results[-1].ok else
+                 f"{len(results[-1].violations)} violation(s)")
+    campaign = CampaignResult(seed=seed, results=results)
+    if shrink_failures:
+        for r in results:
+            if not r.ok:
+                campaign.shrunk[r.scenario.name] = shrink(
+                    r.scenario, **run_kwargs)
+    return campaign
+
+
+# ---------------------------------------------------------------------------
+# delta-debugging shrink
+# ---------------------------------------------------------------------------
+
+def ddmin(items: Sequence, failing: Callable[[List], bool]) -> List:
+    """Zeller's ddmin over ``items``: the smallest subset for which
+    ``failing`` still returns True (1-minimal — dropping any single
+    remaining item makes the failure vanish).  Results are memoised so
+    re-tested subsets cost nothing."""
+    idx = list(range(len(items)))
+    cache: Dict[Tuple[int, ...], bool] = {}
+
+    def fails(sub: List[int]) -> bool:
+        key = tuple(sub)
+        if key not in cache:
+            cache[key] = bool(failing([items[i] for i in sub]))
+        return cache[key]
+
+    if not fails(idx):
+        raise ChaosSpecError(
+            "the full scenario does not fail; nothing to shrink")
+    n = 2
+    while len(idx) >= 2:
+        chunks = [idx[i * len(idx) // n:(i + 1) * len(idx) // n]
+                  for i in range(n)]
+        reduced = False
+        for chunk in chunks:
+            if not chunk or len(chunk) == len(idx):
+                continue
+            if fails(chunk):
+                idx, n, reduced = chunk, 2, True
+                break
+            complement = [i for i in idx if i not in chunk]
+            if complement and fails(complement):
+                idx, n, reduced = complement, max(n - 1, 2), True
+                break
+        if not reduced:
+            if n >= len(idx):
+                break
+            n = min(len(idx), n * 2)
+    return [items[i] for i in idx]
+
+
+@dataclass
+class ShrinkResult:
+    """The minimal failing scenario and the evidence it still trips."""
+
+    minimal: Scenario
+    result: ScenarioResult
+    runs: int
+
+    def to_dict(self) -> dict:
+        return {
+            "minimal": self.minimal.render(),
+            "entries": len(self.minimal.entries),
+            "runs": self.runs,
+            "violations": [v.to_dict()
+                           for v in self.result.violations],
+        }
+
+
+def shrink(scenario: Scenario, **run_kwargs) -> ShrinkResult:
+    """Delta-debug ``scenario`` to the minimal fault subset that still
+    violates an invariant, then re-run the minimal scenario to capture
+    its violation report (with causal chains) as the verdict."""
+    runs = [0]
+
+    def failing(entries: List[ChaosEntry]) -> bool:
+        if not entries:
+            return False
+        runs[0] += 1
+        sub = Scenario(name=f"{scenario.name}#shrink{runs[0]}",
+                       entries=tuple(entries))
+        return not run_scenario(sub, **run_kwargs).ok
+
+    minimal_entries = ddmin(list(scenario.entries), failing)
+    minimal = Scenario(
+        name=f"{scenario.name}#minimal",
+        entries=tuple(sorted(minimal_entries, key=lambda e: e.at)))
+    final = run_scenario(minimal, **run_kwargs)
+    runs[0] += 1
+    return ShrinkResult(minimal=minimal, result=final, runs=runs[0])

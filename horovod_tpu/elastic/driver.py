@@ -42,6 +42,7 @@ from ..run.http_server import (
     EPOCH_KEY,
     HEALTH_SCOPE,
     MEMBERSHIP_SCOPE,
+    PREEMPT_PREFIX,
     READY_PREFIX,
     SPARE_PREFIX,
     STATE_PREFIX,
@@ -98,6 +99,15 @@ class ElasticDriver:
             drain_timeout if drain_timeout is not None
             else env_util.get_float(
                 env_util.HVD_SERVE_DRAIN_TIMEOUT_SECONDS, self._timeout))
+        # chaos-found liveness gap: a member that stops renewing right
+        # before an unrelated commit clears the health scope never gets
+        # a dead verdict (its lease entry is simply gone).  With the
+        # grace > 0, a stable-epoch member with NO re-established lease
+        # that long past stability is removed as dead.
+        self._silent_grace = env_util.get_float(
+            env_util.HVD_ELASTIC_SILENT_GRACE_SECONDS,
+            env_util.DEFAULT_ELASTIC_SILENT_GRACE_SECONDS)
+        self._stable_time = 0.0
         # serving-plane hooks (serving/autoscaler.py): an attached
         # autoscaler ticks from poll() on stable epochs, and announced
         # workers are HELD as spares for it instead of auto-admitted
@@ -338,6 +348,35 @@ class ElasticDriver:
         return self.commit(self.world + list(workers), admitted=workers,
                            reason=reason, cause_id=admit_eid)
 
+    def preempt(self, worker: str, grace: Optional[float] = None,
+                cause_id: Optional[str] = None) -> bool:
+        """Handle a preemption notice for ``worker`` (cloud maintenance
+        signal, ``kind=preempt`` fault) as a **planned drain+snapshot**,
+        not a crash: the worker is asked to finish in flight, snapshot,
+        and ack inside the ``grace`` window (capped at the drain
+        budget); only then is the shrink committed.  Voluntary, so it
+        never counts toward the flapping blocklist.  Returns False when
+        the shrink would violate ``min_np`` (same contract as
+        :meth:`remove`)."""
+        if worker not in self.world or worker in self.finished:
+            return True
+        eid = self._event(
+            "preempt.notice", severity="warning",
+            payload={"worker": worker, "grace": grace,
+                     "epoch": self.epoch},
+            cause_id=cause_id, rank=self.world.index(worker))
+        old = self._drain_timeout
+        if grace:
+            self._drain_timeout = min(old, float(grace))
+        try:
+            return self.remove(
+                worker,
+                f"preemption notice for worker {worker} "
+                f"(grace {self._drain_timeout:.1f}s)",
+                drain=True, cause_id=eid)
+        finally:
+            self._drain_timeout = old
+
     # -- serving-plane hooks (serving/autoscaler.py) -------------------------
     def attach_autoscaler(self, autoscaler, *,
                           hold_admissions: bool = True) -> None:
@@ -454,6 +493,7 @@ class ElasticDriver:
             if self._stable:
                 # the aborted epoch is fully drained: the flag and the
                 # old rebuild artifacts can go
+                self._stable_time = now
                 self.server.clear_scope(ABORT_SCOPE)
                 self._gc()
         # lease expiry (partitions, silent deaths of external members):
@@ -484,6 +524,46 @@ class ElasticDriver:
                 self.remove(worker, f"rank {rank_s} (worker {worker}) "
                             "heartbeat lease expired",
                             cause_id=lease_eid)
+            # the silent-member sweep: a lease entry wiped by a commit's
+            # health-scope clear and never re-established leaves a dead
+            # member with NO verdict at all — after the (opt-in) grace
+            # past stability, missing reads as dead too
+            if self._silent_grace > 0 and self._stable \
+                    and now - self._stable_time > self._silent_grace:
+                ranks = report.get("ranks", {})
+                for i, worker in enumerate(roster):
+                    if not self._stable:
+                        break  # a removal above re-opened the epoch
+                    if str(i) in ranks or worker not in self.world \
+                            or worker in self.finished:
+                        continue
+                    eid = self._event(
+                        "lease.expired", severity="critical",
+                        payload={"rank": i, "worker": worker,
+                                 "silent": True,
+                                 "grace": self._silent_grace},
+                        rank=i)
+                    self.remove(
+                        worker, f"rank {i} (worker {worker}) never "
+                        "re-established its heartbeat lease",
+                        cause_id=eid)
+        if self._stable:
+            # pending preemption notices become planned drains at the
+            # next stable boundary (mid-rebuild, the key just waits)
+            items = self.server.scope_items(MEMBERSHIP_SCOPE)
+            for key in sorted(items):
+                if not key.startswith(PREEMPT_PREFIX):
+                    continue
+                if not self._stable:
+                    break  # an earlier preempt re-opened the epoch
+                worker = key[len(PREEMPT_PREFIX):]
+                grace = None
+                try:
+                    grace = json.loads(items[key]).get("grace")
+                except (ValueError, TypeError):
+                    pass
+                self.server.delete(MEMBERSHIP_SCOPE, key)
+                self.preempt(worker, grace=grace)
         if self._stable and self.failed_reason is None \
                 and not self.finished:
             # no admissions once any member finished: the job is winding

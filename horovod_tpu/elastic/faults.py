@@ -44,8 +44,14 @@ Fields:
              negotiation raises ``TimeoutError``, while the process
              itself stays alive — heartbeat leases expire and the
              elastic driver removes the rank without a process death),
-             or ``corrupt`` (flip bytes in the payload at a mutating
-             seam — only ``peer_push`` today; elsewhere it is a no-op).
+             ``corrupt`` (flip bytes in the payload at a mutating
+             seam — only ``peer_push`` today; elsewhere it is a no-op),
+             or ``preempt[=<grace>]`` (deliver a grace-window
+             preemption notice: the worker publishes
+             ``membership/preempt.<worker>`` and keeps training; the
+             elastic driver's poll turns the notice into a planned
+             drain+snapshot — elastic/driver.preempt — instead of a
+             crash.  Fires at most once per process).
 ``prob``     float in [0, 1] (default 1.0).
 ``seam``     ``step`` / ``dispatch`` / ``http`` / ``controller`` /
              ``peer_push`` / ``peer_pull``; defaults to ``http`` for
@@ -76,7 +82,8 @@ log = get_logger(__name__)
 #: in launcher logs and test assertions.
 FAULT_EXIT_CODE = 17
 
-KINDS = ("crash", "hang", "slow", "http_drop", "partition", "corrupt")
+KINDS = ("crash", "hang", "slow", "http_drop", "partition", "corrupt",
+         "preempt")
 SEAMS = ("step", "dispatch", "http", "controller", "peer_push",
          "peer_pull")
 
@@ -147,6 +154,9 @@ def parse_spec(text: str) -> List[Fault]:
                 raise FaultSpecError(
                     f"kind=slow needs a duration (slow=200ms) in {chunk!r}")
             duration = parse_duration(arg)
+        elif kind == "preempt":
+            # optional grace window: preempt=30s; 0 means "driver default"
+            duration = parse_duration(arg) if arg else 0.0
         elif arg:
             raise FaultSpecError(
                 f"kind={kind} takes no argument (got {arg!r}) in {chunk!r}")
@@ -174,15 +184,29 @@ class FaultInjector:
     invocation counter; a matching fault acts when the counter, rank,
     incarnation, and probability all line up."""
 
-    def __init__(self, faults: List[Fault], rank: int, restart: int):
+    def __init__(self, faults: List[Fault], rank: int, restart: int,
+                 seed: Optional[int] = None):
         self.faults = list(faults)
         self.rank = int(rank)
         self.restart = int(restart)
         self._counts = {seam: 0 for seam in SEAMS}
         self._lock = threading.Lock()
+        # probabilistic faults draw from a PER-INJECTOR stream: with
+        # HVD_FAULT_SEED set, the seed is mixed with rank + incarnation
+        # so every process draws a distinct but replayable sequence —
+        # a failing prob= chaos run reproduces under the same seed
+        if seed is None:
+            self._rng = random.Random()
+        else:
+            self._rng = random.Random(
+                (int(seed) * 0x9E3779B1
+                 + self.rank * 0x85EBCA6B
+                 + self.restart * 0xC2B2AE35) & 0xFFFFFFFF)
         # once a `partition` fault fires, this process's rendezvous +
         # controller traffic is dropped for good (the network-split shape)
         self.partitioned = False
+        # a `preempt` fault delivers its notice at most once
+        self.preempted = False
 
     def fire(self, seam: str, detail: str = "") -> None:
         with self._lock:
@@ -197,7 +221,7 @@ class FaultInjector:
                 continue
             if f.step is not None and f.step != n:
                 continue
-            if f.prob < 1.0 and random.random() >= f.prob:
+            if f.prob < 1.0 and self._rng.random() >= f.prob:
                 continue
             self._act(f, seam, n, detail)
 
@@ -218,7 +242,7 @@ class FaultInjector:
                 continue
             if f.step is not None and f.step != n:
                 continue
-            if f.prob < 1.0 and random.random() >= f.prob:
+            if f.prob < 1.0 and self._rng.random() >= f.prob:
                 continue
             if f.kind == "corrupt":
                 from .. import metrics
@@ -255,8 +279,27 @@ class FaultInjector:
 
             raise urllib.error.URLError(
                 f"injected http_drop at {seam}[{n}] {detail}")
+        elif f.kind == "preempt":
+            self._deliver_preemption(f.duration)
         # `corrupt` outside a mutating seam has no payload to flip — the
         # log line above is its only effect
+
+    def _deliver_preemption(self, grace: float) -> None:
+        """Publish a one-shot preemption notice for this worker; the
+        elastic driver handles it as a planned drain+snapshot
+        (elastic/driver.preempt).  The process keeps training inside
+        the grace window — preemption is NOT a crash."""
+        if self.preempted:
+            return
+        self.preempted = True
+        try:
+            from . import membership
+
+            membership.notify_preemption(grace or None)
+        except Exception as e:  # noqa: BLE001 — a worker without
+            # rendezvous wiring still marks itself preempted; the
+            # notice simply cannot reach a driver
+            log.warning("preemption notice could not be published: %s", e)
 
 
 def _flip_bytes(data: bytes) -> bytes:
@@ -288,7 +331,15 @@ def _build_from_env() -> Optional[FaultInjector]:
         return None
     rank = env_util.get_int(env_util.HVD_PROCESS_ID, 0)
     restart = env_util.get_int(env_util.HVD_RESTART_COUNT, 0)
-    inj = FaultInjector(faults, rank, restart)
+    seed: Optional[int] = None
+    seed_raw = env_util.get_str(env_util.HVD_FAULT_SEED)
+    if seed_raw is not None:
+        try:
+            seed = int(seed_raw)
+        except ValueError:
+            raise FaultSpecError(
+                f"bad {env_util.HVD_FAULT_SEED}={seed_raw!r} (want an int)")
+    inj = FaultInjector(faults, rank, restart, seed=seed)
     log.warning("fault injection armed: %d fault(s) on rank %d "
                 "(incarnation %d): %s", len(faults), rank, restart, spec)
     return inj

@@ -228,6 +228,24 @@ def ack_drain() -> None:
            secret=secret, retry=True)
 
 
+def notify_preemption(grace: Optional[float] = None) -> None:
+    """Publish a preemption notice for THIS worker (cloud maintenance
+    signal, or a ``kind=preempt`` fault): ``preempt.<worker>`` under the
+    membership scope.  The elastic driver's poll picks it up and runs a
+    planned drain+snapshot inside the ``grace`` window
+    (elastic/driver.preempt) — the worker keeps working until the drain
+    request arrives, so preemption never reads as a crash."""
+    from ..run.http_client import put_kv
+    from ..run.http_server import MEMBERSHIP_SCOPE, PREEMPT_PREFIX
+
+    addr, port, secret = _wiring()
+    put_kv(addr, port, MEMBERSHIP_SCOPE,
+           f"{PREEMPT_PREFIX}{worker_id()}",
+           json.dumps({"worker": worker_id(), "grace": grace,
+                       "pid": os.getpid(), "time": time.time()}).encode(),
+           secret=secret, retry=True)
+
+
 def _apply_env(rec: dict) -> int:
     """Adopt the committed record: re-assign this worker's dense rank
     from the roster and rewrite the topology env the runtime reads.

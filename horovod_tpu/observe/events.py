@@ -75,6 +75,7 @@ KNOWN_KINDS = (
     "snapshot.begin", "snapshot.commit", "snapshot.reprotect",
     "restore.source", "spare.purged",
     "watchdog.alert", "watchdog.arm",
+    "preempt.notice", "primary.takeover", "chaos.inject",
 )
 
 

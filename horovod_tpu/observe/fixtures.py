@@ -236,3 +236,136 @@ def evaluate_events_fixture(
     chain = events_mod.extract_chain(evs, "worker2-9-1")
     summary = events_mod.chain_summary(chain)
     return summary
+
+
+# ---------------------------------------------------------------------------
+# chaos invariant fixture (hvd_chaos --check)
+# ---------------------------------------------------------------------------
+
+#: what the invariant monitors (observe/invariants.py) must say about
+#: ``chaos_fixture()``: the recovery chain itself is clean, but the
+#: stream deliberately violates TWO promises — rank 0's resume reports
+#: 17 steps lost (> the snapshot interval of 5, with the full causal
+#: chain from the lease expiry as evidence) and request ``req-7``
+#: completes twice across a drain.  Everything else must stay green.
+CHAOS_EXPECTED: Dict[str, Any] = {
+    "violated": ["serving-exactly-once", "steps-lost-bound"],
+    "green": ["abort-propagation", "epoch-monotonic",
+              "restore-source-agreement"],
+    "steps_lost_chain_kinds": ["lease.expired", "epoch.remove",
+                               "abort.publish", "epoch.commit",
+                               "abort.observe", "restore.source",
+                               "restart.resume", "restart.resume"],
+    "steps_lost": 17,
+    "duplicate_request": "req-7",
+    "completions": 2,
+}
+
+#: parameters ``evaluate_chaos_fixture`` checks the stream against
+CHAOS_PARAMS = {"hb_interval": 0.5, "snapshot_every": 5}
+
+
+def chaos_fixture() -> List[Dict[str, Any]]:
+    """A hand-written incident stream: lease expiry on rank 2 →
+    removal → abort → shrink commit → survivor observes (0.3 s later,
+    inside the 2 x 0.5 s bound) → restores from gen 4 → resumes
+    reporting 17 steps lost (the planted steps-lost violation), plus a
+    ``serve.complete`` pair for the same request id (the planted
+    exactly-once violation) and a second, clean commit chain proving
+    epoch monotonicity."""
+    return [
+        {"id": "launcher-2-0", "ts": 200.0, "host": "launcher", "rank": 2,
+         "kind": "lease.expired", "severity": "critical",
+         "correlation_id": "launcher-2-0", "cause_id": None,
+         "payload": {"rank": 2, "worker": "2", "age_seconds": 2.1,
+                     "interval": 0.5}},
+        {"id": "launcher-2-1", "ts": 200.05, "host": "launcher", "rank": 2,
+         "kind": "epoch.remove", "severity": "warning",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-0",
+         "payload": {"worker": "2", "rank": 2, "drain": False,
+                     "reason": "rank 2 heartbeat lease expired"}},
+        {"id": "launcher-2-2", "ts": 200.1, "host": "launcher", "rank": 2,
+         "kind": "abort.publish", "severity": "critical",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-1",
+         "payload": {"reason": "rank 2 lease expired", "epoch": 3,
+                     "source": "elastic_driver"}},
+        {"id": "launcher-2-3", "ts": 200.15, "host": "launcher",
+         "rank": None, "kind": "epoch.commit", "severity": "warning",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-1",
+         "payload": {"epoch": 4, "size": 2, "removed": ["2"],
+                     "admitted": [], "reason": "rank 2 lease expired"}},
+        {"id": "worker0-4-0", "ts": 200.4, "host": "worker0", "rank": 0,
+         "kind": "abort.observe", "severity": "warning",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-2",
+         "payload": {"epoch": 3, "worker": "0",
+                     "reason": "rank 2 lease expired"}},
+        {"id": "worker0-4-1", "ts": 200.45, "host": "worker0", "rank": 0,
+         "kind": "restore.source", "severity": "info",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-3",
+         "payload": {"epoch": 4, "gen": 4, "step": 40, "worker": "0",
+                     "source": "peer"}},
+        # the planted violation: 17 steps lost >> snapshot_every 5
+        {"id": "worker0-4-2", "ts": 200.5, "host": "worker0", "rank": 0,
+         "kind": "restart.resume", "severity": "info",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-3",
+         "payload": {"epoch": 4, "steps_lost": 17, "worker": "0"}},
+        {"id": "worker1-5-0", "ts": 200.5, "host": "worker1", "rank": 1,
+         "kind": "restart.resume", "severity": "info",
+         "correlation_id": "launcher-2-0", "cause_id": "launcher-2-3",
+         "payload": {"epoch": 4, "steps_lost": 3, "worker": "1"}},
+        # a later, clean drain commit: epoch keeps moving forward
+        {"id": "launcher-2-4", "ts": 201.0, "host": "launcher",
+         "rank": None, "kind": "epoch.commit", "severity": "warning",
+         "correlation_id": "launcher-2-4", "cause_id": None,
+         "payload": {"epoch": 5, "size": 1, "removed": ["1"],
+                     "admitted": [],
+                     "reason": "autoscale shrink (drained: in-flight "
+                               "work completed)"}},
+        # the planted exactly-once violation: req-7 completes twice
+        {"id": "serve-6-0", "ts": 200.8, "host": "serve0", "rank": 0,
+         "kind": "serve.complete", "severity": "info",
+         "correlation_id": "serve-6-0", "cause_id": None,
+         "payload": {"request_id": "req-7"}},
+        {"id": "serve-6-1", "ts": 201.1, "host": "serve1", "rank": 1,
+         "kind": "serve.complete", "severity": "info",
+         "correlation_id": "serve-6-1", "cause_id": None,
+         "payload": {"request_id": "req-7"}},
+        {"id": "serve-6-2", "ts": 201.2, "host": "serve1", "rank": 1,
+         "kind": "serve.complete", "severity": "info",
+         "correlation_id": "serve-6-2", "cause_id": None,
+         "payload": {"request_id": "req-8"}},
+    ]
+
+
+def evaluate_chaos_fixture(
+        events: List[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Run the full invariant catalogue over the fixture stream and
+    distil the verdict shape ``CHAOS_EXPECTED`` pins: which invariants
+    fired, which stayed green, and the causal chain behind the
+    steps-lost violation."""
+    from . import invariants as invariants_mod
+
+    evs = events if events is not None else chaos_fixture()
+    violations = invariants_mod.check_all(
+        evs, hb_interval=CHAOS_PARAMS["hb_interval"],
+        snapshot_every=CHAOS_PARAMS["snapshot_every"])
+    violated = sorted({v.invariant for v in violations})
+    steps = next((v for v in violations
+                  if v.invariant == "steps-lost-bound"), None)
+    dup = next((v for v in violations
+                if v.invariant == "serving-exactly-once"), None)
+    return {
+        "violated": violated,
+        "green": sorted(set(invariants_mod.INVARIANTS)
+                        - set(violated) - {"no-hanging-rank"}),
+        "steps_lost_chain_kinds": [e.get("kind")
+                                   for e in (steps.chain if steps
+                                             else [])],
+        "steps_lost": (steps.evidence.get("steps_lost")
+                       if steps else None),
+        "duplicate_request": (dup.evidence.get("request_id")
+                              if dup else None),
+        "completions": (dup.evidence.get("completions")
+                        if dup else None),
+        "violations": violations,
+    }

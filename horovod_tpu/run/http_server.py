@@ -172,6 +172,11 @@ STATE_PREFIX = "state."
 # drain_ack.<worker>; only then is the shrink epoch committed.
 DRAIN_PREFIX = "drain."
 DRAIN_ACK_PREFIX = "drain_ack."
+# a worker that received a preemption notice (cloud maintenance, or a
+# kind=preempt fault) publishes it under preempt.<worker>; the elastic
+# driver's poll turns the notice into a planned drain+snapshot
+# (elastic/driver.preempt) instead of waiting for the lease to die.
+PREEMPT_PREFIX = "preempt."
 
 EPOCH_PATH = f"/{MEMBERSHIP_SCOPE}/{EPOCH_KEY}"
 
@@ -295,6 +300,8 @@ def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
     drain_acks = {k[len(DRAIN_ACK_PREFIX):]: _load(v)
                   for k, v in keys.items()
                   if k.startswith(DRAIN_ACK_PREFIX)}
+    preempts = {k[len(PREEMPT_PREFIX):]: _load(v) for k, v in keys.items()
+                if k.startswith(PREEMPT_PREFIX)}
     return {
         "epoch": _load(keys.get(EPOCH_KEY)),
         "announces": announces,
@@ -302,6 +309,7 @@ def build_membership_report(store: Dict[str, bytes]) -> Dict[str, object]:
         "blocklist": _load(keys.get(BLOCKLIST_KEY)) or [],
         "drains": drains,
         "drain_acks": drain_acks,
+        "preempts": preempts,
     }
 
 

@@ -139,6 +139,7 @@ HVD_TERM_GRACE_SECONDS = "HVD_TERM_GRACE_SECONDS"      # SIGTERM→SIGKILL escal
 HVD_HTTP_RETRIES = "HVD_HTTP_RETRIES"                  # rendezvous HTTP retry budget (default 2)
 HVD_HTTP_BACKOFF_MS = "HVD_HTTP_BACKOFF_MS"            # base retry backoff, ms (default 50)
 HVD_FAULT_SPEC = "HVD_FAULT_SPEC"                      # fault-injection spec (elastic/faults.py)
+HVD_FAULT_SEED = "HVD_FAULT_SEED"                      # seeds each injector's RNG (mixed with rank + restart) so prob= faults replay deterministically
 HVD_RESTART_COUNT = "HVD_RESTART_COUNT"                # incarnation index set by the supervisor
 HVD_RESTART_BACKOFF_SECONDS = "HVD_RESTART_BACKOFF_SECONDS"  # restart backoff base (default 1)
 # elastic membership (elastic/membership.py + elastic/driver.py;
@@ -148,6 +149,7 @@ HVD_ELASTIC_WORKER_ID = "HVD_ELASTIC_WORKER_ID"        # stable worker identity 
 HVD_ELASTIC_MIN_NP = "HVD_ELASTIC_MIN_NP"              # floor world size before giving up (default 1)
 HVD_ELASTIC_TIMEOUT_SECONDS = "HVD_ELASTIC_TIMEOUT_SECONDS"  # epoch wait/rebuild budget (default 60)
 HVD_ELASTIC_MAX_FLAPS = "HVD_ELASTIC_MAX_FLAPS"        # removals before a worker is blocklisted (default 3)
+HVD_ELASTIC_SILENT_GRACE_SECONDS = "HVD_ELASTIC_SILENT_GRACE_SECONDS"  # >0: a stable-epoch member with NO re-established lease this long past stability is removed as dead (default 0 = off)
 # metrics-plane histogram shape (metrics/registry.py): the default
 # latency bucket scheme is exponential from FLOOR seconds; serving-scale
 # request latencies get their own floor below
@@ -246,6 +248,15 @@ HVD_SNAPSHOT_TIMEOUT_SECONDS = "HVD_SNAPSHOT_TIMEOUT_SECONDS"  # per shard push/
 HVD_SNAPSHOT_COPY = "HVD_SNAPSHOT_COPY"                # 1 also copies numpy leaves at enqueue — for loops that mutate arrays in place (default off)
 HVD_PEER_REPLICAS = "HVD_PEER_REPLICAS"                # peer hosts holding each rank's shards, K (default 2)
 HVD_BENCH_RESTORE = "HVD_BENCH_RESTORE"                # 0 skips bench.py's peer-restore leg
+# chaos campaign engine (elastic/chaos.py, observe/invariants.py,
+# scripts/hvd_chaos.py; docs/fault_tolerance.md#chaos-certification):
+# scripted multi-fault scenarios run against an in-process elastic
+# world and certified by invariant monitors over the flight recorder
+HVD_CHAOS_WORLD = "HVD_CHAOS_WORLD"                    # workers per chaos scenario world (default 3)
+HVD_CHAOS_STEP_SECONDS = "HVD_CHAOS_STEP_SECONDS"      # simulated train-step duration in the chaos world (default 0.01)
+HVD_CHAOS_SNAPSHOT_EVERY = "HVD_CHAOS_SNAPSHOT_EVERY"  # steps between chaos-world snapshot commits (default 5)
+HVD_CHAOS_TIMEOUT_SECONDS = "HVD_CHAOS_TIMEOUT_SECONDS"  # per-scenario wall budget before the runner declares a hang (default 30)
+HVD_BENCH_CHAOS = "HVD_BENCH_CHAOS"                    # 0 skips bench.py's chaos campaign leg
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # 64 MB, reference common.h:69
 DEFAULT_CYCLE_TIME_MS = 5.0                        # reference common.h:67
@@ -311,6 +322,11 @@ DEFAULT_SNAPSHOT_KEEP = 2                          # own committed generations k
 DEFAULT_SNAPSHOT_STORAGE_EVERY = 10                # storage-tier save demotion cadence
 DEFAULT_SNAPSHOT_TIMEOUT_SECONDS = 30.0            # per shard push/pull HTTP budget
 DEFAULT_PEER_REPLICAS = 2                          # peer hosts holding each rank's shards
+DEFAULT_ELASTIC_SILENT_GRACE_SECONDS = 0.0         # elastic/driver.py silent-member removal (0 = off)
+DEFAULT_CHAOS_WORLD = 3                            # elastic/chaos.py workers per scenario
+DEFAULT_CHAOS_STEP_SECONDS = 0.01                  # chaos-world simulated step duration
+DEFAULT_CHAOS_SNAPSHOT_EVERY = 5                   # chaos-world snapshot commit cadence, steps
+DEFAULT_CHAOS_TIMEOUT_SECONDS = 30.0               # per-scenario wall budget
 
 
 def get_int(name: str, default: int) -> int:

@@ -1,0 +1,216 @@
+"""Chaos campaign console: scripted multi-fault scenarios, certified.
+
+Drives the chaos campaign engine (horovod_tpu/elastic/chaos.py —
+docs/fault_tolerance.md "Chaos certification"): runs one scenario or a
+seeded campaign against a real in-process elastic control plane,
+checks every recovery invariant (observe/invariants.py) over the
+flight-recorder evidence, and delta-debugs failures down to the
+minimal fault set.
+
+Run::
+
+    python scripts/hvd_chaos.py --scenario \
+        "at=250ms:rank=1:kind=crash; at=600ms:rank=2:kind=preempt=2s"
+    python scripts/hvd_chaos.py --campaign 8 --seed 7 [--shrink] [--json]
+    python scripts/hvd_chaos.py --campaign 8 --seed 7 --render-only
+    python scripts/hvd_chaos.py --check
+
+``--seed`` makes the campaign reproducible: the same seed always
+renders (and therefore replays) the identical schedule.  ``--shrink``
+ddmin-shrinks every red scenario to its minimal failing fault subset
+before reporting.  ``--check`` is the tier-1 self-test: the
+hand-written invariant fixture must produce its pinned verdicts (two
+planted violations caught, with the causal chain), a hand-written
+green scenario must run clean end-to-end, and a deliberately-violated
+scenario must be caught AND shrunk to its minimal fault pair.
+
+World shape and pacing come from ``HVD_CHAOS_WORLD``,
+``HVD_CHAOS_STEP_SECONDS``, ``HVD_CHAOS_SNAPSHOT_EVERY``, and
+``HVD_CHAOS_TIMEOUT_SECONDS`` (utils/env.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.elastic import chaos  # noqa: E402
+from horovod_tpu.observe.invariants import format_violation  # noqa: E402
+
+#: the --check green scenario: a crash and a preemption composed — the
+#: lossy and the lossless recovery path in one schedule
+CHECK_GREEN = ("at=200ms:rank=1:kind=crash; "
+               "at=700ms:rank=2:kind=preempt=2s")
+#: the --check red scenario: the skew fault corrupts rank 0's restore
+#: bookkeeping, so the crash's lossy recovery over-reports steps lost —
+#: minimal failing subset is exactly {skew, crash}
+CHECK_RED = ("at=150ms:rank=0:kind=skew; at=300ms:rank=1:kind=crash; "
+             "at=650ms:rank=2:kind=slow=80ms")
+
+
+def _print_scenario_result(res: chaos.ScenarioResult) -> None:
+    verdict = "OK" if res.ok else f"{len(res.violations)} VIOLATION(S)"
+    print(f"scenario {res.scenario.name}: {verdict} "
+          f"({res.duration_s:.2f}s, final epoch {res.final_epoch}, "
+          f"world {res.final_world})")
+    print(f"  schedule: {res.scenario.render()}")
+    statuses = {w: i.get("status") for w, i in sorted(res.workers.items())}
+    print(f"  workers: {statuses}")
+    for rec in res.recoveries:
+        lost = max(rec["steps_lost"]) if rec["steps_lost"] else 0
+        print(f"  recovery epoch {rec['epoch']}: removed="
+              f"{rec['removed']} trigger={rec['trigger']} "
+              f"mttr={rec['mttr_ms']}ms steps_lost<={lost}"
+              f"{' (drained)' if rec['drained'] else ''}")
+    if res.failed_reason:
+        print(f"  GIVE-UP: {res.failed_reason}")
+    for v in res.violations:
+        print(format_violation(v))
+
+
+def _print_shrink(name: str, sh: chaos.ShrinkResult) -> None:
+    print(f"shrunk {name}: minimal failing set "
+          f"({len(sh.minimal.entries)} fault(s), {sh.runs} runs):")
+    print(f"  {sh.minimal.render()}")
+    for v in sh.result.violations:
+        print(format_violation(v))
+
+
+def run_scenario_mode(args) -> int:
+    scenario = chaos.parse_scenario(args.scenario, name="cli")
+    result = chaos.run_scenario(scenario)
+    if args.json:
+        out = result.to_dict()
+        if not result.ok and args.shrink:
+            out["shrunk"] = chaos.shrink(scenario).to_dict()
+        print(json.dumps(out, indent=2))
+        return 0 if result.ok else 1
+    _print_scenario_result(result)
+    if not result.ok and args.shrink:
+        _print_shrink(scenario.name, chaos.shrink(scenario))
+    return 0 if result.ok else 1
+
+
+def run_campaign_mode(args) -> int:
+    seed = args.seed if args.seed is not None else 0
+    scenarios = chaos.generate_campaign(seed, count=args.campaign)
+    if args.render_only:
+        for s in scenarios:
+            print(f"{s.name}: {s.render()}")
+        return 0
+    campaign = chaos.run_campaign(scenarios, seed=seed,
+                                  shrink_failures=args.shrink)
+    if args.json:
+        print(json.dumps(campaign.to_dict(), indent=2))
+        return 0 if campaign.ok else 1
+    for res in campaign.results:
+        _print_scenario_result(res)
+    for name, sh in campaign.shrunk.items():
+        _print_shrink(name, sh)
+    n_red = sum(1 for r in campaign.results if not r.ok)
+    print(f"campaign seed={seed}: {len(campaign.results)} scenario(s), "
+          f"{n_red} red")
+    return 0 if campaign.ok else 1
+
+
+def run_check() -> int:
+    """Self-test (tier-1): fixture verdicts, a green run, a caught and
+    shrunk violation."""
+    errors = []
+
+    # 1. the hand-written invariant fixture must reproduce its pinned
+    #    verdicts — both planted violations caught, with the chain
+    from horovod_tpu.observe.fixtures import (
+        CHAOS_EXPECTED, evaluate_chaos_fixture,
+    )
+    got = evaluate_chaos_fixture()
+    for field, exp in CHAOS_EXPECTED.items():
+        if got.get(field) != exp:
+            errors.append(f"fixture {field}: {got.get(field)!r} != {exp!r}")
+    steps = next((v for v in got["violations"]
+                  if v.invariant == "steps-lost-bound"), None)
+    if steps is not None and not steps.chain:
+        errors.append("fixture steps-lost violation carries no causal "
+                      "chain")
+
+    # 2. the green scenario must pass every invariant end-to-end
+    green = chaos.run_scenario(
+        chaos.parse_scenario(CHECK_GREEN, name="check-green"))
+    if not green.ok:
+        errors.append(
+            "green scenario failed: "
+            + "; ".join(v.message for v in green.violations)
+            + (f"; give-up: {green.failed_reason}"
+               if green.failed_reason else ""))
+    statuses = {w: i["status"] for w, i in green.workers.items()}
+    if statuses.get("1") != "crashed" or statuses.get("2") != "preempted":
+        errors.append(f"green scenario end states wrong: {statuses}")
+    drained = [r for r in green.recoveries if r["drained"]]
+    if not drained or any(lost != 0 for r in drained
+                          for lost in r["steps_lost"]):
+        errors.append("preemption did not recover as a lossless drain: "
+                      f"{green.recoveries}")
+
+    # 3. the red scenario must be caught and shrunk to {skew, crash}
+    red_full = chaos.parse_scenario(CHECK_RED, name="check-red")
+    red = chaos.run_scenario(red_full)
+    if red.ok:
+        errors.append("red scenario was NOT caught")
+    elif not any(v.invariant == "steps-lost-bound" and v.chain
+                 for v in red.violations):
+        errors.append("red scenario caught without a chained steps-lost "
+                      "violation")
+    else:
+        sh = chaos.shrink(red_full)
+        kinds = sorted(e.kind for e in sh.minimal.entries)
+        if kinds != ["crash", "skew"]:
+            errors.append(f"shrink did not reach the minimal pair: "
+                          f"{sh.minimal.render()}")
+        if not sh.result.violations:
+            errors.append("minimal scenario no longer violates")
+
+    if errors:
+        print("hvd_chaos --check FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print("hvd_chaos --check OK: fixture verdicts pinned, green "
+          "scenario clean, planted violation caught and shrunk to "
+          "its minimal fault pair")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", help="run one DSL scenario string")
+    ap.add_argument("--campaign", type=int, metavar="N",
+                    help="generate and run N seeded scenarios")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="campaign seed (same seed == same schedule)")
+    ap.add_argument("--shrink", action="store_true",
+                    help="ddmin-shrink red scenarios to the minimal "
+                         "failing fault set")
+    ap.add_argument("--render-only", action="store_true",
+                    help="print the generated campaign without running")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--check", action="store_true",
+                    help="self-test against the hand-written fixture "
+                         "and scenarios (tier-1)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return run_check()
+    if args.scenario:
+        return run_scenario_mode(args)
+    if args.campaign:
+        return run_campaign_mode(args)
+    ap.error("one of --scenario, --campaign, or --check is required")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

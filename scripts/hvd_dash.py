@@ -10,13 +10,16 @@ Joins every signed GET surface the rendezvous server exposes
 * ``/serving`` — replica fleet, queue window, SLO headroom;
 * ``/autotune`` — profile-guided plans, predicted vs realized;
 * ``/timeseries`` — the flushed telemetry history summary;
-* ``/events`` — the flight recorder's correlated event timeline.
+* ``/events`` — the flight recorder's correlated event timeline;
+* ``/peerstate`` — the peer snapshot plane's committed generations.
 
 ``--incident`` switches to incident-report mode: it finds the causal
 chains in the event timeline (observe/events.py ``extract_chain``),
-summarizes each (failed rank, steps lost, duration), and emits them as
-text or — with ``--json`` — a machine-readable report; ``--incident
-EVENT_ID`` restricts to the chain that event belongs to.
+summarizes each (failed rank, steps lost, duration), joins the peer
+state plane's recovery capital (the newest committed snapshot
+generation a restore would come from), and emits them as text or —
+with ``--json`` — a machine-readable report; ``--incident EVENT_ID``
+restricts to the chain that event belongs to.
 
 Run::
 
@@ -48,6 +51,7 @@ SECTIONS = (
     ("autotune", "get_autotune"),
     ("timeseries", "get_timeseries"),
     ("events", "get_events"),
+    ("peerstate", "get_peerstate"),
 )
 
 
@@ -101,7 +105,34 @@ def incident_reports(events, event_id=None) -> list:
     return reports
 
 
-def _print_incidents(reports) -> None:
+def peerstate_digest(peerstate) -> dict:
+    """The recovery-capital summary an incident report carries: the
+    newest committed snapshot generation (what a restore-from-peers
+    would load), its replication, and the shard-server fleet size."""
+    ps = peerstate or {}
+    gens = ps.get("generations") or {}
+    newest = ps.get("newest_committed")
+    info = gens.get(str(newest)) or gens.get(newest) or {}
+    return {
+        "newest_committed_gen": newest,
+        "committed_gens": sum(
+            1 for g in gens.values() if (g or {}).get("committed")),
+        "commits": (info or {}).get("commits"),
+        "world_size": (info or {}).get("world_size"),
+        "shard_servers": len(ps.get("addrs") or {}),
+    }
+
+
+def _print_incidents(reports, peerstate=None) -> None:
+    if peerstate is not None:
+        ps = peerstate_digest(peerstate)
+        if ps["newest_committed_gen"] is not None:
+            print(f"peer state: restore source gen "
+                  f"{ps['newest_committed_gen']} "
+                  f"({ps['commits']}/{ps['world_size']} commits, "
+                  f"{ps['shard_servers']} shard server(s))")
+        else:
+            print("peer state: no committed snapshot generation")
     if not reports:
         print("incidents: none (no multi-event causal chains)")
         return
@@ -172,6 +203,16 @@ def _print_dash(d: dict) -> None:
     if isinstance(metrics, dict):
         print(f"metrics: {len(metrics)} rank snapshot(s)")
 
+    ps = d.get("peerstate")
+    if ps:
+        dig = peerstate_digest(ps)
+        print(f"peerstate: newest committed gen "
+              f"{dig['newest_committed_gen']}, "
+              f"{dig['committed_gens']} committed generation(s), "
+              f"{dig['shard_servers']} shard server(s)")
+    else:
+        print("peerstate: off")
+
     ev = d.get("events") or {}
     events = ev.get("events") or []
     ecounts = ev.get("counts") or {}
@@ -205,16 +246,23 @@ def main(argv=None):
     secret = bytes.fromhex(args.secret) if args.secret else None
 
     if args.incident is not None:
-        from horovod_tpu.run.http_client import get_events
+        from horovod_tpu.run.http_client import get_events, get_peerstate
 
         report = get_events(addr, port, secret=secret)
         reports = incident_reports(report.get("events"),
                                    event_id=args.incident or None)
+        try:
+            peerstate = get_peerstate(addr, port, secret=secret)
+        except Exception:  # noqa: BLE001 — the plane may be off
+            peerstate = None
+        out = {"incidents": reports,
+               "peerstate": peerstate_digest(peerstate)
+               if peerstate else None}
         if args.json:
-            print(json.dumps({"incidents": reports}, indent=2))
+            print(json.dumps(out, indent=2))
         else:
-            _print_incidents(reports)
-        return {"incidents": reports}
+            _print_incidents(reports, peerstate=peerstate)
+        return out
 
     d = fetch_all(addr, port, secret)
     if args.json:

@@ -79,6 +79,7 @@ def test_successful_run_passes_result_through(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_control_leg", lambda: {})
     monkeypatch.setattr(bench, "_watch_leg", lambda: {})
     monkeypatch.setattr(bench, "_restore_leg", lambda: {})
+    monkeypatch.setattr(bench, "_chaos_leg", lambda: {})
     monkeypatch.setattr(bench.subprocess, "run",
                         lambda *a, **k: FakeProc())
     bench.main()

@@ -1,0 +1,410 @@
+"""Chaos campaign engine (docs/fault_tolerance.md "Chaos
+certification"): the scenario DSL, the seeded campaign generator and
+its replay contract, the ddmin shrinker, the invariant monitors over
+flight-recorder evidence, seeded fault-injector determinism, the
+driver's preemption-notice handling, composed control-plane failures
+(primary death during a serving drain), and live in-process scenario
+runs through ``horovod_tpu/elastic/chaos.py``."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic import chaos
+from horovod_tpu.elastic import faults as faults_mod
+from horovod_tpu.elastic.chaos import (
+    ChaosEntry,
+    ChaosSpecError,
+    Scenario,
+    _DRAINED_MARK,
+    ddmin,
+    generate_campaign,
+    measure_recoveries,
+    parse_scenario,
+    run_scenario,
+)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.observe import events as events_mod
+from horovod_tpu.observe import invariants as invariants_mod
+from horovod_tpu.observe.fixtures import (
+    CHAOS_EXPECTED,
+    chaos_fixture,
+    evaluate_chaos_fixture,
+)
+from horovod_tpu.run.http_server import (
+    DRAIN_ACK_PREFIX,
+    DRAIN_PREFIX,
+    MEMBERSHIP_SCOPE,
+    PREEMPT_PREFIX,
+    READY_PREFIX,
+    RendezvousServer,
+)
+
+
+def _wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- the scenario DSL --------------------------------------------------------
+def test_parse_render_roundtrip():
+    text = ("at=250ms:rank=1:kind=crash; at=600ms:rank=2:kind=preempt=2s; "
+            "at=900ms:target=primary:kind=kill; "
+            "at=1.2s:rank=0:kind=slow=150ms")
+    s = parse_scenario(text, name="rt")
+    rendered = s.render()
+    again = parse_scenario(rendered, name="rt")
+    assert again.entries == tuple(sorted(
+        s.entries, key=lambda e: e.at))
+    assert again.render() == rendered  # canonical form is a fixpoint
+    # durations render ms-rounded, control entries carry their target
+    assert "at=600ms:rank=2:kind=preempt=2000ms" in rendered
+    assert "at=900ms:target=primary:kind=kill" in rendered
+
+
+def test_parse_sorts_entries_by_time():
+    s = parse_scenario("at=900ms:rank=0:kind=crash; "
+                       "at=100ms:rank=1:kind=hang")
+    assert [e.at for e in s.entries] == [0.1, 0.9]
+
+
+@pytest.mark.parametrize("bad", [
+    "rank=1:kind=crash",                      # no at=
+    "at=100ms:rank=1",                        # no kind=
+    "at=100ms:rank=1:kind=meteor",            # unknown worker kind
+    "at=100ms:kind=crash",                    # worker fault without rank
+    "at=100ms:rank=1:kind=slow",              # slow without duration
+    "at=100ms:target=primary:kind=crash",     # control target, wrong kind
+    "at=100ms:target=primary:rank=1:kind=kill",   # rank on control target
+    "at=100ms:target=switch:kind=kill",       # unknown target
+    "at=100ms:rank=1:kind=crash:color=red",   # unknown field
+    "at=100ms:rank=one:kind=crash",           # non-integer rank
+    "",                                       # empty scenario
+])
+def test_parse_rejections(bad):
+    with pytest.raises(ChaosSpecError):
+        parse_scenario(bad)
+
+
+# -- seeded campaign generation ----------------------------------------------
+def test_campaign_replay_contract_and_coverage():
+    a = generate_campaign(21, count=8, world_size=3, min_np=1)
+    b = generate_campaign(21, count=8, world_size=3, min_np=1)
+    assert [s.render() for s in a] == [s.render() for s in b]
+    entries = [e for s in a for e in s.entries]
+    # coverage guarantees: a preemption, both control-plane kills,
+    # and >= 2 composed fault kinds in every scenario
+    assert any(e.kind == "preempt" for e in entries)
+    assert any(e.target == "primary" for e in entries)
+    assert any(e.target == "relay" for e in entries)
+    for s in a:
+        assert len({(e.kind, e.target) for e in s.entries}) >= 2, s.render()
+
+
+def test_campaign_seeds_disagree():
+    a = [s.render() for s in generate_campaign(1, count=8)]
+    b = [s.render() for s in generate_campaign(2, count=8)]
+    assert a != b
+
+
+def test_campaign_respects_destructive_budget():
+    destructive = {"crash", "hang", "partition", "preempt"}
+    for seed in (3, 4, 5):
+        for s in generate_campaign(seed, count=8, world_size=3, min_np=2):
+            n = sum(1 for e in s.entries if e.kind in destructive)
+            assert n <= 1, s.render()  # world 3, min_np 2 -> budget 1
+
+
+def test_campaign_needs_headroom():
+    with pytest.raises(ChaosSpecError):
+        generate_campaign(0, world_size=2, min_np=2)
+
+
+# -- ddmin shrinking ---------------------------------------------------------
+def test_ddmin_finds_minimal_pair():
+    calls = []
+
+    def failing(subset):
+        calls.append(list(subset))
+        return {3, 6} <= set(subset)
+
+    minimal = ddmin(list(range(1, 9)), failing)
+    assert sorted(minimal) == [3, 6]
+    # memoisation: no subset is evaluated twice
+    keys = [tuple(c) for c in calls]
+    assert len(keys) == len(set(keys))
+
+
+def test_ddmin_single_culprit_and_green_guard():
+    assert ddmin(["a", "b", "c", "d"], lambda s: "c" in s) == ["c"]
+    with pytest.raises(ChaosSpecError):
+        ddmin([1, 2, 3], lambda s: False)
+
+
+# -- invariant monitors ------------------------------------------------------
+def test_chaos_fixture_verdicts_pinned():
+    got = evaluate_chaos_fixture()
+    for field, expected in CHAOS_EXPECTED.items():
+        assert got[field] == expected, field
+    steps = next(v for v in got["violations"]
+                 if v.invariant == "steps-lost-bound")
+    # the causal chain walks from the lease expiry to the lossy resume
+    assert steps.chain[0]["kind"] == "lease.expired"
+    assert invariants_mod.format_violation(steps).startswith(
+        "VIOLATION [steps-lost-bound]")
+
+
+def test_invariant_epoch_monotonic_catches_regression():
+    evs = [
+        {"id": "c1", "ts": 1.0, "kind": "epoch.commit",
+         "correlation_id": "c1", "payload": {"epoch": 4}},
+        {"id": "c2", "ts": 2.0, "kind": "epoch.commit",
+         "correlation_id": "c2", "payload": {"epoch": 3}},
+    ]
+    out = invariants_mod.check_all(evs, only=["epoch-monotonic"])
+    assert len(out) == 1 and out[0].evidence["epoch"] == 3
+    assert not invariants_mod.check_all(
+        [evs[0]], only=["epoch-monotonic"])
+
+
+def test_invariant_abort_propagation_bound():
+    evs = [
+        {"id": "p1", "ts": 10.0, "kind": "abort.publish",
+         "correlation_id": "p1", "payload": {}},
+        {"id": "o1", "ts": 10.5, "kind": "abort.observe",
+         "correlation_id": "p1", "cause_id": "p1", "payload": {}},
+    ]
+    # observed at +0.5s: green under hb=0.5 (bound 1s), red under 0.1
+    assert not invariants_mod.check_all(
+        evs, hb_interval=0.5, only=["abort-propagation"])
+    out = invariants_mod.check_all(
+        evs, hb_interval=0.1, only=["abort-propagation"])
+    assert len(out) == 1 and "bound 200ms" in out[0].message
+
+
+def test_invariant_no_hanging_rank_needs_runner_evidence():
+    assert not invariants_mod.check_all([], only=["no-hanging-rank"])
+    out = invariants_mod.check_all(
+        [], workers={"w0": {"status": "hung"}, "w1": {"status": "running"}},
+        final_world=["w0", "w1"], only=["no-hanging-rank"])
+    assert len(out) == 1 and out[0].evidence["worker"] == "w0"
+
+
+def test_measure_recoveries_over_fixture():
+    recs = measure_recoveries(chaos_fixture())
+    assert [r["epoch"] for r in recs] == [4, 5]
+    lossy, drained = recs
+    assert lossy["removed"] == ["2"]
+    assert lossy["trigger"] == "lease.expired"
+    assert lossy["steps_lost"] == [17, 3]
+    assert lossy["mttr_ms"] == pytest.approx(500.0, abs=1.0)
+    assert not lossy["drained"]
+    assert drained["drained"] and drained["mttr_ms"] is None
+
+
+# -- seeded fault injection (HVD_FAULT_SEED) ---------------------------------
+def _draws(seed, rank, restart, n=6):
+    inj = faults_mod.FaultInjector([], rank, restart, seed=seed)
+    return [inj._rng.random() for _ in range(n)]
+
+
+def test_fault_injector_seed_mixes_rank_and_incarnation():
+    assert _draws(7, 1, 0) == _draws(7, 1, 0)      # replayable
+    assert _draws(7, 1, 0) != _draws(7, 2, 0)      # distinct per rank
+    assert _draws(7, 1, 0) != _draws(7, 1, 1)      # distinct per restart
+    assert _draws(7, 1, 0) != _draws(8, 1, 0)      # seed matters
+
+
+def test_fault_seed_env_plumbs_into_injector(monkeypatch):
+    monkeypatch.setenv("HVD_FAULT_SPEC", "kind=crash:prob=0.5:rank=3")
+    monkeypatch.setenv("HVD_FAULT_SEED", "42")
+    monkeypatch.setenv("HVD_PROCESS_ID", "1")
+    monkeypatch.setenv("HVD_RESTART_COUNT", "2")
+    a = faults_mod._build_from_env()
+    b = faults_mod._build_from_env()
+    assert [a._rng.random() for _ in range(4)] \
+        == [b._rng.random() for _ in range(4)]
+    monkeypatch.setenv("HVD_FAULT_SEED", "not-an-int")
+    with pytest.raises(faults_mod.FaultSpecError):
+        faults_mod._build_from_env()
+
+
+def test_fault_spec_preempt_parses_grace():
+    (f,) = faults_mod.parse_spec("kind=preempt=2s:rank=1")
+    assert f.kind == "preempt" and f.duration == 2.0 and f.rank == 1
+    (bare,) = faults_mod.parse_spec("kind=preempt")
+    assert bare.duration == 0.0  # driver-default grace
+
+
+# -- driver: preemption notices and composed control-plane failure -----------
+@pytest.fixture()
+def quick_env(monkeypatch):
+    monkeypatch.setenv("HVD_HEARTBEAT_INTERVAL_SECONDS", "0.05")
+    monkeypatch.setenv("HVD_ELASTIC_TIMEOUT_SECONDS", "1.0")
+    monkeypatch.setenv("HVD_EVENTS", "1")
+    monkeypatch.setenv("HVD_METRICS_KV_ADDR", "")  # no background flusher
+    events_mod._reset_for_tests()
+    yield monkeypatch
+    events_mod._reset_for_tests()
+    faults_mod.reset()
+
+
+def _ack_drain(server, worker):
+    """A stand-in worker: ack the drain handshake when it opens."""
+    assert _wait_for(lambda: server.get(
+        MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}{worker}") is not None)
+    server.put(MEMBERSHIP_SCOPE, f"{DRAIN_ACK_PREFIX}{worker}", b"{}")
+
+
+def test_preempt_key_becomes_planned_drain(quick_env):
+    server = RendezvousServer(secret=b"chaos-preempt")
+    server.start()
+    try:
+        drv = ElasticDriver(server, ["a", "b"], min_np=1,
+                            controller="xla", drain_timeout=2.0)
+        for w in ("a", "b"):
+            server.put(MEMBERSHIP_SCOPE, f"{READY_PREFIX}0.{w}", b"{}")
+        drv.poll()
+        assert drv._stable
+        # the maintenance signal lands as a KV notice, not a crash
+        server.put(MEMBERSHIP_SCOPE, f"{PREEMPT_PREFIX}b",
+                   json.dumps({"grace": 1.5}).encode())
+        t = threading.Thread(target=_ack_drain, args=(server, "b"))
+        t.start()
+        drv.poll()  # stable-epoch scan turns the notice into a drain
+        t.join(timeout=5)
+        rec = json.loads(server.get(MEMBERSHIP_SCOPE, "epoch"))
+        assert rec["world"] == ["a"] and rec["removed"] == ["b"]
+        assert _DRAINED_MARK in rec["reason"]
+        # voluntary: no flap, no blocklist, and the notice key is gone
+        assert drv.flaps.get("b") is None and "b" not in drv.blocklist
+        assert server.get(MEMBERSHIP_SCOPE, f"{PREEMPT_PREFIX}b") is None
+        kinds = [e["kind"] for e in events_mod.recorder().drain()]
+        assert "preempt.notice" in kinds and "epoch.drain" in kinds
+        drv.shutdown()
+    finally:
+        server.stop()
+
+
+def test_primary_death_during_serving_drain(quick_env, tmp_path):
+    """Composed control-plane failure (chaos campaign class): the
+    rendezvous primary dies while a serving drain handshake is in
+    flight.  The journaled drain request must survive the warm-standby
+    takeover, the worker acks on the NEW primary, and the removal still
+    commits as a lossless drain — no flap, no blocklist, no lost
+    handshake."""
+    journal = str(tmp_path / "rdv.journal")
+    secret = b"chaos-drain"
+    primary = RendezvousServer(secret=secret, journal_path=journal)
+    primary.start()
+    drv = ElasticDriver(primary, ["a", "b", "c"], min_np=1,
+                        controller="xla", drain_timeout=8.0)
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault(
+        "ok", drv.remove("b", "autoscale scale-down", drain=True)))
+    t.start()
+    standby = None
+    try:
+        assert _wait_for(lambda: primary.get(
+            MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}b") is not None)
+        primary.stop()  # dies mid-handshake, ack outstanding
+        standby = RendezvousServer(secret=secret, journal_path=journal)
+        standby.start()
+        # the drain request replayed from the journal: the handshake
+        # state survived the primary
+        assert standby.get(MEMBERSHIP_SCOPE, f"{DRAIN_PREFIX}b") is not None
+        drv.server = standby  # the fenced-takeover server swap
+        standby.put(MEMBERSHIP_SCOPE, f"{DRAIN_ACK_PREFIX}b", b"{}")
+        t.join(timeout=10)
+        assert not t.is_alive() and out["ok"] is True
+        rec = json.loads(standby.get(MEMBERSHIP_SCOPE, "epoch"))
+        assert rec["world"] == ["a", "c"] and rec["removed"] == ["b"]
+        assert _DRAINED_MARK in rec["reason"]
+        assert drv.flaps.get("b") is None and "b" not in drv.blocklist
+        drv.shutdown()
+    finally:
+        t.join(timeout=1)
+        if standby is not None:
+            standby.stop()
+
+
+# -- live scenarios (in-process world: server + driver + workers) ------------
+def test_live_crash_scenario_green():
+    res = run_scenario(parse_scenario("at=200ms:rank=1:kind=crash",
+                                      name="crash"))
+    assert res.ok, [v.message for v in res.violations]
+    assert res.failed_reason is None
+    assert res.workers["1"]["status"] == "crashed"
+    assert "1" not in res.final_world and len(res.final_world) == 2
+    (rec,) = res.recoveries
+    assert rec["removed"] == ["1"] and not rec["drained"]
+    assert rec["mttr_ms"] is not None
+    assert all(lost <= 5 for lost in rec["steps_lost"])
+
+
+def test_live_preempt_is_lossless_drain():
+    res = run_scenario(parse_scenario("at=300ms:rank=2:kind=preempt=2s",
+                                      name="preempt"))
+    assert res.ok, [v.message for v in res.violations]
+    assert res.workers["2"]["status"] == "preempted"
+    (rec,) = res.recoveries
+    assert rec["drained"] and rec["trigger"] == "preempt.notice"
+    assert rec["steps_lost"] == [0, 0]  # the planned-drain promise
+    kinds = {e["kind"] for e in res.events}
+    assert {"preempt.notice", "epoch.drain", "snapshot.commit"} <= kinds
+
+
+def test_live_primary_kill_transparent_takeover():
+    res = run_scenario(parse_scenario("at=300ms:target=primary:kind=kill",
+                                      name="primary"))
+    assert res.ok, [v.message for v in res.violations]
+    kinds = [e["kind"] for e in res.events]
+    assert "primary.takeover" in kinds
+    # a control-plane outage removes nobody and loses no steps
+    assert res.recoveries == []
+    assert len(res.final_world) == 3
+    assert all(i["status"] == "finished" for i in res.workers.values())
+
+
+@pytest.mark.slow
+def test_live_composed_crash_plus_partition():
+    res = run_scenario(parse_scenario(
+        "at=250ms:rank=1:kind=crash; at=900ms:rank=2:kind=partition",
+        name="composed"))
+    assert res.ok, [v.message for v in res.violations]
+    assert res.workers["1"]["status"] == "crashed"
+    assert res.workers["2"]["status"] == "partitioned"
+    assert res.final_world == ["0"]
+    assert [r["removed"] for r in res.recoveries] == [["1"], ["2"]]
+
+
+@pytest.mark.slow
+def test_live_campaign_acceptance_and_replay():
+    """The ISSUE acceptance drive: an 8-scenario seeded campaign
+    (>= 2 fault kinds each, preemption and a primary kill included)
+    runs green end-to-end, and the same seed renders the identical
+    schedule again."""
+    scenarios = generate_campaign(7, count=8)
+    campaign = chaos.run_campaign(scenarios, seed=7)
+    assert campaign.ok, [
+        (r.scenario.name, [v.message for v in r.violations],
+         r.failed_reason)
+        for r in campaign.results if not r.ok]
+    replay = generate_campaign(7, count=8)
+    assert [s.render() for s in replay] \
+        == [s.render() for s in scenarios]
+
+
+def test_hvd_chaos_check_self_test():
+    """The tier-1 certification fixture: pinned invariant verdicts, a
+    green composed scenario, and a planted violation caught AND shrunk
+    to its minimal fault pair (scripts/hvd_chaos.py --check)."""
+    import scripts.hvd_chaos as cli
+
+    assert cli.main(["--check"]) == 0

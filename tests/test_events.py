@@ -301,7 +301,11 @@ def test_hvd_dash_one_page_and_incident_json(server, capsys):
     out = hvd_dash.main([f"127.0.0.1:{server.port}", "--secret",
                          SECRET.hex(), "--incident", "--json"])
     payload = json.loads(capsys.readouterr().out)
-    assert payload == {"incidents": out["incidents"]}
+    # the incident report joins the peer state plane's recovery
+    # capital; with no snapshots pushed the digest is empty but present
+    assert payload == {"incidents": out["incidents"],
+                       "peerstate": out["peerstate"]}
+    assert payload["peerstate"]["newest_committed_gen"] is None
     (incident,) = out["incidents"]
     assert incident["summary"]["failed_rank"] == 1
     assert incident["summary"]["steps_lost"] == 3

@@ -810,3 +810,47 @@ def test_dead_spare_purged_before_admission(rdv, monkeypatch):
         assert drv.spares == ["squiet"]
     finally:
         drv.shutdown()
+
+
+def test_partition_mid_peer_restore_then_heals(rdv, monkeypatch):
+    """Composed failure (chaos campaign class): a network partition
+    lands while a restore-from-peers is IN FLIGHT — every shard pull
+    dies the way partitioned peer traffic does.  The restore must come
+    back empty-handed gracefully (``last_failure`` names the shard, no
+    exception escapes), and once the partition heals the SAME committed
+    generation restores intact — the capital survives the partition."""
+    server, addr, port = rdv
+    monkeypatch.setenv("HVD_NUM_PROCESSES", "2")   # gen committed = both
+    m1 = _manager(server, "w0", 0, k=2)
+    m2 = _manager(server, "w1", 1, k=2)
+    try:
+        m1.snapshot_sync({"r": np.arange(6.0)}, 7)
+        m2.snapshot_sync({"r": np.arange(3.0) + 1.0}, 7)
+        assert m1.drain(30.0) and m2.drain(30.0)
+
+        # the partition arms AFTER the snapshots committed, BEFORE the
+        # relaunch pulls — i.e. mid-restore from the plane's viewpoint
+        monkeypatch.setenv("HVD_FAULT_SPEC",
+                           "kind=partition:seam=peer_pull:restart=*")
+        faults_mod.reset()
+        fresh = PeerSnapshotManager(replicas_k=2, nshards=2,
+                                    addr="127.0.0.1", port=port,
+                                    secret=SECRET, worker="w0", rank=0)
+        assert fresh.restore() is None
+        assert "no live peer" in (fresh.last_failure or "")
+
+        # partition heals: the fault disarms and the same generation
+        # restores from the surviving replicas
+        monkeypatch.delenv("HVD_FAULT_SPEC")
+        faults_mod.reset()
+        healed = PeerSnapshotManager(replicas_k=2, nshards=2,
+                                     addr="127.0.0.1", port=port,
+                                     secret=SECRET, worker="w0", rank=0)
+        got = healed.restore()
+        assert got is not None
+        state, gen = got
+        assert gen == 7
+        np.testing.assert_array_equal(state["r"], np.arange(6.0))
+    finally:
+        m1.stop()
+        m2.stop()
